@@ -1,0 +1,10 @@
+"""hydragnn_tpu: TPU-native multi-headed GNN training framework.
+
+A ground-up JAX/XLA/pjit re-design with the capabilities of ORNL's HydraGNN
+(config-driven multi-task GNN training for atomistic science).  See SURVEY.md
+for the reference blueprint and the per-module docstrings for parity notes.
+"""
+
+from hydragnn_tpu import graph, config, models
+
+__version__ = "0.1.0"

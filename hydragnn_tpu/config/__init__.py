@@ -1,0 +1,10 @@
+from hydragnn_tpu.config.config import (
+    ALL_MODEL_TYPES,
+    DatasetStats,
+    finalize,
+    get_log_name_config,
+    head_specs_from_config,
+    label_slices_from_config,
+    load_config,
+    save_config,
+)

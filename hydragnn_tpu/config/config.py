@@ -1,0 +1,256 @@
+"""Config system: accepts the reference's JSON schema, finalizes explicitly.
+
+The reference mutates its config dict at runtime based on the loaded data
+(``update_config``, reference hydragnn/utils/config_utils.py:23-106).  Here the
+same inference is an explicit, pure step: :func:`finalize` takes the raw JSON
+dict plus dataset statistics and returns the completed dict — output dims from
+head specs, ``input_dim`` from selected features, PNA degree histogram,
+edge-dim and equivariance validation — with identical key layout so existing
+HydraGNN JSON configs work verbatim (e.g. reference tests/inputs/ci.json).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.graph.batch import HeadSpec
+
+# Architecture keys defaulted to None when absent, matching
+# reference hydragnn/utils/config_utils.py:59-80.
+_OPTIONAL_ARCH_KEYS = [
+    "radius",
+    "num_gaussians",
+    "num_filters",
+    "envelope_exponent",
+    "num_after_skip",
+    "num_before_skip",
+    "basis_emb_size",
+    "int_emb_size",
+    "out_emb_size",
+    "num_radial",
+    "num_spherical",
+]
+
+EDGE_MODELS = ["PNA", "CGCNN", "SchNet", "EGNN"]
+EQUIVARIANT_MODELS = ["EGNN", "SchNet"]
+ALL_MODEL_TYPES = [
+    "SAGE",
+    "GIN",
+    "GAT",
+    "MFC",
+    "PNA",
+    "CGCNN",
+    "SchNet",
+    "DimeNet",
+    "EGNN",
+]
+
+
+def load_config(path_or_dict) -> Dict[str, Any]:
+    if isinstance(path_or_dict, dict):
+        return copy.deepcopy(path_or_dict)
+    with open(path_or_dict, "r") as f:
+        return json.load(f)
+
+
+def finalize(
+    config: Dict[str, Any],
+    dataset_stats: "DatasetStats",
+) -> Dict[str, Any]:
+    """Complete a raw config from dataset statistics (pure; returns a copy).
+
+    Parity with reference update_config (hydragnn/utils/config_utils.py:23-106):
+      - output_dim / output_type from Variables_of_interest + feature dims
+      - input_dim = number of selected input node features
+      - PNA degree histogram + max_neighbours
+      - edge_dim validation (PNA/CGCNN/SchNet/EGNN only; CGCNN default 0)
+      - equivariance validation (EGNN/SchNet only)
+      - defaults: optimizer AdamW, loss mse, activation relu, SyncBatchNorm off
+    """
+    config = copy.deepcopy(config)
+    nn = config["NeuralNetwork"]
+    arch = nn["Architecture"]
+    var = nn["Variables_of_interest"]
+    training = nn["Training"]
+
+    output_type: List[str] = var["type"]
+    output_index: List[int] = var["output_index"]
+
+    # Per-head output dims from the Dataset feature dims (reference
+    # update_config_NN_outputs, config_utils.py:153-189).
+    if "Dataset" in config and "node_features" in config["Dataset"]:
+        gdims = config["Dataset"].get("graph_features", {}).get("dim", [])
+        ndims = config["Dataset"]["node_features"]["dim"]
+        dims_list = [
+            gdims[output_index[i]] if t == "graph" else ndims[output_index[i]]
+            for i, t in enumerate(output_type)
+        ]
+    else:
+        dims_list = var["output_dim"]
+
+    arch["output_dim"] = dims_list
+    arch["output_type"] = output_type
+    arch["num_nodes"] = int(dataset_stats.num_nodes_sample)
+
+    if dataset_stats.graph_size_variable and (
+        "node" in arch.get("output_heads", {})
+        and arch["output_heads"]["node"].get("type") == "mlp_per_node"
+        and "node" in output_type
+    ):
+        raise ValueError('"mlp_per_node" is not allowed for variable graph size')
+
+    arch["input_dim"] = len(var["input_node_features"])
+
+    if arch["model_type"] == "PNA":
+        deg = dataset_stats.pna_deg
+        assert deg is not None, "PNA requires a degree histogram in dataset stats"
+        arch["pna_deg"] = [int(d) for d in deg]
+        arch["max_neighbours"] = len(deg) - 1
+    else:
+        arch["pna_deg"] = None
+
+    for key in _OPTIONAL_ARCH_KEYS:
+        arch.setdefault(key, None)
+
+    # edge_dim (reference update_config_edge_dim, config_utils.py:120-132)
+    arch["edge_dim"] = None
+    if arch.get("edge_features"):
+        assert arch["model_type"] in EDGE_MODELS, (
+            "Edge features can only be used with EGNN, SchNet, PNA and CGCNN."
+        )
+        arch["edge_dim"] = len(arch["edge_features"])
+    elif arch["model_type"] == "CGCNN":
+        arch["edge_dim"] = 0
+
+    # equivariance (reference update_config_equivariance, config_utils.py:109-117)
+    if arch.get("equivariance"):
+        assert arch["model_type"] in EQUIVARIANT_MODELS, (
+            "E(3) equivariance can only be ensured for EGNN and SchNet."
+        )
+    else:
+        arch["equivariance"] = False
+
+    arch.setdefault("freeze_conv_layers", False)
+    arch.setdefault("initial_bias", None)
+    training.setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
+    training.setdefault("loss_function_type", "mse")
+    arch.setdefault("activation_function", "relu")
+    arch.setdefault("SyncBatchNorm", False)
+    arch.setdefault("task_weights", [1.0] * len(output_type))
+    var.setdefault("denormalize_output", False)
+    return config
+
+
+class DatasetStats:
+    """Host-side dataset statistics needed to finalize a config."""
+
+    def __init__(
+        self,
+        num_nodes_sample: int,
+        graph_size_variable: bool,
+        pna_deg: Optional[Sequence[int]] = None,
+        max_nodes: Optional[int] = None,
+        max_edges: Optional[int] = None,
+        minmax_node_feature: Optional[np.ndarray] = None,
+        minmax_graph_feature: Optional[np.ndarray] = None,
+    ):
+        self.num_nodes_sample = num_nodes_sample
+        self.graph_size_variable = graph_size_variable
+        self.pna_deg = pna_deg
+        self.max_nodes = max_nodes or num_nodes_sample
+        self.max_edges = max_edges
+        self.minmax_node_feature = minmax_node_feature
+        self.minmax_graph_feature = minmax_graph_feature
+
+    @staticmethod
+    def from_samples(samples, need_deg: bool = False) -> "DatasetStats":
+        """Compute stats by scanning host-side GraphSamples (degree histogram
+        parity with reference gather_deg, hydragnn/preprocess/utils.py:177-195)."""
+        sizes = {s.num_nodes for s in samples}
+        max_nodes = max(s.num_nodes for s in samples)
+        max_edges = max(s.num_edges for s in samples)
+        pna_deg = None
+        if need_deg:
+            max_deg = 0
+            for s in samples:
+                if s.num_edges:
+                    d = np.bincount(s.edge_index[1], minlength=s.num_nodes)
+                    max_deg = max(max_deg, int(d.max()))
+            hist = np.zeros(max_deg + 1, dtype=np.int64)
+            for s in samples:
+                d = (
+                    np.bincount(s.edge_index[1], minlength=s.num_nodes)
+                    if s.num_edges
+                    else np.zeros(s.num_nodes, dtype=np.int64)
+                )
+                hist += np.bincount(d, minlength=max_deg + 1)
+            pna_deg = hist.tolist()
+        return DatasetStats(
+            num_nodes_sample=samples[0].num_nodes,
+            graph_size_variable=len(sizes) > 1,
+            pna_deg=pna_deg,
+            max_nodes=max_nodes,
+            max_edges=max_edges,
+        )
+
+
+def head_specs_from_config(config: Dict[str, Any]) -> List[HeadSpec]:
+    """Static head layout from a finalized config."""
+    nn = config["NeuralNetwork"]
+    var = nn["Variables_of_interest"]
+    arch = nn["Architecture"]
+    names = var.get("output_names", [f"head{i}" for i in range(len(var["type"]))])
+    return [
+        HeadSpec(name=names[i], type=t, dim=int(arch["output_dim"][i]))
+        for i, t in enumerate(var["type"])
+    ]
+
+
+def label_slices_from_config(config):
+    """Per-head (start, end) column slices into the packed graph_y / node_y
+    sample arrays, from Dataset feature dims + output_index (parity with
+    reference update_predicted_values, hydragnn/preprocess/utils.py:237-279)."""
+    nn = config["NeuralNetwork"]
+    var = nn["Variables_of_interest"]
+    ds = config.get("Dataset", {})
+    gdims = ds.get("graph_features", {}).get("dim", [])
+    ndims = ds.get("node_features", {}).get("dim", [])
+    gslices, nslices = [], []
+    for t, idx in zip(var["type"], var["output_index"]):
+        if t == "graph":
+            lo = int(sum(gdims[:idx]))
+            gslices.append((lo, lo + int(gdims[idx])))
+            nslices.append((0, 0))
+        else:
+            lo = int(sum(ndims[:idx]))
+            nslices.append((lo, lo + int(ndims[idx])))
+            gslices.append((0, 0))
+    return gslices, nslices
+
+
+def get_log_name_config(config: Dict[str, Any]) -> str:
+    """Run-name string, same fields as reference get_log_name_config
+    (hydragnn/utils/config_utils.py:243-276)."""
+    nn = config["NeuralNetwork"]
+    arch, training = nn["Architecture"], nn["Training"]
+    name = config["Dataset"]["name"]
+    trimmed = name[: name.rfind("_") if name.rfind("_") > 0 else None]
+    return (
+        f"{arch['model_type']}-r-{arch.get('radius')}-ncl-{arch['num_conv_layers']}"
+        f"-hd-{arch['hidden_dim']}-ne-{training['num_epoch']}"
+        f"-lr-{training['Optimizer']['learning_rate']}-bs-{training['batch_size']}"
+        f"-data-{trimmed}"
+        f"-node_ft-{''.join(str(x) for x in nn['Variables_of_interest']['input_node_features'])}"
+        f"-task_weights-{''.join(str(w) + '-' for w in arch['task_weights'])}"
+    )
+
+
+def save_config(config: Dict[str, Any], log_name: str, path: str = "./logs/") -> None:
+    os.makedirs(os.path.join(path, log_name), exist_ok=True)
+    with open(os.path.join(path, log_name, "config.json"), "w") as f:
+        json.dump(config, f, indent=4)

@@ -1,0 +1,15 @@
+from hydragnn_tpu.graph.batch import (
+    GraphBatch,
+    GraphSample,
+    HeadSpec,
+    PadSpec,
+    collate,
+    default_label_slices,
+)
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.graph.neighborlist import (
+    radius_graph,
+    radius_graph_pbc,
+    edge_lengths,
+    normalize_rotation,
+)

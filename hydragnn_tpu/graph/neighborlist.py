@@ -1,0 +1,163 @@
+"""Host-side graph construction: radius graphs, periodic neighbor lists.
+
+Graph construction never runs on the TPU — it happens once in the input
+pipeline (as in the reference, where SerializedDataLoader recomputes radius
+graphs at load time; hydragnn/preprocess/serialized_dataset_loader.py:127-141).
+
+Replaces:
+  - PyG ``RadiusGraph``           -> :func:`radius_graph` (scipy cKDTree)
+  - ASE ``neighbor_list`` + PBC   -> :func:`radius_graph_pbc` (periodic image
+    replication; reference hydragnn/preprocess/utils.py:134-174)
+  - PyG ``NormalizeRotation``     -> :func:`normalize_rotation`
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+
+def radius_graph(
+    pos: np.ndarray,
+    radius: float,
+    max_neighbours: int = 32,
+    loop: bool = False,
+) -> np.ndarray:
+    """Edges (2, E) between nodes within ``radius``.
+
+    Matches PyG RadiusGraph semantics (reference
+    hydragnn/preprocess/utils.py:102-107): for each target node, up to
+    ``max_neighbours`` sources within the radius; ``edge_index[0]`` is the
+    source, ``edge_index[1]`` the target.
+    """
+    pos = np.asarray(pos, dtype=np.float64)
+    tree = cKDTree(pos)
+    src_list = []
+    dst_list = []
+    # Query per-target neighbor lists sorted by distance, cap at max_neighbours.
+    dists, idxs = tree.query(
+        pos, k=min(max_neighbours + 1, pos.shape[0]), distance_upper_bound=radius
+    )
+    n = pos.shape[0]
+    for i in range(n):
+        for d, j in zip(np.atleast_1d(dists[i]), np.atleast_1d(idxs[i])):
+            if j >= n or not np.isfinite(d):
+                continue
+            if j == i and not loop:
+                continue
+            src_list.append(j)
+            dst_list.append(i)
+    if not src_list:
+        return np.zeros((2, 0), np.int32)
+    return np.stack(
+        [np.asarray(src_list, np.int32), np.asarray(dst_list, np.int32)], axis=0
+    )
+
+
+def _as_cell_matrix(cell) -> np.ndarray:
+    cell = np.asarray(cell, dtype=np.float64)
+    if cell.ndim == 1:
+        return np.diag(cell)
+    if cell.shape == (3, 3):
+        return cell
+    raise ValueError(f"cell must be a 3-vector or 3x3 matrix, got {cell.shape}")
+
+
+def radius_graph_pbc(
+    pos: np.ndarray,
+    cell,
+    radius: float,
+    max_neighbours: int = 1000,
+    loop: bool = False,
+    check_duplicates: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Periodic radius graph: returns (edge_index (2,E), edge_length (E,)).
+
+    Semantics of ASE ``neighbor_list("ijd", ...)`` as used by the reference's
+    RadiusGraphPBC (hydragnn/preprocess/utils.py:139-171): neighbors across
+    periodic images of the cell; a pair connected both directly and through an
+    image would create duplicate (i, j) edges, which the reference rejects —
+    we do the same when ``check_duplicates``.
+    """
+    pos = np.asarray(pos, dtype=np.float64)
+    H = _as_cell_matrix(cell)  # rows are lattice vectors
+    n = pos.shape[0]
+
+    # How many images along each lattice direction can hold a point within
+    # `radius`: use the perpendicular distance of each lattice plane.
+    Hinv = np.linalg.inv(H)
+    # perpendicular width along axis k = 1 / ||row_k of H^-T||
+    widths = 1.0 / np.linalg.norm(Hinv, axis=0)
+    n_img = np.maximum(np.ceil(radius / widths).astype(int), 0)
+
+    shifts = []
+    for ix in range(-n_img[0], n_img[0] + 1):
+        for iy in range(-n_img[1], n_img[1] + 1):
+            for iz in range(-n_img[2], n_img[2] + 1):
+                shifts.append((ix, iy, iz))
+    shifts = np.asarray(shifts, dtype=np.float64)  # [S, 3]
+    disp = shifts @ H  # cartesian displacement per image [S, 3]
+
+    # Replicated source points: image copies of every atom.
+    S = shifts.shape[0]
+    rep_pos = (pos[None, :, :] + disp[:, None, :]).reshape(S * n, 3)
+    rep_idx = np.tile(np.arange(n), S)
+    is_central = np.repeat((shifts == 0).all(axis=1), n)
+
+    tree = cKDTree(rep_pos)
+    src, dst, lengths = [], [], []
+    for i in range(n):
+        neigh = tree.query_ball_point(pos[i], radius)
+        cand = []
+        for k in neigh:
+            j = rep_idx[k]
+            if is_central[k] and j == i and not loop:
+                continue
+            d = np.linalg.norm(rep_pos[k] - pos[i])
+            if d > radius + 1e-12:
+                continue
+            cand.append((d, j))
+        cand.sort(key=lambda t: t[0])
+        for d, j in cand[:max_neighbours]:
+            src.append(j)
+            dst.append(i)
+            lengths.append(d)
+
+    edge_index = (
+        np.stack([np.asarray(src, np.int32), np.asarray(dst, np.int32)])
+        if src
+        else np.zeros((2, 0), np.int32)
+    )
+    lengths = np.asarray(lengths, np.float64)
+
+    if check_duplicates and edge_index.shape[1]:
+        pairs = set()
+        for a, b in zip(edge_index[0], edge_index[1]):
+            if (a, b) in pairs:
+                raise ValueError(
+                    "Adding periodic boundary conditions would result in duplicate "
+                    "edges. Cutoff radius must be reduced or system size increased."
+                )
+            pairs.add((a, b))
+    return edge_index, lengths.astype(np.float32)
+
+
+def edge_lengths(pos: np.ndarray, edge_index: np.ndarray) -> np.ndarray:
+    """Euclidean length per edge, shape (E, 1)."""
+    d = pos[edge_index[0]] - pos[edge_index[1]]
+    return np.linalg.norm(d, axis=1, keepdims=True).astype(np.float32)
+
+
+def normalize_rotation(pos: np.ndarray) -> np.ndarray:
+    """Rotate positions onto their principal axes (PyG NormalizeRotation
+    semantics, used by the reference's rotational-invariance path;
+    hydragnn/preprocess/serialized_dataset_loader.py:123-125)."""
+    pos = np.asarray(pos, dtype=np.float64)
+    centered = pos - pos.mean(axis=0, keepdims=True)
+    _, _, vt = np.linalg.svd(centered, full_matrices=False)
+    # Fix sign convention for determinism.
+    signs = np.sign(vt[np.arange(vt.shape[0]), np.argmax(np.abs(vt), axis=1)])
+    vt = vt * signs[:, None]
+    return (centered @ vt.T).astype(np.float32)

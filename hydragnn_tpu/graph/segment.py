@@ -1,0 +1,102 @@
+"""Segment (scatter/gather) ops — the message-passing primitives.
+
+TPU-native replacement for torch-scatter / torch-sparse (reference depends on
+them for all PyG conv internals; see SURVEY.md §2.3).  XLA lowers
+``jax.ops.segment_sum`` to efficient one-hot matmuls / scatter kernels on TPU,
+so message passing is expressed as gather (``x[senders]``) + segment reduce at
+``receivers`` with *static* ``num_segments``.
+
+All ops take an optional mask (1.0 = valid) so padded edges/nodes contribute
+nothing — this is what makes padded static-shape batching exact.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BIG = 1e9
+
+
+def segment_sum(data, segment_ids, num_segments, mask=None):
+    if mask is not None:
+        data = data * _bcast(mask, data)
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+def segment_count(segment_ids, num_segments, mask=None, dtype=jnp.float32):
+    ones = jnp.ones((segment_ids.shape[0],), dtype)
+    if mask is not None:
+        ones = ones * mask.astype(dtype)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments, mask=None):
+    total = segment_sum(data, segment_ids, num_segments, mask)
+    count = segment_count(segment_ids, num_segments, mask)
+    return total / _bcast(jnp.maximum(count, 1.0), total)
+
+
+def segment_max(data, segment_ids, num_segments, mask=None):
+    """Max-reduce; empty/masked segments yield 0 (matching PyG conventions)."""
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data) > 0, data, -_BIG)
+    out = jax.ops.segment_max(data, segment_ids, num_segments)
+    return jnp.where(out <= -_BIG * 0.5, 0.0, out)
+
+
+def segment_min(data, segment_ids, num_segments, mask=None):
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data) > 0, data, _BIG)
+    out = jax.ops.segment_min(data, segment_ids, num_segments)
+    return jnp.where(out >= _BIG * 0.5, 0.0, out)
+
+
+def segment_std(data, segment_ids, num_segments, mask=None, eps=1e-5):
+    """Per-segment standard deviation (PNA 'std' aggregator numerics)."""
+    mean = segment_mean(data, segment_ids, num_segments, mask)
+    sq_mean = segment_mean(data * data, segment_ids, num_segments, mask)
+    var = jnp.maximum(sq_mean - mean * mean, 0.0)
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(logits, segment_ids, num_segments, mask=None):
+    """Numerically-stable softmax within segments (GATv2 attention).
+
+    Padded entries (mask == 0) get zero weight.
+    """
+    if mask is not None:
+        logits = jnp.where(_bcast(mask, logits) > 0, logits, -_BIG)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments)
+    seg_max = jnp.where(seg_max <= -_BIG * 0.5, 0.0, seg_max)
+    logits = logits - seg_max[segment_ids]
+    unnorm = jnp.exp(logits)
+    if mask is not None:
+        unnorm = unnorm * _bcast(mask, unnorm)
+    denom = jax.ops.segment_sum(unnorm, segment_ids, num_segments)
+    return unnorm / jnp.maximum(denom, 1e-16)[segment_ids]
+
+
+def degree(receivers, num_nodes, mask=None):
+    """In-degree per node (reference computes degree on edge_index[1];
+    hydragnn/preprocess/utils.py:188)."""
+    return segment_count(receivers, num_nodes, mask)
+
+
+def masked_mean_pool(x, node_gid, num_graphs, node_mask):
+    """Per-graph mean over *real* nodes — parity with PyG global_mean_pool
+    (reference hydragnn/models/Base.py:296) under padding."""
+    return segment_mean(x, node_gid, num_graphs, node_mask)
+
+
+def masked_sum_pool(x, node_gid, num_graphs, node_mask):
+    return segment_sum(x, node_gid, num_graphs, node_mask)
+
+
+def _bcast(mask, data):
+    """Broadcast a [E]/[N] mask against [E, ...] data."""
+    if mask.ndim == data.ndim:
+        return mask.astype(data.dtype)
+    return mask.reshape(mask.shape + (1,) * (data.ndim - mask.ndim)).astype(data.dtype)

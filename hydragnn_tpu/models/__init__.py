@@ -1,0 +1,11 @@
+from hydragnn_tpu.models.base import Base, ModelConfig, MLPNode, multihead_loss
+from hydragnn_tpu.models.create import create_model, create_model_config, init_model
+from hydragnn_tpu.models.sage import SAGEStack
+from hydragnn_tpu.models.gin import GINStack
+from hydragnn_tpu.models.gat import GATStack
+from hydragnn_tpu.models.mfc import MFCStack
+from hydragnn_tpu.models.pna import PNAStack
+from hydragnn_tpu.models.cgcnn import CGCNNStack
+from hydragnn_tpu.models.schnet import SCFStack
+from hydragnn_tpu.models.egnn import EGCLStack
+from hydragnn_tpu.models.dimenet import DIMEStack

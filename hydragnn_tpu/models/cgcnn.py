@@ -1,0 +1,41 @@
+"""CGCNN stack (parity: reference hydragnn/models/CGCNNStack.py).
+
+CGConv with additive aggregation: for z_ij = [x_i, x_j, e_ij],
+out_i = x_i + sum_{j->i} sigmoid(W_f z_ij) * softplus(W_s z_ij).
+CGConv preserves feature dimension, so the stack forces
+hidden_dim = input_dim (reference CGCNNStack.py:30-40), and conv-type node
+heads are rejected (CGCNNStack.py:66-89 — enforced in ModelConfig.from_config
+via the create-time validation in models/create.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.models.base import Base
+
+
+class CGConv(nn.Module):
+    dim: int  # feature dim, preserved
+    edge_dim: int = 0
+
+    @nn.compact
+    def __call__(self, x, pos, g, train):
+        n = x.shape[0]
+        src, dst = g.senders, g.receivers
+        parts = [x[dst], x[src]]
+        if self.edge_dim and g.edge_attr is not None:
+            parts.append(g.edge_attr)
+        z = jnp.concatenate(parts, axis=-1)
+        gate = jax.nn.sigmoid(nn.Dense(self.dim, name="lin_f")(z))
+        core = jax.nn.softplus(nn.Dense(self.dim, name="lin_s")(z))
+        agg = segment.segment_sum(gate * core, dst, n, g.edge_mask)
+        return x + agg, pos
+
+
+class CGCNNStack(Base):
+    def make_conv(self, name, in_dim, out_dim, last_layer):
+        return CGConv(dim=in_dim, edge_dim=self.cfg.edge_dim or 0, name=name)

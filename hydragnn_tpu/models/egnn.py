@@ -1,0 +1,80 @@
+"""EGNN stack (parity: reference hydragnn/models/EGCLStack.py).
+
+E(n)-equivariant graph convolution layer: edge MLP on
+[h_src, h_dst, ||dx||^2, edge_attr]; equivariant coordinate update from a
+scalar gate on the edge features (tanh-bounded, clamped, mean-aggregated);
+node MLP on [h, sum of incident messages].  The coordinate branch runs on
+all but the last layer (reference EGCLStack.py:36-46); aggregation happens
+at the edge *source* as in the reference (EGCLStack.py:194,210).
+No BatchNorm feature layers (reference uses Identity; EGCLStack.py:41).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.models.base import Base
+
+
+class EGCL(nn.Module):
+    out_dim: int
+    hidden_dim: int
+    edge_dim: int
+    equivariant: bool
+
+    @nn.compact
+    def __call__(self, x, pos, g, train):
+        n = x.shape[0]
+        src, dst = g.senders, g.receivers
+
+        diff = pos[src] - pos[dst]
+        radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
+        diff = diff / (jnp.sqrt(radial) + 1.0)  # norm_diff=True
+
+        parts = [x[src], x[dst], radial]
+        if self.edge_dim and g.edge_attr is not None:
+            parts.append(g.edge_attr)
+        m = jnp.concatenate(parts, axis=-1)
+        m = nn.Dense(self.hidden_dim, name="edge_mlp_0")(m)
+        m = nn.relu(m)
+        m = nn.Dense(self.hidden_dim, name="edge_mlp_1")(m)
+        m = nn.relu(m)
+        m = m * g.edge_mask[:, None]
+
+        if self.equivariant:
+            c = nn.Dense(self.hidden_dim, name="coord_mlp_0")(m)
+            c = nn.relu(c)
+            c = nn.Dense(
+                1,
+                use_bias=False,
+                kernel_init=nn.initializers.variance_scaling(
+                    0.001, "fan_avg", "uniform"
+                ),
+                name="coord_mlp_1",
+            )(c)
+            c = jnp.tanh(c)  # tanh=True in reference E_GCL
+            trans = jnp.clip(diff * c, -100.0, 100.0)
+            pos = pos + segment.segment_mean(trans, src, n, g.edge_mask)
+
+        agg = segment.segment_sum(m, src, n, g.edge_mask)
+        h = jnp.concatenate([x, agg], axis=-1)
+        h = nn.Dense(self.hidden_dim, name="node_mlp_0")(h)
+        h = nn.relu(h)
+        h = nn.Dense(self.out_dim, name="node_mlp_1")(h)
+        return h, pos
+
+
+class EGCLStack(Base):
+    has_batchnorm: bool = False
+
+    def make_conv(self, name, in_dim, out_dim, last_layer):
+        c = self.cfg
+        return EGCL(
+            out_dim,
+            hidden_dim=c.hidden_dim,
+            edge_dim=c.edge_dim or 0,
+            equivariant=c.equivariance and not last_layer,
+            name=name,
+        )

@@ -1,0 +1,121 @@
+"""GAT stack (parity: reference hydragnn/models/GATStack.py).
+
+GATv2 attention with heads=6, negative_slope=0.05, attention dropout 0.25,
+and self-loops (reference GATStack.py:91-100).  All-but-last encoder layers
+concatenate heads (features = hidden_dim * heads); the final layer averages
+them (GATStack.py:35-46) — the stack overrides the encoder/BN dim bookkeeping
+accordingly.
+
+The padded-edge problem GATv2 poses on TPU is the softmax: attention is
+normalized per receiving node over its incident edges *plus* its self-loop.
+We compute a numerically-stable segment softmax over the static edge array
+with masks, handling the self-loop term analytically (no edge-array resize).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.models.base import Base
+
+
+class GATv2Conv(nn.Module):
+    out_dim: int  # per-head output dim
+    heads: int
+    negative_slope: float
+    concat: bool
+    dropout: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, pos, g, train):
+        n = x.shape[0]
+        h, f = self.heads, self.out_dim
+        src, dst = g.senders, g.receivers
+
+        xl = nn.Dense(h * f, name="lin_l")(x).reshape(n, h, f)  # source transform
+        xr = nn.Dense(h * f, name="lin_r")(x).reshape(n, h, f)  # target transform
+        att = self.param("att", nn.initializers.lecun_normal(), (1, h, f))
+
+        def logits(s, t):
+            z = nn.leaky_relu(s + t, self.negative_slope)
+            return jnp.sum(z * att, axis=-1)  # [., h]
+
+        e_edge = logits(xl[src], xr[dst])  # [E, h]
+        e_self = logits(xl, xr)  # [N, h] self-loop logit per node
+
+        # softmax over {incident edges} U {self loop}, masked on padded edges
+        neg = -1e9
+        e_edge = jnp.where(g.edge_mask[:, None] > 0, e_edge, neg)
+        seg_max = jax.ops.segment_max(e_edge, dst, n)
+        seg_max = jnp.maximum(jnp.where(seg_max <= neg * 0.5, e_self, seg_max), e_self)
+        exp_edge = jnp.exp(e_edge - seg_max[dst]) * g.edge_mask[:, None]
+        exp_self = jnp.exp(e_self - seg_max)
+        denom = jax.ops.segment_sum(exp_edge, dst, n) + exp_self
+        alpha_edge = exp_edge / jnp.maximum(denom, 1e-16)[dst]
+        alpha_self = exp_self / jnp.maximum(denom, 1e-16)
+
+        if train and self.dropout > 0:
+            rng = self.make_rng("dropout")
+            keep = 1.0 - self.dropout
+            k1, k2 = jax.random.split(rng)
+            alpha_edge = (
+                alpha_edge
+                * jax.random.bernoulli(k1, keep, alpha_edge.shape).astype(x.dtype)
+                / keep
+            )
+            alpha_self = (
+                alpha_self
+                * jax.random.bernoulli(k2, keep, alpha_self.shape).astype(x.dtype)
+                / keep
+            )
+
+        out = jax.ops.segment_sum(alpha_edge[:, :, None] * xl[src], dst, n)
+        out = out + alpha_self[:, :, None] * xl  # [N, h, f]
+
+        if self.concat:
+            out = out.reshape(n, h * f)
+            bias = self.param("bias", nn.initializers.zeros, (h * f,))
+        else:
+            out = jnp.mean(out, axis=1)
+            bias = self.param("bias", nn.initializers.zeros, (f,))
+        return out + bias, pos
+
+
+class GATStack(Base):
+    def encoder_dims(self) -> List[Tuple[int, int, int]]:
+        # hidden layers concat heads -> hidden_dim*heads features; final
+        # layer averages heads -> hidden_dim (reference GATStack.py:35-46)
+        c = self.cfg
+        h = c.gat_heads
+        dims = [(c.input_dim, c.hidden_dim, c.hidden_dim * h)]
+        for _ in range(c.num_conv_layers - 2):
+            dims.append((c.hidden_dim * h, c.hidden_dim, c.hidden_dim * h))
+        dims.append((c.hidden_dim * h, c.hidden_dim, c.hidden_dim))
+        return dims
+
+    def node_conv_dims(self, head_dim):
+        # reference GATStack.py:48-89: hidden node convs concat heads
+        c = self.cfg
+        h = c.gat_heads
+        hdn = list(c.node_head.dim_headlayers)
+        hidden = [(c.hidden_dim, hdn[0], hdn[0] * h)]
+        for i in range(c.node_head.num_headlayers - 1):
+            hidden.append((hdn[i] * h, hdn[i + 1], hdn[i + 1] * h))
+        out = (hdn[-1] * h, head_dim, head_dim)
+        return hidden, out
+
+    def make_conv(self, name, in_dim, out_dim, last_layer):
+        c = self.cfg
+        return GATv2Conv(
+            out_dim,
+            heads=c.gat_heads,
+            negative_slope=c.gat_negative_slope,
+            concat=not last_layer,
+            dropout=c.dropout,
+            name=name,
+        )

@@ -1,0 +1,33 @@
+"""GIN stack (parity: reference hydragnn/models/GINStack.py).
+
+GINConv with a 2-layer MLP and a trainable eps initialized to 100.0
+(reference GINStack.py:26-34): out_i = MLP((1 + eps) x_i + sum_{j->i} x_j).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.models.base import Base
+
+
+class GINConv(nn.Module):
+    out_dim: int
+    eps_init: float = 100.0
+
+    @nn.compact
+    def __call__(self, x, pos, g, train):
+        eps = self.param("eps", lambda key: jnp.asarray(self.eps_init, jnp.float32))
+        agg = segment.segment_sum(x[g.senders], g.receivers, x.shape[0], g.edge_mask)
+        h = (1.0 + eps) * x + agg
+        h = nn.Dense(self.out_dim, name="mlp_0")(h)
+        h = nn.relu(h)
+        h = nn.Dense(self.out_dim, name="mlp_1")(h)
+        return h, pos
+
+
+class GINStack(Base):
+    def make_conv(self, name, in_dim, out_dim, last_layer):
+        return GINConv(out_dim, name=name)

@@ -1,0 +1,138 @@
+"""Shared neural building blocks: activations, losses, MLPs, masked BatchNorm.
+
+Parity targets:
+  - activation selector        -> reference hydragnn/utils/model.py:30-44
+  - loss selector              -> reference hydragnn/utils/model.py:47-55
+  - PyG BatchNorm under padding-> :class:`MaskedBatchNorm` (masked statistics;
+    with jit + sharding the batch statistics are computed over the *global*
+    sharded batch, which natively gives SyncBatchNorm semantics, reference
+    hydragnn/utils/distributed.py:238-239)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class PReLU(nn.Module):
+    """Learnable leaky-ReLU (torch.nn.PReLU parity: single shared slope 0.25)."""
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param("alpha", lambda key: jnp.asarray(0.25, jnp.float32))
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+def activation_module(name: str):
+    """Activation by config name (reference hydragnn/utils/model.py:30-44)."""
+    fns = {
+        "relu": nn.relu,
+        "selu": nn.selu,
+        "elu": nn.elu,
+        "lrelu_01": lambda x: nn.leaky_relu(x, 0.1),
+        "lrelu_025": lambda x: nn.leaky_relu(x, 0.25),
+        "lrelu_05": lambda x: nn.leaky_relu(x, 0.5),
+    }
+    if name == "prelu":
+        return PReLU()
+    if name not in fns:
+        raise ValueError(f"Unknown activation function: {name}")
+    return fns[name]
+
+
+def loss_function(name: str) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """Masked, mean-reduced loss (reference hydragnn/utils/model.py:47-55).
+
+    Signature: (pred, target, mask) -> scalar.  ``mask`` broadcasts along the
+    leading axis; the mean runs over valid elements only, so padded rows
+    reproduce the reference's unpadded loss exactly.
+    """
+
+    def _masked_mean(err, mask):
+        m = mask.reshape(mask.shape + (1,) * (err.ndim - mask.ndim))
+        denom = jnp.maximum(jnp.sum(m) * err.shape[-1], 1.0)
+        return jnp.sum(err * m) / denom
+
+    if name == "mse":
+        return lambda p, t, m: _masked_mean((p - t) ** 2, m)
+    if name == "mae":
+        return lambda p, t, m: _masked_mean(jnp.abs(p - t), m)
+    if name == "smooth_l1":
+
+        def _sl1(p, t, m):
+            d = jnp.abs(p - t)
+            return _masked_mean(jnp.where(d < 1.0, 0.5 * d * d, d - 0.5), m)
+
+        return _sl1
+    if name == "rmse":
+        return lambda p, t, m: jnp.sqrt(_masked_mean((p - t) ** 2, m) + 1e-16)
+    raise ValueError(f"Unknown loss function: {name}")
+
+
+class MLP(nn.Module):
+    """Dense stack: hidden layers with activation, linear output layer."""
+
+    features: Sequence[int]
+    activation: str = "relu"
+    final_activation: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        act = activation_module(self.activation)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1 or self.final_activation:
+                x = act(x)
+        return x
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm over valid (masked) rows with running statistics.
+
+    Equivalent to PyG ``BatchNorm`` (torch momentum 0.1, eps 1e-5) but exact
+    under padded static-shape batching: padded rows contribute nothing to the
+    batch statistics.  Under jit with a data-sharded batch the reductions are
+    global across devices — i.e. cross-replica (Sync) BatchNorm for free.
+    """
+
+    features: int
+    momentum: float = 0.1  # torch convention: new = (1-m)*old + m*batch
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, mask, use_running_average: bool = False):
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((self.features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((self.features,), jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            m = mask.astype(x.dtype)[:, None]
+            count = jnp.maximum(jnp.sum(m), 1.0)
+            mean = jnp.sum(x * m, axis=0) / count
+            var = jnp.sum(((x - mean) ** 2) * m, axis=0) / count
+            if not self.is_initializing():
+                # torch tracks the *unbiased* variance in running stats
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = (
+                    1.0 - self.momentum
+                ) * ra_mean.value + self.momentum * mean
+                ra_var.value = (
+                    1.0 - self.momentum
+                ) * ra_var.value + self.momentum * unbiased
+        return scale * (x - mean) * jax.lax.rsqrt(var + self.eps) + bias
+
+
+def shifted_softplus(x):
+    """softplus(x) - log(2): SchNet's activation (PyG ShiftedSoftplus)."""
+    return jax.nn.softplus(x) - jnp.log(2.0)

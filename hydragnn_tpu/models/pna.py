@@ -1,0 +1,79 @@
+"""PNA stack (parity: reference hydragnn/models/PNAStack.py).
+
+Principal Neighbourhood Aggregation with aggregators [mean, min, max, std]
+and scalers [identity, amplification, attenuation, linear]
+(reference PNAStack.py:28-34; towers=1, pre_layers=1, post_layers=1,
+divide_input=False as in PyG PNAConv).  The degree-scaler averages
+(avg log-degree / avg degree) are computed from the training-set degree
+histogram collected by the data layer (parity with gather_deg,
+reference hydragnn/preprocess/utils.py:177-195).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.models.base import Base
+
+
+class PNAConv(nn.Module):
+    out_dim: int
+    in_dim: int
+    avg_deg_log: float
+    avg_deg_lin: float
+    edge_dim: int = 0
+
+    @nn.compact
+    def __call__(self, x, pos, g, train):
+        n = x.shape[0]
+        f = self.in_dim
+        src, dst = g.senders, g.receivers
+
+        h_src, h_dst = x[src], x[dst]
+        if self.edge_dim:
+            e = nn.Dense(f, name="edge_encoder")(g.edge_attr)
+            z = jnp.concatenate([h_dst, h_src, e], axis=-1)
+        else:
+            z = jnp.concatenate([h_dst, h_src], axis=-1)
+        msg = nn.Dense(f, name="pre_nn")(z)  # pre_layers=1
+
+        aggs = [
+            segment.segment_mean(msg, dst, n, g.edge_mask),
+            segment.segment_min(msg, dst, n, g.edge_mask),
+            segment.segment_max(msg, dst, n, g.edge_mask),
+            segment.segment_std(msg, dst, n, g.edge_mask),
+        ]
+        agg = jnp.concatenate(aggs, axis=-1)  # [N, 4F]
+
+        deg = jnp.maximum(segment.degree(dst, n, g.edge_mask), 1.0)[:, None]
+        log_deg = jnp.log(deg + 1.0)
+        scaled = jnp.concatenate(
+            [
+                agg,
+                agg * (log_deg / self.avg_deg_log),
+                agg * (self.avg_deg_log / log_deg),
+                agg * (deg / jnp.maximum(self.avg_deg_lin, 1e-8)),
+            ],
+            axis=-1,
+        )  # [N, 16F]
+
+        out = jnp.concatenate([x, scaled], axis=-1)
+        out = nn.Dense(self.out_dim, name="post_nn")(out)  # post_layers=1
+        out = nn.Dense(self.out_dim, name="lin_out")(out)
+        return out, pos
+
+
+class PNAStack(Base):
+    def make_conv(self, name, in_dim, out_dim, last_layer):
+        c = self.cfg
+        assert c.pna_avg_deg_log is not None, "PNA requires degree input."
+        return PNAConv(
+            out_dim,
+            in_dim=in_dim,
+            avg_deg_log=max(c.pna_avg_deg_log, 1e-8),
+            avg_deg_lin=c.pna_avg_deg_lin,
+            edge_dim=c.edge_dim or 0,
+            name=name,
+        )

@@ -1,0 +1,32 @@
+"""GraphSAGE stack (parity: reference hydragnn/models/SAGEStack.py).
+
+SAGEConv semantics: out_i = W_self x_i + W_neigh mean_{j->i}(x_j).
+Expressed TPU-natively as a gather + masked segment mean + two dense layers
+(both lower to MXU matmuls under XLA).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.models.base import Base
+
+
+class SAGEConv(nn.Module):
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x, pos, g, train):
+        neigh = segment.segment_mean(
+            x[g.senders], g.receivers, x.shape[0], g.edge_mask
+        )
+        out = nn.Dense(self.out_dim, name="lin_self")(x) + nn.Dense(
+            self.out_dim, use_bias=False, name="lin_neigh"
+        )(neigh)
+        return out, pos
+
+
+class SAGEStack(Base):
+    def make_conv(self, name, in_dim, out_dim, last_layer):
+        return SAGEConv(out_dim, name=name)

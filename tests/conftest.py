@@ -1,0 +1,18 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh (the reference's analog is its
+# dual single-process / mpirun -n 2 CI; see SURVEY.md §4).
+#
+# XLA_FLAGS must be set before the CPU client is created; jax_platforms is
+# forced via config.update because the environment may pre-register a TPU
+# plugin at interpreter startup (sitecustomize), which locks JAX_PLATFORMS
+# before test code runs.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

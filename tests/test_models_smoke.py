@@ -1,0 +1,168 @@
+"""Forward-pass smoke matrix: all 9 model types x head configs.
+
+Mirrors the breadth of reference tests/test_graphs.py (which trains all
+9 x {single, multihead}); full accuracy training runs live in
+test_graphs.py here.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from hydragnn_tpu.graph import (
+    GraphSample,
+    HeadSpec,
+    PadSpec,
+    collate,
+    radius_graph,
+)
+from hydragnn_tpu.models.base import (
+    GraphHeadCfg,
+    ModelConfig,
+    NodeHeadCfg,
+    multihead_loss,
+)
+from hydragnn_tpu.models.create import create_model, init_model
+from hydragnn_tpu.models.dimenet import add_dimenet_extras
+
+ALL_MODELS = ["SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN", "SchNet", "DimeNet", "EGNN"]
+
+
+def make_samples(n_graphs=3, n_nodes=8, seed=0):
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n_graphs):
+        pos = rng.rand(n_nodes, 3) * 2.0
+        x = rng.rand(n_nodes, 1)
+        ei = radius_graph(pos, radius=1.5, max_neighbours=10)
+        node_y = np.concatenate([x, x**2, x**3], axis=1)
+        graph_y = np.array([node_y.sum()])
+        samples.append(
+            GraphSample(x=x, pos=pos, edge_index=ei, graph_y=graph_y, node_y=node_y)
+        )
+    return samples
+
+
+def make_cfg(model_type, multihead=False, edge_dim=None, equivariance=False,
+             node_head_type="mlp"):
+    if multihead:
+        output_dim = (1, 1, 1, 1)
+        output_type = ("graph", "node", "node", "node")
+        weights = (20.0, 1.0, 1.0, 1.0)
+    else:
+        output_dim = (1,)
+        output_type = ("graph",)
+        weights = (1.0,)
+    return ModelConfig(
+        model_type=model_type,
+        input_dim=1,
+        hidden_dim=1 if model_type == "CGCNN" else 8,
+        output_dim=output_dim,
+        output_type=output_type,
+        graph_head=GraphHeadCfg(2, 4, 2, (10, 10)),
+        node_head=NodeHeadCfg(2, (4, 4), node_head_type),
+        task_weights=weights,
+        num_conv_layers=2,
+        num_nodes=8,
+        edge_dim=edge_dim,
+        equivariance=equivariance,
+        pna_avg_deg_log=1.5,
+        pna_avg_deg_lin=4.0,
+        max_degree=10,
+        max_neighbours=10,
+        num_gaussians=10,
+        num_filters=16,
+        radius=1.5,
+        envelope_exponent=5,
+        num_before_skip=1,
+        num_after_skip=2,
+        num_radial=6,
+        num_spherical=7,
+        basis_emb_size=8,
+        int_emb_size=16,
+        out_emb_size=16,
+    )
+
+
+def build_batch(samples, head_specs, with_edge_lengths=False, dimenet=False):
+    if with_edge_lengths:
+        for s in samples:
+            d = s.pos[s.edge_index[0]] - s.pos[s.edge_index[1]]
+            s.edge_attr = np.linalg.norm(d, axis=1, keepdims=True)
+    pad = PadSpec.for_batch(len(samples), 8, 60)
+    batch = collate(samples, pad, head_specs)
+    if dimenet:
+        batch = add_dimenet_extras(batch, max_triplets=2048)
+    return batch
+
+
+@pytest.mark.parametrize("model_type", ALL_MODELS)
+@pytest.mark.parametrize("multihead", [False, True])
+def test_forward(model_type, multihead):
+    cfg = make_cfg(model_type, multihead)
+    specs = [
+        HeadSpec(n, t, d)
+        for n, t, d in zip(
+            ["g", "n1", "n2", "n3"], cfg.output_type, cfg.output_dim
+        )
+    ]
+    samples = make_samples()
+    batch = build_batch(samples, specs, dimenet=model_type == "DimeNet")
+    model = create_model(cfg)
+    variables = init_model(model, batch)
+    out = model.apply(
+        variables,
+        batch,
+        train=False,
+        mutable=False,
+    )
+    assert len(out) == len(cfg.output_dim)
+    for o, t in zip(out, cfg.output_type):
+        expect = batch.num_graphs if t == "graph" else batch.num_nodes
+        assert o.shape == (expect, 1)
+        assert np.all(np.isfinite(np.asarray(o)))
+    total, per_head = multihead_loss(cfg, out, batch)
+    assert np.isfinite(float(total))
+    assert len(per_head) == len(out)
+
+
+@pytest.mark.parametrize("model_type", ["PNA", "CGCNN", "SchNet", "EGNN"])
+def test_forward_edge_lengths(model_type):
+    cfg = make_cfg(model_type, edge_dim=1)
+    specs = [HeadSpec("g", "graph", 1)]
+    batch = build_batch(make_samples(), specs, with_edge_lengths=True)
+    model = create_model(cfg)
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False, mutable=False)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+@pytest.mark.parametrize("model_type", ["EGNN", "SchNet"])
+def test_forward_equivariant(model_type):
+    cfg = make_cfg(model_type, equivariance=True)
+    specs = [HeadSpec("g", "graph", 1)]
+    batch = build_batch(make_samples(), specs)
+    model = create_model(cfg)
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False, mutable=False)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+@pytest.mark.parametrize(
+    "model_type", ["SAGE", "GIN", "GAT", "MFC", "PNA", "SchNet", "DimeNet", "EGNN"]
+)
+def test_forward_conv_node_head(model_type):
+    cfg = make_cfg(model_type, multihead=True, node_head_type="conv")
+    specs = [
+        HeadSpec(n, t, d)
+        for n, t, d in zip(["g", "n1", "n2", "n3"], cfg.output_type, cfg.output_dim)
+    ]
+    batch = build_batch(make_samples(), specs, dimenet=model_type == "DimeNet")
+    model = create_model(cfg)
+    variables = init_model(model, batch)
+    rngs = {"dropout": jax.random.PRNGKey(0)}
+    out, _ = model.apply(
+        variables, batch, train=True, rngs=rngs, mutable=["batch_stats"]
+    )
+    for o in out:
+        assert np.all(np.isfinite(np.asarray(o)))
