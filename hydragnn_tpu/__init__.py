@@ -5,6 +5,8 @@ A ground-up JAX/XLA/pjit re-design with the capabilities of ORNL's HydraGNN
 for the reference blueprint and the per-module docstrings for parity notes.
 """
 
-from hydragnn_tpu import graph, config, models
+from hydragnn_tpu import graph, config, models, data, train, utils, parallel, postprocess
+from hydragnn_tpu.run_training import run_training
+from hydragnn_tpu.run_prediction import run_prediction
 
 __version__ = "0.1.0"

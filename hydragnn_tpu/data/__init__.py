@@ -1,0 +1,33 @@
+from hydragnn_tpu.data.abstract import AbstractBaseDataset
+from hydragnn_tpu.data.raw import (
+    AbstractRawDataset,
+    CFGDataset,
+    LSMSDataset,
+    RAW_FORMATS,
+    RawSample,
+    XYZDataset,
+    nsplit,
+    tensor_divide,
+)
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.data.transform import transform_raw_samples, select_feature_columns
+from hydragnn_tpu.data.splitting import (
+    compositional_stratified_splitting,
+    split_dataset,
+)
+from hydragnn_tpu.data.dataloader import (
+    GraphDataLoader,
+    create_dataloaders,
+    pad_spec_for,
+)
+from hydragnn_tpu.data.pickle_store import (
+    SerializedDataset,
+    SerializedWriter,
+    SimplePickleDataset,
+    SimplePickleWriter,
+)
+from hydragnn_tpu.data.load_data import (
+    dataset_loading_and_splitting,
+    load_serialized_splits,
+    transform_raw_data_to_serialized,
+)

@@ -1,0 +1,32 @@
+"""Dataset protocol (parity: reference hydragnn/utils/abstractbasedataset.py)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Iterator, List
+
+
+class AbstractBaseDataset(ABC):
+    """List-backed dataset with ``get``/``len`` — subclasses fill
+    ``self.dataset`` or override accessors."""
+
+    def __init__(self):
+        self.dataset: List[Any] = []
+
+    @abstractmethod
+    def get(self, idx: int) -> Any:
+        ...
+
+    @abstractmethod
+    def len(self) -> int:
+        ...
+
+    def __len__(self) -> int:
+        return self.len()
+
+    def __getitem__(self, idx: int) -> Any:
+        return self.get(idx)
+
+    def __iter__(self) -> Iterator[Any]:
+        for i in range(self.len()):
+            yield self.get(i)

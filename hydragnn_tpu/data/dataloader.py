@@ -1,0 +1,134 @@
+"""Host-side batching dataloader producing static-shape GraphBatches.
+
+Replaces torch ``DataLoader`` + ``DistributedSampler`` + PyG collation
+(reference hydragnn/preprocess/load_data.py:226-297): every batch is padded to
+one fixed :class:`PadSpec`, so the jit'd step compiles exactly once.  Sharding
+across data-parallel processes is strided over a per-epoch seeded permutation
+with wrap-around padding — DistributedSampler semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.graph.batch import (
+    GraphBatch,
+    GraphSample,
+    HeadSpec,
+    PadSpec,
+    collate,
+)
+
+
+class GraphDataLoader:
+    """Iterates padded GraphBatches over a list of host-side GraphSamples."""
+
+    def __init__(
+        self,
+        samples: Sequence[GraphSample],
+        head_specs: Sequence[HeadSpec],
+        batch_size: int,
+        pad_spec: Optional[PadSpec] = None,
+        shuffle: bool = False,
+        seed: int = 0,
+        graph_feature_slices: Optional[Sequence[Tuple[int, int]]] = None,
+        node_feature_slices: Optional[Sequence[Tuple[int, int]]] = None,
+        rank: int = 0,
+        world_size: int = 1,
+        drop_last: bool = False,
+    ):
+        self.samples = list(samples)
+        self.head_specs = list(head_specs)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.rank = rank
+        self.world_size = world_size
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.graph_feature_slices = graph_feature_slices
+        self.node_feature_slices = node_feature_slices
+        if pad_spec is None:
+            pad_spec = pad_spec_for(self.samples, self.batch_size)
+        self.pad_spec = pad_spec
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle (parity: DistributedSampler.set_epoch)."""
+        self.epoch = epoch
+
+    def _local_indices(self) -> np.ndarray:
+        n = len(self.samples)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        if self.world_size > 1:
+            # wrap-pad so every rank sees the same number of samples
+            total = int(math.ceil(n / self.world_size)) * self.world_size
+            order = np.concatenate([order, order[: total - n]])
+            order = order[self.rank :: self.world_size]
+        return order
+
+    def __len__(self) -> int:
+        n = len(self._local_indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return int(math.ceil(n / self.batch_size))
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        order = self._local_indices()
+        nb = len(self)
+        for b in range(nb):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            batch = [self.samples[i] for i in idx]
+            yield collate(
+                batch,
+                self.pad_spec,
+                self.head_specs,
+                self.graph_feature_slices,
+                self.node_feature_slices,
+            )
+
+
+def pad_spec_for(
+    samples: Sequence[GraphSample], batch_size: int, round_to: int = 8
+) -> PadSpec:
+    """Pad spec covering the worst-case batch of this dataset."""
+    max_nodes = max(s.num_nodes for s in samples)
+    max_edges = max(max(s.num_edges for s in samples), 1)
+    return PadSpec.for_batch(batch_size, max_nodes, max_edges, round_to)
+
+
+def create_dataloaders(
+    trainset: Sequence[GraphSample],
+    valset: Sequence[GraphSample],
+    testset: Sequence[GraphSample],
+    batch_size: int,
+    head_specs: Sequence[HeadSpec],
+    graph_feature_slices=None,
+    node_feature_slices=None,
+    rank: int = 0,
+    world_size: int = 1,
+    seed: int = 0,
+) -> Tuple["GraphDataLoader", "GraphDataLoader", "GraphDataLoader"]:
+    """Three loaders sharing one PadSpec (so train/val/test share the same
+    compiled executable).  Parity: reference create_dataloaders
+    (hydragnn/preprocess/load_data.py:226-297)."""
+    all_samples = list(trainset) + list(valset) + list(testset)
+    pad = pad_spec_for(all_samples, batch_size)
+    mk = lambda split, shuffle: GraphDataLoader(
+        split,
+        head_specs,
+        batch_size,
+        pad_spec=pad,
+        shuffle=shuffle,
+        seed=seed,
+        graph_feature_slices=graph_feature_slices,
+        node_feature_slices=node_feature_slices,
+        rank=rank,
+        world_size=world_size,
+    )
+    return mk(trainset, True), mk(valset, False), mk(testset, False)

@@ -1,0 +1,139 @@
+"""Top-level data orchestration: raw files -> split GraphSample loaders.
+
+Parity with reference hydragnn/preprocess/load_data.py:207-407
+(`dataset_loading_and_splitting` / `transform_raw_data_to_serialized` /
+`total_to_train_val_test_pkls` / `load_train_val_test_sets`), collapsed into
+explicit pure steps.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+from hydragnn_tpu.config.config import head_specs_from_config, label_slices_from_config
+from hydragnn_tpu.data.dataloader import GraphDataLoader, create_dataloaders
+from hydragnn_tpu.data.raw import RAW_FORMATS, RawSample
+from hydragnn_tpu.data.splitting import split_dataset
+from hydragnn_tpu.data.transform import transform_raw_samples
+from hydragnn_tpu.graph.batch import GraphSample
+
+
+def serialized_dir(config: Dict[str, Any]) -> str:
+    base = os.environ.get("SERIALIZED_DATA_PATH", os.getcwd())
+    return os.path.join(base, "serialized_dataset")
+
+
+def transform_raw_data_to_serialized(
+    config: Dict[str, Any], rank: int = 0, world_size: int = 1, dist: bool = False
+) -> None:
+    """Parse + normalize raw files and pickle them (reference
+    load_data.py:349-363 runs this on rank 0 only; here any rank may run it
+    over its shard when ``dist``)."""
+    fmt = config["Dataset"]["format"]
+    loader_cls = RAW_FORMATS.get(fmt)
+    if loader_cls is None:
+        raise ValueError(f"Unknown raw dataset format: {fmt}")
+    loader = loader_cls(config, dist=dist, rank=rank, world_size=world_size)
+    loader.load_raw_data()
+    loader.save_serialized(serialized_dir(config))
+
+
+def load_serialized_splits(
+    config: Dict[str, Any]
+) -> Tuple[List[RawSample], List[RawSample], List[RawSample]]:
+    """Load pickled RawSamples and produce train/val/test record lists."""
+    ds = config["Dataset"]
+    name = ds["name"]
+    sdir = serialized_dir(config)
+    paths = ds["path"]
+
+    def _read(label: str) -> List[RawSample]:
+        suffix = "" if label == "total" else f"_{label}"
+        with open(os.path.join(sdir, f"{name}{suffix}.pkl"), "rb") as f:
+            _minmax_node = pickle.load(f)
+            _minmax_graph = pickle.load(f)
+            return pickle.load(f)
+
+    if "total" in paths:
+        total = _read("total")
+        perc_train = config["NeuralNetwork"]["Training"]["perc_train"]
+        return split_dataset(
+            total,
+            perc_train,
+            ds.get("compositional_stratified_splitting", False),
+        )
+    return _read("train"), _read("validate"), _read("test")
+
+
+def dataset_loading_and_splitting(
+    config: Dict[str, Any],
+    rank: int = 0,
+    world_size: int = 1,
+    seed: int = 0,
+) -> Tuple[GraphDataLoader, GraphDataLoader, GraphDataLoader, Dict[str, Any]]:
+    """Raw -> serialized -> transformed -> three padded loaders, plus the
+    finalized config (reference load_data.py:207-223 + update_config; config
+    completion is explicit here instead of mutating after loader creation)."""
+    from hydragnn_tpu.config.config import DatasetStats, finalize
+
+    if rank == 0:
+        transform_raw_data_to_serialized(config)
+    if world_size > 1:
+        from hydragnn_tpu.parallel.comm import host_allreduce
+        import numpy as np
+
+        host_allreduce(np.zeros(1))  # barrier: wait for rank-0 serialization
+
+    train_r, val_r, test_r = load_serialized_splits(config)
+    trainset = transform_raw_samples(train_r, config)
+    valset = transform_raw_samples(val_r, config)
+    testset = transform_raw_samples(test_r, config)
+
+    need_deg = config["NeuralNetwork"]["Architecture"]["model_type"] == "PNA"
+    stats = DatasetStats.from_samples(
+        trainset + valset + testset, need_deg=need_deg)
+    if world_size > 1:
+        stats = _reduce_stats_across_hosts(stats)
+    config = finalize(config, stats)
+
+    head_specs = head_specs_from_config(config)
+    gslices, nslices = label_slices_from_config(config)
+    batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
+    train_l, val_l, test_l = create_dataloaders(
+        trainset,
+        valset,
+        testset,
+        batch_size,
+        head_specs,
+        graph_feature_slices=gslices,
+        node_feature_slices=nslices,
+        rank=rank,
+        world_size=world_size,
+        seed=seed,
+    )
+    return train_l, val_l, test_l, config
+
+
+def _reduce_stats_across_hosts(stats):
+    """Cross-host max/or-reduce of dataset statistics (parity with the
+    reference's all_reduce in check_if_graph_size_variable and gather_deg,
+    hydragnn/preprocess/utils.py:25-80,198-234)."""
+    import numpy as np
+
+    from hydragnn_tpu.parallel.comm import host_allgather, host_allreduce
+
+    stats.max_nodes = int(host_allreduce(
+        np.asarray([stats.max_nodes]), "max")[0])
+    stats.max_edges = int(host_allreduce(
+        np.asarray([stats.max_edges]), "max")[0])
+    stats.graph_size_variable = bool(host_allreduce(
+        np.asarray([float(stats.graph_size_variable)]), "max")[0] > 0)
+    if stats.pna_deg is not None:
+        local = np.asarray(stats.pna_deg, dtype=np.int64)
+        maxlen = int(host_allreduce(np.asarray([len(local)]), "max")[0])
+        padded = np.zeros(maxlen, dtype=np.int64)
+        padded[: len(local)] = local
+        stats.pna_deg = host_allreduce(padded, "sum").tolist()
+    return stats

@@ -1,0 +1,143 @@
+"""Pickle-backed dataset stores.
+
+Parity with the reference's two pickle paths:
+  - :class:`SimplePickleWriter`/`SimplePickleDataset` — meta file + one pickle
+    per sample, rank-offset file naming (reference
+    hydragnn/utils/pickledataset.py:15-184);
+  - :class:`SerializedWriter`/`SerializedDataset` — one pickle per
+    (rank, split) holding the whole shard (reference
+    hydragnn/utils/serializeddataset.py:1-87).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+from typing import Any, List, Optional, Sequence
+
+from hydragnn_tpu.data.abstract import AbstractBaseDataset
+
+
+class SimplePickleWriter:
+    """Write one pickle per sample with global contiguous numbering across
+    ranks (rank offsets from an allgather of local counts)."""
+
+    def __init__(
+        self,
+        samples: Sequence[Any],
+        basedir: str,
+        label: str = "total",
+        use_subdir: bool = False,
+        nmax_persubdir: int = 10000,
+        minmax_node_feature=None,
+        minmax_graph_feature=None,
+        rank: int = 0,
+        comm_counts: Optional[List[int]] = None,
+        attrs: Optional[dict] = None,
+    ):
+        dirname = os.path.join(basedir, label)
+        os.makedirs(dirname, exist_ok=True)
+        counts = comm_counts if comm_counts is not None else [len(samples)]
+        offset = sum(counts[:rank])
+        total = sum(counts)
+        if rank == 0:
+            meta = {
+                "total_ns": total,
+                "use_subdir": use_subdir,
+                "nmax_persubdir": nmax_persubdir,
+                "minmax_node_feature": minmax_node_feature,
+                "minmax_graph_feature": minmax_graph_feature,
+                "attrs": attrs or {},
+            }
+            with open(os.path.join(dirname, "meta.pkl"), "wb") as f:
+                pickle.dump(meta, f)
+        for i, s in enumerate(samples):
+            gid = offset + i
+            subdir = ""
+            if use_subdir:
+                subdir = str(gid // nmax_persubdir)
+                os.makedirs(os.path.join(dirname, subdir), exist_ok=True)
+            fname = os.path.join(dirname, subdir, f"{label}-{gid}.pkl")
+            with open(fname, "wb") as f:
+                pickle.dump(s, f)
+
+
+class SimplePickleDataset(AbstractBaseDataset):
+    """Read per-sample pickles; optional preload into RAM."""
+
+    def __init__(self, basedir: str, label: str = "total", preload: bool = True,
+                 subset: Optional[Sequence[int]] = None):
+        super().__init__()
+        self.dirname = os.path.join(basedir, label)
+        self.label = label
+        with open(os.path.join(self.dirname, "meta.pkl"), "rb") as f:
+            self.meta = pickle.load(f)
+        self.total_ns = int(self.meta["total_ns"])
+        self.use_subdir = bool(self.meta.get("use_subdir", False))
+        self.nmax_persubdir = int(self.meta.get("nmax_persubdir", 10000))
+        self.minmax_node_feature = self.meta.get("minmax_node_feature")
+        self.minmax_graph_feature = self.meta.get("minmax_graph_feature")
+        self.indices = list(subset) if subset is not None else list(range(self.total_ns))
+        self._cache = None
+        if preload:
+            self._cache = [self._read(i) for i in self.indices]
+
+    def _read(self, gid: int):
+        subdir = str(gid // self.nmax_persubdir) if self.use_subdir else ""
+        fname = os.path.join(self.dirname, subdir, f"{self.label}-{gid}.pkl")
+        with open(fname, "rb") as f:
+            return pickle.load(f)
+
+    def len(self) -> int:
+        return len(self.indices)
+
+    def get(self, idx: int):
+        if self._cache is not None:
+            return self._cache[idx]
+        return self._read(self.indices[idx])
+
+
+class SerializedWriter:
+    """One pickle per (rank, split) holding the full shard."""
+
+    def __init__(
+        self,
+        samples: Sequence[Any],
+        basedir: str,
+        name: str = "dataset",
+        label: str = "total",
+        rank: int = 0,
+        minmax_node_feature=None,
+        minmax_graph_feature=None,
+    ):
+        dirname = os.path.join(basedir, name)
+        os.makedirs(dirname, exist_ok=True)
+        with open(os.path.join(dirname, f"{label}-{rank}.pkl"), "wb") as f:
+            pickle.dump(minmax_node_feature, f)
+            pickle.dump(minmax_graph_feature, f)
+            pickle.dump(list(samples), f)
+
+
+class SerializedDataset(AbstractBaseDataset):
+    """Read every rank shard of a split."""
+
+    def __init__(self, basedir: str, name: str = "dataset", label: str = "total"):
+        super().__init__()
+        dirname = os.path.join(basedir, name)
+        self.minmax_node_feature = None
+        self.minmax_graph_feature = None
+        for fname in sorted(glob.glob(os.path.join(dirname, f"{label}-*.pkl"))):
+            with open(fname, "rb") as f:
+                self.minmax_node_feature = pickle.load(f)
+                self.minmax_graph_feature = pickle.load(f)
+                self.dataset.extend(pickle.load(f))
+        if not self.dataset:
+            raise FileNotFoundError(
+                f"No serialized shards for {label} under {dirname}")
+
+    def len(self) -> int:
+        return len(self.dataset)
+
+    def get(self, idx: int):
+        return self.dataset[idx]

@@ -1,0 +1,92 @@
+"""Dataset splitting: plain slicing or composition-stratified.
+
+Parity with reference hydragnn/preprocess/load_data.py:300-318 and
+hydragnn/preprocess/compositional_data_splitting.py:55-155.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def composition_category(x_col0: np.ndarray) -> Tuple:
+    """Category key = sorted (element, count) signature of the structure
+    (parity: compositional_data_splitting.py:55-71, which buckets by
+    per-element atom counts from the first node-feature column)."""
+    vals, counts = np.unique(np.asarray(x_col0).round(6), return_counts=True)
+    return tuple(zip(vals.tolist(), counts.tolist()))
+
+
+def compositional_stratified_splitting(
+    samples: Sequence, perc_train: float, seed: int = 0
+) -> Tuple[List, List, List]:
+    """Two-stage stratified split into train/val/test with val = test =
+    (1-perc_train)/2, stratified on composition categories.  Categories with
+    fewer than 2 members are duplicated so stratification is well defined
+    (parity: the reference's dedup-augmentation of singletons,
+    compositional_data_splitting.py:74-92)."""
+    samples = list(samples)
+    cats = [composition_category(_first_feature_column(s)) for s in samples]
+    uniq = {c: i for i, c in enumerate(sorted(set(cats)))}
+    labels = np.asarray([uniq[c] for c in cats])
+
+    # Duplicate singleton-category samples (the augmented copy is a reference
+    # to the same sample, as in the reference implementation).
+    counts = np.bincount(labels, minlength=len(uniq))
+    for ci in np.flatnonzero(counts == 1):
+        idx = int(np.flatnonzero(labels == ci)[0])
+        samples.append(samples[idx])
+        labels = np.append(labels, ci)
+
+    from sklearn.model_selection import StratifiedShuffleSplit
+
+    sss1 = StratifiedShuffleSplit(
+        n_splits=1, train_size=perc_train, random_state=seed)
+    train_idx, rest_idx = next(sss1.split(np.zeros(len(labels)), labels))
+    rest_labels = labels[rest_idx]
+    # a rest category can itself be a singleton; duplicate again
+    rest_idx = list(rest_idx)
+    rc = np.bincount(rest_labels, minlength=len(uniq))
+    for ci in np.flatnonzero(rc == 1):
+        j = int(np.flatnonzero(rest_labels == ci)[0])
+        rest_idx.append(rest_idx[j])
+        rest_labels = np.append(rest_labels, ci)
+    rest_idx = np.asarray(rest_idx)
+    sss2 = StratifiedShuffleSplit(n_splits=1, train_size=0.5, random_state=seed)
+    val_j, test_j = next(sss2.split(np.zeros(len(rest_idx)), rest_labels))
+    trainset = [samples[i] for i in train_idx]
+    valset = [samples[i] for i in rest_idx[val_j]]
+    testset = [samples[i] for i in rest_idx[test_j]]
+    return trainset, valset, testset
+
+
+def split_dataset(
+    dataset: Sequence,
+    perc_train: float,
+    stratify_splitting: bool = False,
+    seed: int = 0,
+) -> Tuple[List, List, List]:
+    """Parity with reference split_dataset (load_data.py:300-318): plain
+    contiguous slicing, or stratified when requested."""
+    if not stratify_splitting:
+        n = len(dataset)
+        perc_val = (1 - perc_train) / 2
+        n_train = int(perc_train * n)
+        n_val = int(perc_val * n)
+        data = list(dataset)
+        return (
+            data[:n_train],
+            data[n_train : n_train + n_val],
+            data[n_train + n_val :],
+        )
+    return compositional_stratified_splitting(dataset, perc_train, seed)
+
+
+def _first_feature_column(sample) -> np.ndarray:
+    x = getattr(sample, "node_y", None)
+    if x is None:
+        x = sample.x
+    x = np.asarray(x)
+    return x[:, 0] if x.ndim > 1 else x

@@ -1,0 +1,116 @@
+"""Deterministic synthetic BCC dataset — the framework's CI fixture.
+
+Behavioral parity with the reference fixture
+(reference tests/deterministic_graph_data.py:20-185): random BCC supercells
+whose targets are analytic functions of a KNN-smoothed random node feature,
+written as LSMS-format text files so the raw-loader path is exercised.
+
+File layout per configuration (LSMS text):
+  line 0:  GRAPH_OUTPUT [GRAPH_OUTPUT_LINEAR]
+  line i:  feature  index  x  y  z  out_x  out_x2  out_x3
+with
+  out_x  = KNN_mean_k(feature)        (k = number_neighbors)
+  out_x2 = out_x^2 + feature          (the LSMS charge-density fixup in the
+                                       loader subtracts the feature back)
+  out_x3 = out_x^3
+  GRAPH_OUTPUT = sum(out_x) + sum(out_x2) + sum(out_x3)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+
+def knn_mean(pos: np.ndarray, values: np.ndarray, k: int) -> np.ndarray:
+    """Mean of the k nearest neighbors' values per point — the same smoothing
+    the reference gets from sklearn's KNeighborsRegressor (a point is its own
+    nearest neighbor, so the node's value participates)."""
+    tree = cKDTree(pos)
+    _, idx = tree.query(pos, k=min(k, pos.shape[0]))
+    idx = np.atleast_2d(idx)
+    if idx.ndim == 1:
+        idx = idx[:, None]
+    return values[idx].mean(axis=1)
+
+
+def deterministic_graph_data(
+    path: str,
+    number_configurations: int = 500,
+    configuration_start: int = 0,
+    unit_cell_x_range: Sequence[int] = (1, 3),
+    unit_cell_y_range: Sequence[int] = (1, 3),
+    unit_cell_z_range: Sequence[int] = (1, 2),
+    number_types: int = 3,
+    types: Optional[Sequence[int]] = None,
+    number_neighbors: int = 2,
+    linear_only: bool = False,
+    seed: int = 0,
+) -> None:
+    """Write ``number_configurations`` LSMS text files under ``path``."""
+    if types is None:
+        types = list(range(number_types))
+    rng = np.random.RandomState(seed)
+    os.makedirs(path, exist_ok=True)
+    ucx = rng.randint(unit_cell_x_range[0], unit_cell_x_range[1],
+                      number_configurations)
+    ucy = rng.randint(unit_cell_y_range[0], unit_cell_y_range[1],
+                      number_configurations)
+    ucz = rng.randint(unit_cell_z_range[0], unit_cell_z_range[1],
+                      number_configurations)
+    for conf in range(number_configurations):
+        _write_configuration(
+            path, conf, configuration_start, int(ucx[conf]), int(ucy[conf]),
+            int(ucz[conf]), types, number_neighbors, linear_only, rng,
+        )
+
+
+def _write_configuration(
+    path: str,
+    configuration: int,
+    configuration_start: int,
+    uc_x: int,
+    uc_y: int,
+    uc_z: int,
+    types: Sequence[int],
+    number_neighbors: int,
+    linear_only: bool,
+    rng: np.random.RandomState,
+) -> None:
+    n = 2 * uc_x * uc_y * uc_z
+    pos = np.zeros((n, 3))
+    i = 0
+    for x in range(uc_x):
+        for y in range(uc_y):
+            for z in range(uc_z):
+                pos[i] = (x, y, z)
+                pos[i + 1] = (x + 0.5, y + 0.5, z + 0.5)
+                i += 2
+    feature = rng.randint(min(types), max(types) + 1, (n,)).astype(np.float64)
+
+    if linear_only:
+        out_x = feature
+    else:
+        out_x = knn_mean(pos, feature, number_neighbors)
+    out_x2 = out_x ** 2 + feature
+    out_x3 = out_x ** 3
+
+    if linear_only:
+        total = out_x.sum()
+        header = f"{total:.8f}"
+    else:
+        total = out_x.sum() + out_x2.sum() + out_x3.sum()
+        header = f"{total:.8f}\t{out_x.sum():.8f}"
+
+    lines = [header]
+    for j in range(n):
+        lines.append(
+            f"{feature[j]:.6f}\t{j}\t{pos[j,0]:.6f}\t{pos[j,1]:.6f}\t"
+            f"{pos[j,2]:.6f}\t{out_x[j]:.8f}\t{out_x2[j]:.8f}\t{out_x3[j]:.8f}"
+        )
+    fname = os.path.join(path, f"output{configuration + configuration_start}.txt")
+    with open(fname, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")

@@ -1,0 +1,123 @@
+"""Config-driven transformation: normalized RawSamples -> GraphSamples.
+
+The analog of the reference's SerializedDataLoader
+(reference hydragnn/preprocess/serialized_dataset_loader.py:103-241): apply
+optional rotation normalization, build the radius graph (PBC or open), compute
+edge lengths and normalize them by the *global* max over the dataset, then lay
+out per-sample label tables (``graph_y`` = all graph features, ``node_y`` =
+all node features) and select the input features into ``x``.  The per-head
+slices into those tables come from ``config.label_slices_from_config`` — the
+static replacement of the reference's runtime ``update_predicted_values`` /
+``y_loc`` bookkeeping (hydragnn/preprocess/utils.py:237-279).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.data.raw import RawSample
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.graph.neighborlist import (
+    edge_lengths,
+    normalize_rotation,
+    radius_graph,
+    radius_graph_pbc,
+)
+
+
+def select_feature_columns(
+    dims: Sequence[int], selected: Sequence[int]
+) -> List[int]:
+    """Column indices of the selected feature blocks (parity with reference
+    update_atom_features, hydragnn/preprocess/utils.py:282-293)."""
+    cols: List[int] = []
+    offsets = np.concatenate([[0], np.cumsum(dims)]).astype(int)
+    for i in selected:
+        cols.extend(range(offsets[i], offsets[i + 1]))
+    return cols
+
+
+def transform_raw_samples(
+    records: Sequence[RawSample],
+    config: Dict[str, Any],
+    world_max_edge_length: Optional[float] = None,
+) -> List[GraphSample]:
+    """Build GraphSamples per the config's Architecture + Variables sections.
+
+    ``world_max_edge_length`` lets multi-host callers pass the cross-host
+    max (parity with the reference's all_reduce(MAX) edge normalization,
+    serialized_dataset_loader.py:148-164); single-host callers leave it None
+    and the local max is used.
+    """
+    nn_sec = config["NeuralNetwork"]
+    arch = nn_sec["Architecture"]
+    var = nn_sec["Variables_of_interest"]
+    ds = config.get("Dataset", {})
+
+    radius = float(arch.get("radius") or 5.0)
+    max_neigh = int(arch.get("max_neighbours") or 100)
+    pbc = bool(arch.get("periodic_boundary_conditions", False))
+    rot = bool(ds.get("rotational_invariance", False))
+    edge_feature_names = arch.get("edge_features") or []
+
+    node_dims = [int(d) for d in ds.get("node_features", {}).get("dim", [])]
+    input_cols = (
+        select_feature_columns(node_dims, var["input_node_features"])
+        if node_dims
+        else list(var["input_node_features"])
+    )
+
+    built = []
+    max_len = 0.0
+    for rec in records:
+        pos = np.asarray(rec.pos, dtype=np.float64)
+        if rot:
+            pos = normalize_rotation(pos).astype(np.float64)
+        if pbc:
+            assert rec.cell is not None, "PBC requires a cell per sample"
+            edge_index, lengths = radius_graph_pbc(
+                pos, rec.cell, radius, max_neighbours=max_neigh)
+            lengths = lengths.reshape(-1, 1)
+        else:
+            edge_index = radius_graph(pos, radius, max_neighbours=max_neigh)
+            lengths = edge_lengths(pos, edge_index)
+        if lengths.size:
+            max_len = max(max_len, float(lengths.max()))
+        built.append((rec, pos, edge_index, lengths))
+
+    norm = world_max_edge_length if world_max_edge_length else max_len
+    norm = norm or 1.0
+
+    out: List[GraphSample] = []
+    for rec, pos, edge_index, lengths in built:
+        x_full = np.asarray(rec.x, dtype=np.float32)
+        edge_attr = (lengths / norm).astype(np.float32) if edge_feature_names else None
+        out.append(
+            GraphSample(
+                x=x_full[:, input_cols],
+                pos=pos.astype(np.float32),
+                edge_index=edge_index,
+                edge_attr=edge_attr,
+                graph_y=None if rec.y is None else np.asarray(rec.y, np.float32),
+                node_y=x_full,
+                cell=rec.cell,
+            )
+        )
+    return out
+
+
+def local_max_edge_length(
+    records: Sequence[RawSample], config: Dict[str, Any]
+) -> float:
+    """Max edge length over local records (input to a cross-host max)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    radius = float(arch.get("radius") or 5.0)
+    max_neigh = int(arch.get("max_neighbours") or 100)
+    m = 0.0
+    for rec in records:
+        ei = radius_graph(np.asarray(rec.pos, np.float64), radius, max_neigh)
+        if ei.shape[1]:
+            m = max(m, float(edge_lengths(np.asarray(rec.pos), ei).max()))
+    return m

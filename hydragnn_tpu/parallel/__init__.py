@@ -1,0 +1,8 @@
+from hydragnn_tpu.parallel.comm import (
+    allgather_counts,
+    host_allgather,
+    host_allreduce,
+    host_broadcast_scalar,
+    num_processes,
+    process_index,
+)

@@ -1,0 +1,73 @@
+"""Host-side collectives for dataset construction.
+
+The reference uses mpi4py (allreduce/allgather/bcast) for its data plane
+(reference hydragnn/preprocess/utils.py:25-80, utils/adiosdataset.py).  Here
+the data plane rides JAX's multi-host runtime: when
+``jax.distributed.initialize`` has run, host-side numpy reductions go through
+``jax.experimental.multihost_utils``; single-process runs short-circuit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def num_processes() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def host_allreduce(arr: np.ndarray, op: str = "sum") -> np.ndarray:
+    """All-reduce a small numpy array across hosts (min/max/sum)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(np.asarray(arr))
+    if op == "sum":
+        return np.sum(stacked, axis=0)
+    if op == "min":
+        return np.min(stacked, axis=0)
+    if op == "max":
+        return np.max(stacked, axis=0)
+    raise ValueError(f"unknown op {op}")
+
+
+def host_allgather(arr: np.ndarray) -> np.ndarray:
+    """Gather a numpy array from every host; returns stacked [n_hosts, ...]."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)[None]
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(np.asarray(arr))
+
+
+def host_broadcast_scalar(value: float, root: int = 0) -> float:
+    """Broadcast a host scalar from ``root`` (SLURM stop flags etc.)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray([value if jax.process_index() == root else 0.0])
+    return float(multihost_utils.broadcast_one_to_all(arr)[0])
+
+
+def allgather_counts(local_count: int) -> List[int]:
+    """Per-host counts (for rank-offset file naming, writer layouts)."""
+    out = host_allgather(np.asarray([local_count], dtype=np.int64))
+    return [int(c) for c in out.reshape(-1)]

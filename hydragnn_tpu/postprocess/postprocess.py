@@ -1,0 +1,23 @@
+"""Output denormalization (parity: reference hydragnn/postprocess/postprocess.py:13-54)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def output_denormalize(y_minmax: Sequence[Sequence[float]], true_values, predicted_values):
+    """Inverse the min-max normalization on per-head true/pred arrays."""
+    for ihead in range(len(true_values)):
+        ymin, ymax = float(y_minmax[ihead][0]), float(y_minmax[ihead][1])
+        true_values[ihead] = np.asarray(true_values[ihead]) * (ymax - ymin) + ymin
+        predicted_values[ihead] = (
+            np.asarray(predicted_values[ihead]) * (ymax - ymin) + ymin
+        )
+    return true_values, predicted_values
+
+
+def unscale_features_by_num_nodes(values: np.ndarray, num_nodes: np.ndarray) -> np.ndarray:
+    """Undo per-num-nodes feature scaling (reference postprocess.py:29-54)."""
+    return np.asarray(values) * np.asarray(num_nodes).reshape(-1, 1)

@@ -1,0 +1,75 @@
+"""JSON-config-driven prediction entry point.
+
+Parity: reference hydragnn/run_prediction.py:28-83 — rebuild data + model,
+load the checkpoint saved by run_training, evaluate the test split, optionally
+denormalize, and return (error, per-task error, true values, predictions).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any, Dict
+
+from hydragnn_tpu.config.config import get_log_name_config
+from hydragnn_tpu.data.load_data import dataset_loading_and_splitting
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    load_state,
+    make_eval_step,
+    test,
+)
+
+
+@functools.singledispatch
+def run_prediction(config, **kwargs):
+    raise TypeError("Input must be filename string or configuration dictionary.")
+
+
+@run_prediction.register
+def _(config_file: str, **kwargs):
+    with open(config_file, "r") as f:
+        config = json.load(f)
+    return run_prediction(config, **kwargs)
+
+
+@run_prediction.register
+def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
+    os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+
+    from hydragnn_tpu.parallel.comm import num_processes, process_index
+    import jax
+
+    world_size, rank = num_processes(), process_index()
+
+    train_loader, val_loader, test_loader, config = dataset_loading_and_splitting(
+        config, rank=rank, world_size=world_size, seed=seed)
+
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+    opt_spec = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    example = next(iter(test_loader))
+    state = create_train_state(model, example, opt_spec, seed=seed)
+    log_name = get_log_name_config(config)
+    state = load_state(state, log_name, logs_dir)
+
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    error, tasks_error, true_values, predicted_values = test(
+        eval_step, state, test_loader, cfg.num_heads,
+        world_size=world_size)
+
+    if config["NeuralNetwork"]["Variables_of_interest"].get(
+            "denormalize_output"):
+        from hydragnn_tpu.postprocess.postprocess import output_denormalize
+
+        true_values, predicted_values = output_denormalize(
+            config["NeuralNetwork"]["Variables_of_interest"]["y_minmax"],
+            true_values,
+            predicted_values,
+        )
+
+    return error, tasks_error, true_values, predicted_values

@@ -1,0 +1,105 @@
+"""JSON-config-driven training entry point.
+
+Parity: reference hydragnn/run_training.py:43-133 — accepts a config file path
+or dict (singledispatch), then: data loading/splitting -> config finalization
+-> model -> optimizer (+ plateau LR scheduler) -> train/validate/test loop ->
+rank-0 model save -> timer printout.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any, Dict, Tuple
+
+from hydragnn_tpu.config.config import get_log_name_config, save_config
+from hydragnn_tpu.data.load_data import dataset_loading_and_splitting
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    save_state,
+    train_validate_test,
+)
+from hydragnn_tpu.utils.print_utils import print_distributed, setup_log
+from hydragnn_tpu.utils import tracer as tr
+
+
+@functools.singledispatch
+def run_training(config, **kwargs):
+    raise TypeError("Input must be filename string or configuration dictionary.")
+
+
+@run_training.register
+def _(config_file: str, **kwargs):
+    with open(config_file, "r") as f:
+        config = json.load(f)
+    return run_training(config, **kwargs)
+
+
+@run_training.register
+def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
+    os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+
+    from hydragnn_tpu.parallel.comm import num_processes, process_index
+
+    world_size, rank = num_processes(), process_index()
+
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+    train_loader, val_loader, test_loader, config = dataset_loading_and_splitting(
+        config, rank=rank, world_size=world_size, seed=seed)
+
+    log_name = get_log_name_config(config)
+    setup_log(log_name, logs_dir)
+    save_config(config, log_name, logs_dir)
+
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    opt_spec = select_optimizer(
+        config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    example = next(iter(train_loader))
+    state = create_train_state(model, example, opt_spec, seed=seed)
+
+    # warm start (reference load_existing_model_config, utils/model.py:81-84)
+    training = config["NeuralNetwork"]["Training"]
+    if training.get("continue", 0):
+        from hydragnn_tpu.train.trainer import load_state
+
+        start_from = training.get("startfrom", log_name)
+        state = load_state(state, start_from, logs_dir)
+
+    writer = None
+    if rank == 0:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            writer = SummaryWriter(os.path.join(logs_dir, log_name))
+        except Exception:
+            writer = None
+
+    state, history = train_validate_test(
+        model,
+        cfg,
+        state,
+        opt_spec,
+        train_loader,
+        val_loader,
+        test_loader,
+        config["NeuralNetwork"],
+        log_name,
+        verbosity,
+        writer=writer,
+        rank=rank,
+        world_size=world_size,
+        logs_dir=logs_dir,
+    )
+
+    save_state(state, log_name, logs_dir, rank=rank)
+    tr.print_timers(verbosity)
+    if writer is not None:
+        writer.close()
+    return state, history, config

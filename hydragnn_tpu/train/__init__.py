@@ -1,0 +1,19 @@
+from hydragnn_tpu.train.optimizer import (
+    OptimizerSpec,
+    get_learning_rate,
+    select_optimizer,
+    set_learning_rate,
+)
+from hydragnn_tpu.train.trainer import (
+    CheckpointTracker,
+    EarlyStopping,
+    ReduceLROnPlateau,
+    TrainState,
+    create_train_state,
+    load_state,
+    make_eval_step,
+    make_train_step,
+    save_state,
+    test,
+    train_validate_test,
+)

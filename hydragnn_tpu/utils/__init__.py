@@ -1,0 +1,9 @@
+from hydragnn_tpu.utils.print_utils import (
+    iterate_tqdm,
+    log,
+    log0,
+    print_distributed,
+    print_master,
+    setup_log,
+)
+from hydragnn_tpu.utils import tracer

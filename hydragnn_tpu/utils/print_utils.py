@@ -1,0 +1,87 @@
+"""Verbosity-gated printing + run logging.
+
+Parity: reference hydragnn/utils/print_utils.py:29-111 (5 verbosity levels,
+rank-0 and per-rank variants, tqdm gating, file+console logging under
+./logs/<run>/run.log).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable
+
+_MAX_VERBOSITY_LEVELS = 5
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_nothing(*args, **kwargs):
+    pass
+
+
+def print_master(*args, **kwargs):
+    if _rank() == 0:
+        print(*args, **kwargs)
+
+
+def print_all_processes(*args, **kwargs):
+    print(f"[rank {_rank()}]", *args, **kwargs)
+
+
+def print_distributed(verbosity_level: int, *args, **kwargs):
+    """Levels 0: silent; 1-2: rank 0 only; 3-4: every rank (parity:
+    reference print_distributed dispatch, print_utils.py:29-53)."""
+    assert 0 <= verbosity_level < _MAX_VERBOSITY_LEVELS, "unknown verbosity"
+    if verbosity_level in (1, 2):
+        print_master(*args, **kwargs)
+    elif verbosity_level in (3, 4):
+        print_all_processes(*args, **kwargs)
+
+
+def iterate_tqdm(iterator: Iterable, verbosity_level: int, **kwargs):
+    """tqdm wrapping at verbosity 2/4 (reference print_utils.py:56-60)."""
+    if verbosity_level in (2, 4):
+        from tqdm import tqdm
+
+        return tqdm(iterator, **kwargs)
+    return iterator
+
+
+_logger_initialized = False
+
+
+def setup_log(log_name: str, logs_dir: str = "./logs/") -> None:
+    """File+console logging with rank prefix (reference print_utils.py:63-91)."""
+    global _logger_initialized
+    d = os.path.join(logs_dir, log_name)
+    os.makedirs(d, exist_ok=True)
+    fmt = logging.Formatter(
+        f"%(levelname)s (rank {_rank()}): %(message)s")
+    root = logging.getLogger("hydragnn_tpu")
+    root.setLevel(logging.INFO)
+    root.handlers.clear()
+    fh = logging.FileHandler(os.path.join(d, "run.log"))
+    fh.setFormatter(fmt)
+    root.addHandler(fh)
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    root.addHandler(sh)
+    _logger_initialized = True
+
+
+def log(*args):
+    logging.getLogger("hydragnn_tpu").info(" ".join(str(a) for a in args))
+
+
+def log0(*args):
+    if _rank() == 0:
+        log(*args)

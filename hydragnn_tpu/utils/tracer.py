@@ -1,0 +1,164 @@
+"""Pluggable region tracer (parity: reference hydragnn/utils/tracer.py:40-155).
+
+Module-level ``start``/``stop`` fan out to registered tracers.  The built-in
+tracers are :class:`TimerTracer` (cumulative wall-clock regions, the GPTL
+analog) and :class:`JaxProfilerTracer` (wraps regions in
+``jax.profiler.TraceAnnotation`` so they show in TensorBoard/Perfetto traces).
+A ``@profile`` decorator and ``timer`` contextmanager mirror the reference API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Dict, Optional
+
+_tracers: Dict[str, "Tracer"] = {}
+_enabled = True
+
+
+class Tracer:
+    def start(self, name: str):  # pragma: no cover - interface
+        ...
+
+    def stop(self, name: str):  # pragma: no cover - interface
+        ...
+
+    def reset(self):
+        ...
+
+
+class TimerTracer(Tracer):
+    """Named cumulative wall-clock regions (GPTL-style)."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._open: Dict[str, float] = {}
+
+    def start(self, name: str):
+        self._open[name] = time.perf_counter()
+
+    def stop(self, name: str):
+        t0 = self._open.pop(name, None)
+        if t0 is None:
+            return
+        self.totals[name] = self.totals.get(name, 0.0) + time.perf_counter() - t0
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+        self._open.clear()
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"total_s": v, "count": self.counts.get(k, 0)}
+            for k, v in sorted(self.totals.items())
+        }
+
+
+class JaxProfilerTracer(Tracer):
+    """Region names become jax.profiler trace annotations."""
+
+    def __init__(self):
+        self._open: Dict[str, object] = {}
+
+    def start(self, name: str):
+        import jax.profiler
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        self._open[name] = ann
+
+    def stop(self, name: str):
+        ann = self._open.pop(name, None)
+        if ann is not None:
+            ann.__exit__(None, None, None)
+
+
+def initialize(timer: bool = True, jax_annotations: bool = False) -> None:
+    _tracers.clear()
+    if timer:
+        _tracers["timer"] = TimerTracer()
+    if jax_annotations:
+        _tracers["jax"] = JaxProfilerTracer()
+
+
+def has(name: str) -> bool:
+    return name in _tracers
+
+
+def get(name: str) -> Optional[Tracer]:
+    return _tracers.get(name)
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def start(name: str):
+    if _enabled:
+        for t in _tracers.values():
+            t.start(name)
+
+
+def stop(name: str):
+    if _enabled:
+        for t in _tracers.values():
+            t.stop(name)
+
+
+def reset():
+    for t in _tracers.values():
+        t.reset()
+
+
+def profile(name: str):
+    """Decorator: trace the wrapped call (reference tracer.py:132-144)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            start(name)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                stop(name)
+
+        return wrapped
+
+    return deco
+
+
+@contextlib.contextmanager
+def timer(name: str):
+    start(name)
+    try:
+        yield
+    finally:
+        stop(name)
+
+
+def print_timers(verbosity: int = 0):
+    t = _tracers.get("timer")
+    if t is None:
+        return
+    from hydragnn_tpu.utils.print_utils import print_distributed
+
+    for name, s in t.summary().items():
+        print_distributed(
+            verbosity,
+            f"Timer {name}: total {s['total_s']:.4f}s over {int(s['count'])} calls",
+        )
+
+
+# default: timers on
+initialize()
