@@ -1,0 +1,101 @@
+"""Benchmark: training throughput of the flagship config on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The benchmarked step is the jit'd data-parallel train step of a QM9-scale
+SchNet energy model (BASELINE.md headline config) on synthetic padded batches
+— the same step function `run_training` uses.  The reference publishes no
+throughput numbers (see BASELINE.md), so ``vs_baseline`` is the ratio against
+a recorded reference-implementation measurement when available in
+``BASELINE.json["published"]``, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+
+    # QM9-scale: ~18 heavy+H atoms/graph, batch 128, hidden 64, 4 interactions
+    batch_size = 128
+    nodes_per_graph = 20
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(batch_size):
+        n = nodes_per_graph
+        pos = rng.rand(n, 3).astype(np.float32) * 4.0
+        x = rng.randint(0, 5, (n, 1)).astype(np.float32)
+        ei = radius_graph(pos, radius=1.8, max_neighbours=20)
+        samples.append(GraphSample(
+            x=x, pos=pos, edge_index=ei,
+            graph_y=rng.rand(1).astype(np.float32), node_y=x))
+    heads = [HeadSpec("energy", "graph", 1)]
+    pad = PadSpec.for_batch(batch_size, nodes_per_graph,
+                            max(s.num_edges for s in samples))
+    batch = collate(samples, pad, heads)
+
+    cfg = ModelConfig(
+        model_type="SchNet",
+        input_dim=1,
+        hidden_dim=64,
+        output_dim=(1,),
+        output_type=("graph",),
+        graph_head=GraphHeadCfg(2, 64, 2, (64, 64)),
+        node_head=None,
+        task_weights=(1.0,),
+        num_conv_layers=4,
+        num_gaussians=50,
+        num_filters=64,
+        radius=1.8,
+        max_neighbours=20,
+    )
+    model = create_model(cfg)
+    opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    state = create_train_state(model, batch, opt_spec)
+    step = jax.jit(make_train_step(model, cfg, opt_spec), donate_argnums=0)
+
+    batch = jax.device_put(batch)
+    # warmup + compile
+    state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+
+    n_iters = 50
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    graphs_per_sec = batch_size * n_iters / dt
+
+    published = {}
+    try:
+        with open("BASELINE.json") as f:
+            published = json.load(f).get("published", {}) or {}
+    except Exception:
+        pass
+    base = published.get("graphs_per_sec_per_chip")
+    vs_baseline = (graphs_per_sec / float(base)) if base else 1.0
+
+    print(json.dumps({
+        "metric": "qm9_schnet_train_throughput",
+        "value": round(graphs_per_sec, 2),
+        "unit": "graphs/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

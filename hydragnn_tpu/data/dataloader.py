@@ -39,6 +39,7 @@ class GraphDataLoader:
         rank: int = 0,
         world_size: int = 1,
         drop_last: bool = False,
+        post_collate=None,
     ):
         self.samples = list(samples)
         self.head_specs = list(head_specs)
@@ -51,6 +52,7 @@ class GraphDataLoader:
         self.epoch = 0
         self.graph_feature_slices = graph_feature_slices
         self.node_feature_slices = node_feature_slices
+        self.post_collate = post_collate
         if pad_spec is None:
             pad_spec = pad_spec_for(self.samples, self.batch_size)
         self.pad_spec = pad_spec
@@ -84,13 +86,16 @@ class GraphDataLoader:
         for b in range(nb):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
             batch = [self.samples[i] for i in idx]
-            yield collate(
+            out = collate(
                 batch,
                 self.pad_spec,
                 self.head_specs,
                 self.graph_feature_slices,
                 self.node_feature_slices,
             )
+            if self.post_collate is not None:
+                out = self.post_collate(out)
+            yield out
 
 
 def pad_spec_for(
@@ -113,6 +118,7 @@ def create_dataloaders(
     rank: int = 0,
     world_size: int = 1,
     seed: int = 0,
+    post_collate=None,
 ) -> Tuple["GraphDataLoader", "GraphDataLoader", "GraphDataLoader"]:
     """Three loaders sharing one PadSpec (so train/val/test share the same
     compiled executable).  Parity: reference create_dataloaders
@@ -130,5 +136,6 @@ def create_dataloaders(
         node_feature_slices=node_feature_slices,
         rank=rank,
         world_size=world_size,
+        post_collate=post_collate,
     )
     return mk(trainset, True), mk(valset, False), mk(testset, False)

@@ -101,6 +101,22 @@ def dataset_loading_and_splitting(
     head_specs = head_specs_from_config(config)
     gslices, nslices = label_slices_from_config(config)
     batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
+
+    # DimeNet consumes a static padded triplet table per batch (the TPU
+    # replacement of the reference's per-batch SparseTensor triplets,
+    # DIMEStack.py:158-182); size it from the worst-case sample.
+    post_collate = None
+    if config["NeuralNetwork"]["Architecture"]["model_type"] == "DimeNet":
+        from hydragnn_tpu.models.dimenet import add_dimenet_extras, count_triplets
+
+        max_per_sample = 1
+        for s in trainset + valset + testset:
+            if s.num_edges:
+                max_per_sample = max(
+                    max_per_sample, count_triplets(s.edge_index, s.num_nodes))
+        max_triplets = -(-(batch_size * max_per_sample + 1) // 8) * 8
+        post_collate = lambda b: add_dimenet_extras(b, max_triplets)
+
     train_l, val_l, test_l = create_dataloaders(
         trainset,
         valset,
@@ -112,6 +128,7 @@ def dataset_loading_and_splitting(
         rank=rank,
         world_size=world_size,
         seed=seed,
+        post_collate=post_collate,
     )
     return train_l, val_l, test_l, config
 

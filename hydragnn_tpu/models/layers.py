@@ -73,6 +73,39 @@ def loss_function(name: str) -> Callable[[jax.Array, jax.Array, jax.Array], jax.
     raise ValueError(f"Unknown loss function: {name}")
 
 
+def symmetric_uniform_init(bound: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+# torch.nn.Linear default init: kaiming_uniform(a=sqrt(5)) on the kernel
+# (== uniform(+-sqrt(1/fan_in))) and uniform(+-1/sqrt(fan_in)) bias.  The
+# reference relies on this spread-out init; zero-init biases make narrow ReLU
+# heads collapse to constants on some seeds.
+torch_kernel_init = nn.initializers.variance_scaling(
+    1.0 / 3.0, "fan_in", "uniform")
+
+
+class TDense(nn.Module):
+    """Dense layer with torch.nn.Linear's default initialization."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        import math
+
+        fan_in = x.shape[-1]
+        bound = 1.0 / math.sqrt(fan_in)
+        kernel = self.param(
+            "kernel", torch_kernel_init, (fan_in, self.features))
+        bias = self.param(
+            "bias", symmetric_uniform_init(bound), (self.features,))
+        return x @ kernel + bias
+
+
 class MLP(nn.Module):
     """Dense stack: hidden layers with activation, linear output layer."""
 
@@ -84,7 +117,7 @@ class MLP(nn.Module):
     def __call__(self, x):
         act = activation_module(self.activation)
         for i, f in enumerate(self.features):
-            x = nn.Dense(f, name=f"dense_{i}")(x)
+            x = TDense(f, name=f"dense_{i}")(x)
             if i < len(self.features) - 1 or self.final_activation:
                 x = act(x)
         return x

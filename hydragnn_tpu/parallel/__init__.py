@@ -1,3 +1,13 @@
+from hydragnn_tpu.parallel.mesh import (
+    DATA_AXIS,
+    DeviceStackLoader,
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_mesh,
+    replicate_state,
+    setup_distributed,
+    stack_batches,
+)
 from hydragnn_tpu.parallel.comm import (
     allgather_counts,
     host_allgather,

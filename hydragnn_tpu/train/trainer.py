@@ -93,14 +93,16 @@ def _loss_and_metrics(
     train: bool,
     energy_head: int = -1,
     forces_head: int = -1,
+    dropout_rng: Optional[jax.Array] = None,
 ):
     """Forward + weighted loss (+ self-consistency term); returns
     (loss, (per_head, new_batch_stats, outputs))."""
     variables = {"params": params, "batch_stats": batch_stats}
-    if train and batch_stats:
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    if train:
         outputs, mutated = model.apply(
-            variables, g, train=True, mutable=["batch_stats"])
-        new_stats = mutated["batch_stats"]
+            variables, g, train=True, mutable=["batch_stats"], rngs=rngs)
+        new_stats = mutated.get("batch_stats", batch_stats)
     else:
         outputs = model.apply(variables, g, train=False)
         new_stats = batch_stats
@@ -143,10 +145,12 @@ def make_train_step(
     energy_head, forces_head = _force_head_indices(output_names)
 
     def train_step(state: TrainState, g: GraphBatch):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0xD0), state.step)
+
         def loss_fn(params):
             return _loss_and_metrics(
                 model, cfg, params, state.batch_stats, g, True,
-                energy_head, forces_head)
+                energy_head, forces_head, dropout_rng)
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)

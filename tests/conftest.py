@@ -13,6 +13,28 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _scratch_cwd(tmp_path_factory):
+    """Run the whole session from a scratch dir so dataset/, logs/ and
+    serialized_dataset/ artifacts never land in the repo.  Dataset files are
+    cached across test runs in /tmp to keep reruns fast."""
+    scratch = os.environ.get("HYDRAGNN_TEST_SCRATCH", "/tmp/hydragnn_tpu_tests")
+    os.makedirs(scratch, exist_ok=True)
+    old = os.getcwd()
+    os.chdir(scratch)
+    os.environ["SERIALIZED_DATA_PATH"] = scratch
+    yield scratch
+    os.chdir(old)
