@@ -99,7 +99,7 @@ def make_dp_train_step(
     all-reduce parity, reference train_validate_test.py:496).
     """
     import optax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     energy_head, forces_head = _force_head_indices(output_names)
 
@@ -145,7 +145,7 @@ def make_dp_train_step(
         mesh=mesh,
         in_specs=(P(), P(axis)),
         out_specs=(P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=0)
 
@@ -157,7 +157,7 @@ def make_dp_eval_step(
     axis: str = DATA_AXIS,
 ):
     """jit'd DP eval step over stacked batches [D, ...]."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     def per_device(state: TrainState, g: GraphBatch):
         g = jax.tree.map(lambda x: x[0], g)
@@ -185,7 +185,7 @@ def make_dp_eval_step(
             "per_head": P(),
             "outputs": P(axis),
         },
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded)
 

@@ -1,0 +1,123 @@
+"""LSMS total-energy -> formation Gibbs energy dataset conversion.
+
+Parity: reference utils/lsms/convert_total_energy_to_formation_gibbs.py:30-183
+(binary alloys only): find the two pure-element configurations, compute each
+sample's linear-mixing energy from the pure energies, subtract to get the
+formation enthalpy, subtract T*S (ideal mixing entropy in Rydberg units) and
+rewrite the header energy into a ``<dir>_gibbs_energy`` copy of the dataset.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+from scipy import special
+
+# LSMS units (Rydberg)
+_KB_JOULE_PER_KELVIN = 1.380649e-23
+_JOULE_TO_RYDBERG = 4.5874208973812e17
+_KB_RYDBERG_PER_KELVIN = _KB_JOULE_PER_KELVIN * _JOULE_TO_RYDBERG
+
+
+def _read_file(path: str) -> Tuple[str, List[str]]:
+    with open(path, "r") as f:
+        lines = f.readlines()
+    return lines[0].split()[0], lines
+
+
+def compute_formation_enthalpy(
+    elements_list: Sequence[float],
+    pure_elements_energy: Dict[float, float],
+    total_energy: float,
+    atoms: np.ndarray,
+) -> Tuple[float, float, float, float]:
+    """(composition, linear_mixing_energy, formation_enthalpy, entropy)."""
+    elements, counts = np.unique(atoms[:, 0], return_counts=True)
+    for e in elements:
+        assert e in elements_list, (
+            f"Sample contains element {e} not present in the binary considered.")
+    for pos, elem in enumerate(elements_list):
+        if elem not in elements:
+            elements = np.insert(elements, pos, elem)
+            counts = np.insert(counts, pos, 0)
+    num_atoms = atoms.shape[0]
+    composition = counts[0] / num_atoms
+    linear_mixing_energy = (
+        pure_elements_energy[elements[0]] * composition
+        + pure_elements_energy[elements[1]] * (1 - composition)
+    ) * num_atoms
+    formation_enthalpy = total_energy - linear_mixing_energy
+    entropy = _KB_RYDBERG_PER_KELVIN * math.log(
+        special.comb(num_atoms, counts[0]))
+    return composition, linear_mixing_energy, formation_enthalpy, entropy
+
+
+def convert_raw_data_energy_to_gibbs(
+    dir: str,
+    elements_list: Sequence[float],
+    temperature_kelvin: float = 0.0,
+    overwrite_data: bool = False,
+    create_plots: bool = True,
+) -> None:
+    """Rewrite every LSMS file's header energy with the formation Gibbs
+    energy into ``<dir>_gibbs_energy/`` (binary alloys only)."""
+    dir = dir.rstrip("/")
+    new_dir = dir + "_gibbs_energy/"
+    if os.path.exists(new_dir) and overwrite_data:
+        shutil.rmtree(new_dir)
+    os.makedirs(new_dir, exist_ok=True)
+
+    elements_list = sorted(elements_list)
+    pure_elements_energy: Dict[float, float] = {}
+    all_files = sorted(os.listdir(dir))
+    for fname in all_files:
+        total_energy_txt, lines = _read_file(os.path.join(dir, fname))
+        atoms = np.loadtxt(lines[1:])
+        atoms = np.atleast_2d(atoms)
+        pure = np.unique(atoms[:, 0])
+        if len(pure) == 1:
+            pure_elements_energy[pure[0]] = (
+                float(total_energy_txt) / atoms.shape[0])
+    assert len(pure_elements_energy) == 2, "Must have two single element files."
+
+    comp_l, h_l, g_l, te_l, lme_l = [], [], [], [], []
+    for fname in all_files:
+        path = os.path.join(dir, fname)
+        total_energy_txt, lines = _read_file(path)
+        atoms = np.atleast_2d(np.loadtxt(lines[1:]))
+        comp, lme, enthalpy, entropy = compute_formation_enthalpy(
+            elements_list, pure_elements_energy, float(total_energy_txt), atoms)
+        gibbs = enthalpy - temperature_kelvin * entropy
+        comp_l.append(comp)
+        h_l.append(enthalpy)
+        g_l.append(gibbs)
+        te_l.append(float(total_energy_txt))
+        lme_l.append(lme)
+        lines[0] = lines[0].replace(total_energy_txt, str(gibbs))
+        with open(os.path.join(new_dir, fname), "w") as f:
+            f.write("".join(lines))
+
+    if create_plots:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        for fig, (xs, ys, xl, yl, out) in enumerate([
+            (te_l, lme_l, "Total energy (Rydberg)",
+             "Linear mixing energy (Rydberg)", "linear_mixing_energy.png"),
+            (comp_l, h_l, "Concentration",
+             "Formation enthalpy (Rydberg)", "formation_enthalpy.png"),
+            (comp_l, g_l, "Concentration",
+             "Formation Gibbs energy (Rydberg)", "formation_gibbs_energy.png"),
+        ]):
+            plt.figure(fig)
+            plt.scatter(xs, ys, edgecolor="b", facecolor="none")
+            plt.xlabel(xl)
+            plt.ylabel(yl)
+            plt.savefig(out)
+            plt.close()

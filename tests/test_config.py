@@ -1,0 +1,102 @@
+"""Config-schema checks (parity: reference tests/test_config.py:16-40 checks
+required keys; plus finalize() inference unit checks)."""
+
+import json
+import os
+
+import numpy as np
+
+from hydragnn_tpu.config.config import (
+    DatasetStats,
+    finalize,
+    get_log_name_config,
+    head_specs_from_config,
+    label_slices_from_config,
+)
+
+_REQUIRED_TOP = ["Verbosity", "Dataset", "NeuralNetwork"]
+_REQUIRED_NN = ["Architecture", "Variables_of_interest", "Training"]
+_REQUIRED_ARCH = ["model_type", "hidden_dim", "num_conv_layers", "output_heads"]
+_REQUIRED_TRAINING = ["num_epoch", "batch_size", "Optimizer", "perc_train"]
+
+
+def _load(name):
+    with open(os.path.join(os.path.dirname(__file__), "inputs", name)) as f:
+        return json.load(f)
+
+
+def test_required_keys_present():
+    for fname in ["ci.json", "ci_multihead.json", "ci_equivariant.json",
+                  "ci_vectoroutput.json", "ci_conv_head.json"]:
+        config = _load(fname)
+        for k in _REQUIRED_TOP:
+            assert k in config, f"{fname} missing {k}"
+        for k in _REQUIRED_NN:
+            assert k in config["NeuralNetwork"], f"{fname} missing {k}"
+        for k in _REQUIRED_ARCH:
+            assert k in config["NeuralNetwork"]["Architecture"]
+        for k in _REQUIRED_TRAINING:
+            assert k in config["NeuralNetwork"]["Training"]
+
+
+def test_finalize_inference():
+    config = _load("ci_multihead.json")
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+    stats = DatasetStats(
+        num_nodes_sample=8, graph_size_variable=True, max_nodes=8, max_edges=48)
+    out = finalize(config, stats)
+    arch = out["NeuralNetwork"]["Architecture"]
+    assert arch["output_dim"] == [1, 1, 1, 1]
+    assert arch["output_type"] == ["graph", "node", "node", "node"]
+    assert arch["input_dim"] == 1
+    assert arch["edge_dim"] is None
+    # original config untouched (finalize is pure)
+    assert "output_dim" not in config["NeuralNetwork"]["Architecture"]
+
+
+def test_finalize_pna_requires_deg():
+    import pytest
+
+    config = _load("ci.json")
+    stats = DatasetStats(num_nodes_sample=8, graph_size_variable=True)
+    with pytest.raises(AssertionError):
+        finalize(config, stats)  # PNA without degree histogram
+    stats = DatasetStats(
+        num_nodes_sample=8, graph_size_variable=True, pna_deg=[0, 4, 10, 2])
+    out = finalize(config, stats)
+    assert out["NeuralNetwork"]["Architecture"]["pna_deg"] == [0, 4, 10, 2]
+    assert out["NeuralNetwork"]["Architecture"]["max_neighbours"] == 3
+
+
+def test_edge_features_validation():
+    import pytest
+
+    config = _load("ci.json")
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "GIN"
+    config["NeuralNetwork"]["Architecture"]["edge_features"] = ["lengths"]
+    stats = DatasetStats(num_nodes_sample=8, graph_size_variable=True)
+    with pytest.raises(AssertionError):
+        finalize(config, stats)
+
+
+def test_label_slices():
+    config = _load("ci_vectoroutput.json")
+    gs, ns = label_slices_from_config(config)
+    # graph dims [1,2,1]; node dims [2,1,2]
+    assert gs[1] == (0, 1)   # "sum" -> graph feature 0
+    assert gs[2] == (1, 3)   # "sums_vec" -> graph feature 1
+    assert gs[3] == (3, 4)   # "sum_linear" -> graph feature 2
+    assert ns[0] == (3, 5)   # "x2x3_vec" -> node feature 2
+    assert ns[4] == (2, 3)   # "x" -> node feature 1
+    assert ns[5] == (0, 2)   # "xx2_vec" -> node feature 0
+
+
+def test_log_name_and_head_specs():
+    config = _load("ci.json")
+    stats = DatasetStats(
+        num_nodes_sample=8, graph_size_variable=True, pna_deg=[0, 4])
+    out = finalize(config, stats)
+    name = get_log_name_config(out)
+    assert "PNA" in name and "hd-8" in name
+    specs = head_specs_from_config(out)
+    assert len(specs) == 1 and specs[0].type == "graph" and specs[0].dim == 1

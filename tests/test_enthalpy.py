@@ -1,0 +1,33 @@
+"""Formation-enthalpy conversion yields exactly 0 for linear data (parity:
+reference tests/test_enthalpy.py:15-59)."""
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.utils.lsms import convert_raw_data_energy_to_gibbs
+
+
+def test_formation_enthalpy():
+    d = "dataset/unit_test_enthalpy"
+    os.makedirs(d, exist_ok=True)
+    num_config = 10
+    if not os.listdir(d):
+        # random binary samples with linear (composition-proportional) energy
+        deterministic_graph_data(
+            d, num_config, number_types=2, linear_only=True, seed=11)
+        # two pure-component configurations
+        deterministic_graph_data(
+            d, number_configurations=1, configuration_start=num_config,
+            number_types=1, types=[0], linear_only=True, seed=12)
+        deterministic_graph_data(
+            d, number_configurations=1, configuration_start=num_config + 1,
+            number_types=1, types=[1], linear_only=True, seed=13)
+
+    convert_raw_data_energy_to_gibbs(d, [0, 1], create_plots=False)
+
+    new_dir = d + "_gibbs_energy"
+    for fname in os.listdir(new_dir):
+        enthalpy = np.loadtxt(os.path.join(new_dir, fname), max_rows=1)
+        assert enthalpy == 0

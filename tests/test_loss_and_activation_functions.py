@@ -1,0 +1,24 @@
+"""Smoke matrix over activation x loss types (parity: reference
+tests/test_loss_and_activation_functions.py:20-23, interface-only)."""
+
+import json
+import os
+
+import pytest
+
+import hydragnn_tpu
+from test_graphs import _generate_data
+
+
+@pytest.mark.parametrize(
+    "activation", ["relu", "selu", "prelu", "elu", "lrelu_025"])
+@pytest.mark.parametrize("loss", ["mse", "mae", "smooth_l1", "rmse"])
+def test_loss_and_activation_functions(activation, loss):
+    with open(os.path.join(os.path.dirname(__file__), "inputs", "ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+    config["NeuralNetwork"]["Architecture"]["activation_function"] = activation
+    config["NeuralNetwork"]["Training"]["loss_function_type"] = loss
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    _generate_data(config, num_samples_tot=60)
+    hydragnn_tpu.run_training(config)

@@ -1,0 +1,33 @@
+"""Smoke matrix over optimizer types (parity: reference
+tests/test_optimizer.py:21-23)."""
+
+import json
+import os
+
+import pytest
+
+import hydragnn_tpu
+from test_graphs import _generate_data
+
+OPTIMIZERS = ["SGD", "Adam", "Adadelta", "Adagrad", "Adamax", "AdamW",
+              "RMSprop", "FusedLAMB"]
+
+
+@pytest.mark.parametrize("opt_type", OPTIMIZERS)
+@pytest.mark.parametrize("use_zero", [False, True])
+def test_optimizers(opt_type, use_zero):
+    with open(os.path.join(os.path.dirname(__file__), "inputs", "ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    config["NeuralNetwork"]["Training"]["Optimizer"]["type"] = opt_type
+    config["NeuralNetwork"]["Training"]["Optimizer"]["use_zero_redundancy"] = use_zero
+    _generate_data(config, num_samples_tot=60)
+    hydragnn_tpu.run_training(config)
+
+
+def test_unknown_optimizer_raises():
+    from hydragnn_tpu.train.optimizer import select_optimizer
+
+    with pytest.raises(NameError):
+        select_optimizer({"type": "NotAnOptimizer", "learning_rate": 1e-3})

@@ -1,0 +1,72 @@
+"""PBC radius-graph unit tests (parity: reference
+tests/test_periodic_boundary_conditions.py:25-123): exact neighbor counts on
+an H2 molecule and a bulk-BCC Cr supercell, with and without self loops,
+positions untouched."""
+
+import numpy as np
+
+from hydragnn_tpu.graph.neighborlist import radius_graph, radius_graph_pbc
+
+
+def _check_pbc(pos, cell, radius, expected_neighbors,
+               expected_neighbors_self_loops):
+    n = pos.shape[0]
+    ei_no_loop, lengths = radius_graph_pbc(
+        pos, cell, radius, max_neighbours=100000, loop=False)
+    ei_loop, _ = radius_graph_pbc(
+        pos, cell, radius, max_neighbours=100000, loop=True)
+
+    assert ei_no_loop.shape[1] == expected_neighbors * n
+    assert ei_loop.shape[1] == expected_neighbors_self_loops * n
+    # all edge lengths within the radius
+    assert (lengths <= radius + 1e-9).all()
+
+
+def test_periodic_h2():
+    # H2 in a 3x3x3 cell: 1 bond/atom without loops, +1 self edge with loops
+    cell = np.eye(3) * 3.0
+    pos = np.array([[1.0, 1.0, 1.0], [1.43, 1.43, 1.43]])
+    _check_pbc(pos, cell, radius=0.9, expected_neighbors=1,
+               expected_neighbors_self_loops=2)
+
+
+def test_periodic_bcc_large():
+    # 5x5x5 orthorhombic BCC Cr supercell (a=3.6): radius 5.0 captures the
+    # first (8 at a*sqrt(3)/2) and second (6 at a) neighbor shells = 14.
+    a = 3.6
+    reps = 5
+    base = np.array([[0.0, 0.0, 0.0], [0.5, 0.5, 0.5]]) * a
+    pos = []
+    for i in range(reps):
+        for j in range(reps):
+            for k in range(reps):
+                pos.append(base + np.array([i, j, k]) * a)
+    pos = np.concatenate(pos, axis=0)
+    cell = np.eye(3) * (a * reps)
+    _check_pbc(pos, cell, radius=5.0, expected_neighbors=14,
+               expected_neighbors_self_loops=15)
+
+
+def test_pbc_duplicate_edge_rejection():
+    # A cell small enough that an atom pair connects both directly and
+    # through an image must raise (parity: reference RadiusGraphPBC assert,
+    # hydragnn/preprocess/utils.py:160-171).
+    cell = np.eye(3) * 1.0
+    pos = np.array([[0.0, 0.0, 0.0], [0.5, 0.5, 0.5]])
+    import pytest
+
+    with pytest.raises(ValueError, match="duplicate"):
+        radius_graph_pbc(pos, cell, radius=1.2, loop=False)
+
+
+def test_open_vs_pbc_small_radius():
+    # With a radius smaller than any image distance, PBC and open-boundary
+    # graphs coincide.
+    rng = np.random.RandomState(0)
+    pos = rng.rand(8, 3) * 2.0 + 4.0
+    cell = np.eye(3) * 10.0
+    ei_open = radius_graph(pos, radius=1.5, max_neighbours=100)
+    ei_pbc, _ = radius_graph_pbc(pos, cell, radius=1.5, loop=False)
+    open_set = set(zip(ei_open[0].tolist(), ei_open[1].tolist()))
+    pbc_set = set(zip(ei_pbc[0].tolist(), ei_pbc[1].tolist()))
+    assert open_set == pbc_set

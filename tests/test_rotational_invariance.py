@@ -1,0 +1,70 @@
+"""Rotation-normalization invariance (parity: reference
+tests/test_rotational_invariance.py:15-110): the radius graph + edge lengths
+of a structure and its rotation-normalized copy are equivalent edge sets."""
+
+import numpy as np
+
+from hydragnn_tpu.graph.neighborlist import (
+    edge_lengths,
+    normalize_rotation,
+    radius_graph,
+)
+
+
+def _edge_set_equivalent(ei1, len1, ei2, len2, tol):
+    """Order-independent edge-set comparison with length tolerance (parity:
+    reference check_data_samples_equivalence, preprocess/utils.py:83-99)."""
+    if ei1.shape != ei2.shape:
+        return False
+    m2 = {}
+    for j in range(ei2.shape[1]):
+        m2[(int(ei2[0, j]), int(ei2[1, j]))] = float(len2[j, 0])
+    for i in range(ei1.shape[1]):
+        key = (int(ei1[0, i]), int(ei1[1, i]))
+        if key not in m2:
+            return False
+        if abs(m2[key] - float(len1[i, 0])) >= tol:
+            return False
+    return True
+
+
+def _check(pos, radius, tol=1e-5):
+    ei = radius_graph(pos, radius, max_neighbours=100)
+    lens = edge_lengths(pos, ei)
+    pos_rot = normalize_rotation(pos)
+    ei_rot = radius_graph(pos_rot, radius, max_neighbours=100)
+    lens_rot = edge_lengths(pos_rot, ei_rot)
+    assert _edge_set_equivalent(ei, lens, ei_rot, lens_rot, tol)
+
+
+def _bct_sample():
+    uc_x, uc_y, uc_z = 4, 2, 2
+    lxy, lz = 5.218, 7.058
+    pos = []
+    for x in range(uc_x):
+        for y in range(uc_y):
+            for z in range(uc_z):
+                pos.append([x * lxy, y * lxy, z * lz])
+                pos.append([(x + 0.5) * lxy, (y + 0.5) * lxy, (z + 0.5) * lz])
+    return np.asarray(pos)
+
+
+def test_rotational_invariance_bct():
+    _check(_bct_sample(), radius=7.0)
+
+
+def test_rotational_invariance_random():
+    rng = np.random.RandomState(7)
+    for _ in range(10):
+        pos = 3.0 * rng.randn(10, 3)
+        _check(pos, radius=4.0)
+
+
+def test_rotation_is_orthogonal():
+    rng = np.random.RandomState(3)
+    pos = rng.randn(20, 3)
+    rot = normalize_rotation(pos)
+    # pairwise distances preserved
+    d0 = np.linalg.norm(pos - pos.mean(0) - (pos[:1] - pos.mean(0)), axis=1)
+    d1 = np.linalg.norm(rot - rot[:1], axis=1)
+    np.testing.assert_allclose(d0, d1, atol=1e-4)
