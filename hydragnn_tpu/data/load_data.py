@@ -100,7 +100,16 @@ def dataset_loading_and_splitting(
 
     head_specs = head_specs_from_config(config)
     gslices, nslices = label_slices_from_config(config)
-    batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
+    batch_size = int(config["NeuralNetwork"]["Training"]["batch_size"])
+
+    # With multiple local accelerators the train loop runs the DP mesh path
+    # on device-stacked micro-batches (see train/trainer.py); the configured
+    # batch size is the GLOBAL batch, so loaders produce micro-batches.
+    import jax
+
+    n_local = len(jax.local_devices())
+    if n_local > 1:
+        batch_size = max(1, -(-batch_size // n_local))
 
     # DimeNet consumes a static padded triplet table per batch (the TPU
     # replacement of the reference's per-batch SparseTensor triplets,

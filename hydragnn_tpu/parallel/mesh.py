@@ -118,11 +118,14 @@ def make_dp_train_step(
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        # gradient pmean across devices = DDP all-reduce parity
         grads = jax.lax.pmean(grads, axis)
         new_stats = jax.lax.pmean(new_stats, axis)
-        loss = jax.lax.pmean(loss, axis)
-        per_head = jax.lax.pmean(per_head, axis)
-        num_graphs = jax.lax.psum(g.n_real_graphs, axis)
+        ng_local = g.n_real_graphs
+        num_graphs = jax.lax.psum(ng_local, axis)
+        denom = jnp.maximum(num_graphs, 1.0)
+        loss = jax.lax.psum(loss * ng_local, axis) / denom
+        per_head = [jax.lax.psum(p * ng_local, axis) / denom for p in per_head]
 
         updates, new_opt_state = opt_spec.tx.update(
             grads, state.opt_state, state.params)
@@ -163,9 +166,12 @@ def make_dp_eval_step(
         g = jax.tree.map(lambda x: x[0], g)
         loss, (per_head, _, outputs) = _loss_and_metrics(
             model, cfg, state.params, state.batch_stats, g, False)
-        loss = jax.lax.pmean(loss, axis)
-        per_head = jax.lax.pmean(per_head, axis)
-        num_graphs = jax.lax.psum(g.n_real_graphs, axis)
+        # weight by real graphs so empty wrap-padding shards don't dilute
+        ng_local = g.n_real_graphs
+        num_graphs = jax.lax.psum(ng_local, axis)
+        denom = jnp.maximum(num_graphs, 1.0)
+        loss = jax.lax.psum(loss * ng_local, axis) / denom
+        per_head = [jax.lax.psum(p * ng_local, axis) / denom for p in per_head]
         # re-add the device axis so outputs gather across shards
         outputs = jax.tree.map(lambda x: x[None], outputs)
         return {

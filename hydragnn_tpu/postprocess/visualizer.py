@@ -1,0 +1,112 @@
+"""Result visualization (parity: reference hydragnn/postprocess/visualizer.py).
+
+Matplotlib plots of training results: per-head parity scatter plots, error
+PDFs and conditional means, loss history, node-count histogram.  All methods
+render to PNG under ``logs/<name>/`` on rank 0.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+class Visualizer:
+    def __init__(
+        self,
+        model_with_config_name: str,
+        node_feature: Optional[Sequence] = None,
+        num_heads: int = 1,
+        head_dims: Optional[Sequence[int]] = None,
+        logs_dir: str = "./logs/",
+    ):
+        self.log_name = model_with_config_name
+        self.outdir = os.path.join(logs_dir, model_with_config_name)
+        os.makedirs(self.outdir, exist_ok=True)
+        self.num_heads = num_heads
+        self.head_dims = list(head_dims or [1] * num_heads)
+
+    # -- scatter / parity plots (reference visualizer.py:692-720) ----------
+    def create_scatter_plots(
+        self,
+        true_values: Sequence[np.ndarray],
+        predicted_values: Sequence[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+        iepoch: Optional[int] = None,
+    ) -> None:
+        plt = _plt()
+        n = len(true_values)
+        fig, axs = plt.subplots(1, n, figsize=(5 * n, 4.5), squeeze=False)
+        for ih in range(n):
+            t = np.asarray(true_values[ih]).reshape(-1)
+            p = np.asarray(predicted_values[ih]).reshape(-1)
+            ax = axs[0][ih]
+            ax.scatter(t, p, s=6, edgecolor="b", facecolor="none")
+            lo, hi = float(min(t.min(), p.min())), float(max(t.max(), p.max()))
+            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            name = output_names[ih] if output_names else f"head{ih}"
+            ax.set_title(f"{name}  MAE={np.abs(t - p).mean():.4f}")
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, f"scatter{suffix}.png"))
+        plt.close(fig)
+
+    # -- error statistics (reference "global analysis", visualizer.py:134+) -
+    def create_error_histograms(
+        self,
+        true_values: Sequence[np.ndarray],
+        predicted_values: Sequence[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+    ) -> None:
+        plt = _plt()
+        n = len(true_values)
+        fig, axs = plt.subplots(1, n, figsize=(5 * n, 4), squeeze=False)
+        for ih in range(n):
+            err = (np.asarray(predicted_values[ih]) -
+                   np.asarray(true_values[ih])).reshape(-1)
+            ax = axs[0][ih]
+            ax.hist(err, bins=40, color="b", alpha=0.6, density=True)
+            name = output_names[ih] if output_names else f"head{ih}"
+            ax.set_title(f"{name} error PDF")
+            ax.set_xlabel("pred - true")
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "error_pdf.png"))
+        plt.close(fig)
+
+    # -- loss history (reference visualizer.py:629-690) --------------------
+    def plot_history(self, history: Dict[str, List[float]]) -> None:
+        plt = _plt()
+        fig, ax = plt.subplots(figsize=(6, 4.5))
+        for split in ("train", "val", "test"):
+            if split in history and history[split]:
+                ax.semilogy(history[split], label=split)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "history.png"))
+        plt.close(fig)
+
+    # -- dataset statistics (reference visualizer.py:734+) -----------------
+    def num_nodes_plot(self, node_counts: Sequence[int]) -> None:
+        plt = _plt()
+        fig, ax = plt.subplots(figsize=(5, 4))
+        ax.hist(np.asarray(node_counts), bins=20, color="b", alpha=0.7)
+        ax.set_xlabel("nodes per graph")
+        ax.set_ylabel("count")
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "num_nodes.png"))
+        plt.close(fig)

@@ -308,8 +308,8 @@ def _run_epoch(step_fn, state, loader, train: bool):
     for g in loader:
         if train:
             state, metrics = step_fn(state, g)
-            per_head = [metrics[k] for k in sorted(metrics)
-                        if k.startswith("task_")]
+            n_tasks = sum(1 for k in metrics if k.startswith("task_"))
+            per_head = [metrics[f"task_{i}"] for i in range(n_tasks)]
         else:
             metrics = step_fn(state, g)
             per_head = metrics["per_head"]
@@ -337,20 +337,49 @@ def train_validate_test(
     rank: int = 0,
     world_size: int = 1,
     logs_dir: str = "./logs/",
+    use_mesh_dp: Optional[bool] = None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Epoch loop with LR plateau scheduling, early stopping, checkpointing.
 
     Parity with reference train_validate_test (train_validate_test.py:53-284):
     per-epoch train/val/test losses, scheduler.step(val), checkpoint(val) with
     warmup, optional early stop, metric reduction across ranks.
+
+    When this process drives more than one accelerator (a TPU host's local
+    chips), the loop automatically switches to the data-parallel mesh path:
+    device-stacked batches through the shard_map step (DDP parity; see
+    hydragnn_tpu/parallel/mesh.py).  ``use_mesh_dp`` forces the choice.
     """
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     output_names = config_nn["Variables_of_interest"].get("output_names")
 
-    train_step = jax.jit(
-        make_train_step(model, cfg, opt_spec, output_names), donate_argnums=0)
-    eval_step = jax.jit(make_eval_step(model, cfg))
+    n_local_devices = len(jax.local_devices())
+    if use_mesh_dp is None:
+        use_mesh_dp = n_local_devices > 1
+    if use_mesh_dp:
+        from hydragnn_tpu.parallel.mesh import (
+            DeviceStackLoader,
+            make_dp_eval_step,
+            make_dp_train_step,
+            make_mesh,
+            replicate_state,
+        )
+
+        mesh = make_mesh()
+        state = replicate_state(state, mesh)
+        train_step = make_dp_train_step(
+            model, cfg, opt_spec, mesh, output_names)
+        eval_step = make_dp_eval_step(model, cfg, mesh)
+        n_dev = len(mesh.devices)
+        train_loader = DeviceStackLoader(train_loader, n_dev, drop_last=True)
+        val_loader = DeviceStackLoader(val_loader, n_dev, drop_last=False)
+        test_loader = DeviceStackLoader(test_loader, n_dev, drop_last=False)
+    else:
+        train_step = jax.jit(
+            make_train_step(model, cfg, opt_spec, output_names),
+            donate_argnums=0)
+        eval_step = jax.jit(make_eval_step(model, cfg))
 
     scheduler = ReduceLROnPlateau()
     earlystopper = None
@@ -418,6 +447,15 @@ def train_validate_test(
         if earlystopper is not None and earlystopper(val_loss):
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
+        # SLURM walltime graceful stop (reference train_validate_test.py:229-235)
+        if os.getenv("SLURM_JOB_ID"):
+            from hydragnn_tpu.utils.slurm import check_remaining
+
+            if not check_remaining(time.time() - t0):
+                print_distributed(
+                    verbosity,
+                    f"Stopping at epoch {epoch}: insufficient SLURM walltime")
+                break
 
     return state, history
 

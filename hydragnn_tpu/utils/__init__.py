@@ -7,3 +7,11 @@ from hydragnn_tpu.utils.print_utils import (
     setup_log,
 )
 from hydragnn_tpu.utils import tracer
+from hydragnn_tpu.utils.time_utils import Timer, get_timer, print_timers, reset_timers
+from hydragnn_tpu.utils.profile import Profiler
+from hydragnn_tpu.utils.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from hydragnn_tpu.utils.slurm import check_remaining, parse_slurm_nodelist

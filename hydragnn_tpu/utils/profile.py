@@ -1,0 +1,76 @@
+"""jax.profiler wrapper (parity: reference hydragnn/utils/profile.py:9-70).
+
+The reference wraps ``torch.profiler.profile`` with a wait/warmup/active
+schedule and a TensorBoard trace handler, enabled per-epoch from the config's
+``Profile`` section.  Here the same schedule gates ``jax.profiler`` traces
+(viewable in TensorBoard/Perfetto/XProf).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+class Profiler:
+    """Step-scheduled profiler: wait -> warmup -> active -> done.
+
+    Config keys (reference profile.py:32-43): ``enable`` (int), ``wait``,
+    ``warmup``, ``active``, ``trace_dir``.
+    """
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None,
+                 log_name: str = "run", logs_dir: str = "./logs/"):
+        config = config or {}
+        self.enabled = bool(int(config.get("enable", 0)))
+        self.wait = int(config.get("wait", 5))
+        self.warmup = int(config.get("warmup", 3))
+        self.active = int(config.get("active", 3))
+        self.trace_dir = config.get(
+            "trace_dir", os.path.join(logs_dir, log_name, "trace"))
+        self._step = 0
+        self._tracing = False
+        self._done = False
+
+    def setup(self, config: Optional[Dict[str, Any]]):
+        """Re-arm from a config section (reference Profiler.setup)."""
+        if config:
+            self.__init__(config, os.path.basename(
+                os.path.dirname(self.trace_dir)) or "run",
+                os.path.dirname(os.path.dirname(self.trace_dir)) or "./logs/")
+        return self
+
+    def step(self) -> None:
+        """Advance the schedule; start/stop the device trace at boundaries."""
+        if not self.enabled or self._done:
+            return
+        start_at = self.wait + self.warmup
+        stop_at = start_at + self.active
+        if self._step == start_at and not self._tracing:
+            import jax.profiler
+
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+        self._step += 1
+        if self._step >= stop_at and self._tracing:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._done = True
+
+    def disable(self):
+        if self._tracing:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+        self.enabled = False
+
+
+def annotate(name: str):
+    """Context manager adding a named region to device traces."""
+    import jax.profiler
+
+    return jax.profiler.TraceAnnotation(name)

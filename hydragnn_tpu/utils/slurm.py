@@ -1,0 +1,74 @@
+"""Scheduler integration: SLURM time-limit graceful stop.
+
+Parity: reference hydragnn/utils/distributed.py:46-77 (nodelist parsing) and
+:287-312 (``check_remaining``: rank 0 scrapes ``squeue -o %L``, compares the
+remaining walltime to the last epoch's duration and broadcasts a stop flag).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from typing import List, Optional
+
+
+def parse_slurm_nodelist(nodelist: str) -> List[str]:
+    """Expand 'frontier[00001-00003,00007]' style SLURM nodelists
+    (reference distributed.py:46-77)."""
+    out: List[str] = []
+    for m in re.finditer(r"([a-zA-Z0-9._-]+?)(?:\[([^\]]+)\])?(?:,|$)", nodelist):
+        prefix, ranges = m.group(1), m.group(2)
+        if not prefix:
+            continue
+        if ranges is None:
+            out.append(prefix)
+            continue
+        for part in ranges.split(","):
+            if "-" in part:
+                lo, hi = part.split("-")
+                width = len(lo)
+                for i in range(int(lo), int(hi) + 1):
+                    out.append(f"{prefix}{str(i).zfill(width)}")
+            else:
+                out.append(f"{prefix}{part}")
+    return out
+
+
+def _remaining_seconds() -> Optional[float]:
+    """Remaining walltime of this SLURM job in seconds, or None."""
+    job = os.getenv("SLURM_JOB_ID")
+    if not job:
+        return None
+    try:
+        txt = subprocess.run(
+            ["squeue", "-h", "-j", job, "-o", "%L"],
+            capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+    except Exception:
+        return None
+    if not txt:
+        return None
+    # formats: [DD-]HH:MM:SS | MM:SS | SS
+    days = 0
+    if "-" in txt:
+        d, txt = txt.split("-", 1)
+        days = int(d)
+    parts = [int(p) for p in txt.split(":")]
+    while len(parts) < 3:
+        parts.insert(0, 0)
+    h, m, s = parts
+    return days * 86400 + h * 3600 + m * 60 + s
+
+
+def check_remaining(epoch_seconds: float, safety_factor: float = 2.0) -> bool:
+    """True if there is time for another epoch; rank-0 decision broadcast to
+    every host (reference distributed.py:287-312)."""
+    from hydragnn_tpu.parallel.comm import host_broadcast_scalar, process_index
+
+    ok = 1.0
+    if process_index() == 0:
+        remaining = _remaining_seconds()
+        if remaining is not None and remaining < epoch_seconds * safety_factor:
+            ok = 0.0
+    return bool(host_broadcast_scalar(ok) > 0.5)

@@ -1,0 +1,191 @@
+"""SMILES -> graph sample conversion.
+
+Parity: reference hydragnn/utils/smiles_utils.py:49-117 (RDKit molecule to
+graph with one-hot atom types, aromatic/hybridization flags, and bond-type
+one-hot edge features).  RDKit is preferred when importable; otherwise a
+native minimal SMILES parser covers the organic subset (B C N O P S F Cl Br I,
+aromatic lowercase forms, brackets, branches, ring closures, bond orders) —
+enough for QM9 / OGB-style molecule strings.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.graph.batch import GraphSample
+
+_ORGANIC = ["B", "C", "N", "O", "P", "S", "F", "Cl", "Br", "I"]
+_BOND_ORDER = {"-": 1.0, "=": 2.0, "#": 3.0, ":": 1.5}
+
+# hybridization one-hot slots (reference uses rdkit's SP/SP2/SP3)
+_HYB = ["SP", "SP2", "SP3"]
+
+
+def parse_smiles(smiles: str) -> Tuple[List[Dict], List[Tuple[int, int, float]]]:
+    """Minimal SMILES parser: returns (atoms, bonds).
+
+    atoms: dicts with ``symbol`` and ``aromatic``; bonds: (i, j, order).
+    """
+    atoms: List[Dict] = []
+    bonds: List[Tuple[int, int, float]] = []
+    stack: List[int] = []
+    rings: Dict[str, Tuple[int, float]] = {}
+    prev = -1
+    pending_order: Optional[float] = None
+    i = 0
+    n = len(smiles)
+    while i < n:
+        ch = smiles[i]
+        if ch in "-=#:":
+            pending_order = _BOND_ORDER[ch]
+            i += 1
+        elif ch == "(":
+            stack.append(prev)
+            i += 1
+        elif ch == ")":
+            prev = stack.pop()
+            i += 1
+        elif ch in "/\\.":
+            i += 1  # stereo marks / disconnection: ignored
+        elif ch == "[":
+            j = smiles.index("]", i)
+            body = smiles[i + 1 : j]
+            m = re.match(r"\d*([A-Za-z][a-z]?)", body)
+            sym = m.group(1)
+            aromatic = sym.islower()
+            atoms.append({"symbol": sym.capitalize(), "aromatic": aromatic})
+            idx = len(atoms) - 1
+            if prev >= 0:
+                order = pending_order or (1.5 if aromatic and atoms[prev]["aromatic"] else 1.0)
+                bonds.append((prev, idx, order))
+            prev = idx
+            pending_order = None
+            i = j + 1
+        elif ch == "%":
+            label = smiles[i + 1 : i + 3]
+            _close_ring(rings, label, prev, pending_order, bonds, atoms)
+            pending_order = None
+            i += 3
+        elif ch.isdigit():
+            _close_ring(rings, ch, prev, pending_order, bonds, atoms)
+            pending_order = None
+            i += 1
+        else:
+            two = smiles[i : i + 2]
+            if two in ("Cl", "Br"):
+                sym, aromatic, i = two, False, i + 2
+            elif ch.isupper():
+                sym, aromatic, i = ch, False, i + 1
+            elif ch.islower():
+                sym, aromatic, i = ch.upper(), True, i + 1
+            else:
+                raise ValueError(f"Cannot parse SMILES at '{ch}' in {smiles}")
+            atoms.append({"symbol": sym, "aromatic": aromatic})
+            idx = len(atoms) - 1
+            if prev >= 0:
+                order = pending_order or (
+                    1.5 if aromatic and atoms[prev]["aromatic"] else 1.0)
+                bonds.append((prev, idx, order))
+            prev = idx
+            pending_order = None
+    return atoms, bonds
+
+
+def _close_ring(rings, label, prev, pending_order, bonds, atoms):
+    if label in rings:
+        j, order0 = rings.pop(label)
+        order = pending_order or order0 or (
+            1.5 if atoms[prev]["aromatic"] and atoms[j]["aromatic"] else 1.0)
+        bonds.append((j, prev, order))
+    else:
+        rings[label] = (prev, pending_order)
+
+
+def _approx_hybridization(symbol: str, aromatic: bool, orders: List[float]) -> str:
+    """SP/SP2/SP3 estimate from bond orders (native fallback for rdkit)."""
+    if aromatic or any(o == 2.0 for o in orders):
+        return "SP2"
+    if any(o == 3.0 for o in orders):
+        return "SP"
+    return "SP3"
+
+
+def generate_graphdata_from_smilestr(
+    smilestr: str,
+    ytarget,
+    types: Optional[Dict[str, int]] = None,
+    var_config=None,
+) -> GraphSample:
+    """SMILES string -> GraphSample with one-hot types + aromatic +
+    hybridization node features and bond-order one-hot edge features."""
+    types = types or {s: i for i, s in enumerate(_ORGANIC)}
+    try:
+        return _from_rdkit(smilestr, ytarget, types)
+    except ImportError:
+        pass
+    atoms, bonds = parse_smiles(smilestr)
+    n = len(atoms)
+    x = np.zeros((n, len(types) + 1 + len(_HYB)), np.float32)
+    orders_per_atom: List[List[float]] = [[] for _ in range(n)]
+    for i, j, o in bonds:
+        orders_per_atom[i].append(o)
+        orders_per_atom[j].append(o)
+    for idx, a in enumerate(atoms):
+        x[idx, types[a["symbol"]]] = 1.0
+        x[idx, len(types)] = 1.0 if a["aromatic"] else 0.0
+        hyb = _approx_hybridization(
+            a["symbol"], a["aromatic"], orders_per_atom[idx])
+        x[idx, len(types) + 1 + _HYB.index(hyb)] = 1.0
+
+    src, dst, eattr = [], [], []
+    for i, j, o in bonds:
+        onehot = [float(o == 1.0), float(o == 1.5), float(o == 2.0),
+                  float(o == 3.0)]
+        src += [i, j]
+        dst += [j, i]
+        eattr += [onehot, onehot]
+    edge_index = (np.asarray([src, dst], np.int32)
+                  if src else np.zeros((2, 0), np.int32))
+    edge_attr = (np.asarray(eattr, np.float32)
+                 if eattr else np.zeros((0, 4), np.float32))
+    y = np.atleast_1d(np.asarray(ytarget, np.float32))
+    return GraphSample(
+        x=x, pos=np.zeros((n, 3), np.float32), edge_index=edge_index,
+        edge_attr=edge_attr, graph_y=y, node_y=x)
+
+
+def _from_rdkit(smilestr: str, ytarget, types: Dict[str, int]) -> GraphSample:
+    from rdkit import Chem  # noqa: F401 - gated import
+
+    mol = Chem.MolFromSmiles(smilestr)
+    if mol is None:
+        raise ValueError(f"RDKit could not parse: {smilestr}")
+    n = mol.GetNumAtoms()
+    x = np.zeros((n, len(types) + 1 + len(_HYB)), np.float32)
+    for atom in mol.GetAtoms():
+        i = atom.GetIdx()
+        x[i, types[atom.GetSymbol()]] = 1.0
+        x[i, len(types)] = 1.0 if atom.GetIsAromatic() else 0.0
+        h = str(atom.GetHybridization())
+        if h in _HYB:
+            x[i, len(types) + 1 + _HYB.index(h)] = 1.0
+    src, dst, eattr = [], [], []
+    for bond in mol.GetBonds():
+        i, j = bond.GetBeginAtomIdx(), bond.GetEndAtomIdx()
+        o = bond.GetBondTypeAsDouble()
+        onehot = [float(o == 1.0), float(o == 1.5), float(o == 2.0),
+                  float(o == 3.0)]
+        src += [i, j]
+        dst += [j, i]
+        eattr += [onehot, onehot]
+    edge_index = (np.asarray([src, dst], np.int32)
+                  if src else np.zeros((2, 0), np.int32))
+    edge_attr = (np.asarray(eattr, np.float32)
+                 if eattr else np.zeros((0, 4), np.float32))
+    y = np.atleast_1d(np.asarray(ytarget, np.float32))
+    return GraphSample(
+        x=x, pos=np.zeros((n, 3), np.float32), edge_index=edge_index,
+        edge_attr=edge_attr, graph_y=y, node_y=x)

@@ -1,0 +1,71 @@
+"""Named cumulative timers with cross-rank reduction.
+
+Parity: reference hydragnn/utils/time_utils.py:70-138 — every ``stop`` folds
+the interval into a named cumulative total; ``print_timers`` reports
+min/max/avg across ranks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+_timers: Dict[str, "Timer"] = {}
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self._start: Optional[float] = None
+        _timers[name] = self
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is None:
+            return
+        self.total += time.perf_counter() - self._start
+        self.count += 1
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def get_timer(name: str) -> Timer:
+    return _timers.get(name) or Timer(name)
+
+
+def reset_timers() -> None:
+    _timers.clear()
+
+
+def print_timers(verbosity: int = 0) -> None:
+    """Per-timer min/max/avg across hosts (reference time_utils.py:95-138)."""
+    from hydragnn_tpu.parallel.comm import host_allgather, num_processes
+    from hydragnn_tpu.utils.print_utils import print_distributed
+
+    if not _timers:
+        return
+    names = sorted(_timers)
+    totals = np.asarray([_timers[n].total for n in names])
+    if num_processes() > 1:
+        stacked = host_allgather(totals)  # [n_hosts, n_timers]
+        mins, maxs, avgs = stacked.min(0), stacked.max(0), stacked.mean(0)
+    else:
+        mins = maxs = avgs = totals
+    for i, n in enumerate(names):
+        print_distributed(
+            verbosity,
+            f"Timer {n}: min {mins[i]:.4f}s  max {maxs[i]:.4f}s  "
+            f"avg {avgs[i]:.4f}s  ({_timers[n].count} calls)",
+        )

@@ -1,0 +1,133 @@
+"""Unit tests for aux subsystems: SMILES parsing, atomic descriptors,
+visualizer, SLURM nodelist parsing, orbax checkpointing, profiler schedule,
+timers (parity with reference tests/test_atomicdescriptors.py and the aux
+subsystem inventory in SURVEY.md §5)."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def test_smiles_parser_basic():
+    from hydragnn_tpu.utils.smiles_utils import generate_graphdata_from_smilestr
+
+    # ethanol: 3 heavy atoms, 2 bonds -> 4 directed edges
+    g = generate_graphdata_from_smilestr("CCO", 1.23)
+    assert g.num_nodes == 3
+    assert g.num_edges == 4
+    assert g.graph_y[0] == pytest.approx(1.23)
+
+    # benzene: aromatic ring, 6 atoms, 6 ring bonds -> 12 directed edges
+    g = generate_graphdata_from_smilestr("c1ccccc1", 0.0)
+    assert g.num_nodes == 6
+    assert g.num_edges == 12
+    # aromatic flag set on every atom
+    assert (g.x[:, 10] == 1.0).all()
+
+    # branches and double bonds: acetic acid CC(=O)O
+    g = generate_graphdata_from_smilestr("CC(=O)O", 0.0)
+    assert g.num_nodes == 4
+    assert g.num_edges == 6
+
+
+def test_atomicdescriptors():
+    from hydragnn_tpu.utils.atomicdescriptors import (
+        atomicdescriptors,
+        group_period,
+    )
+
+    assert group_period(1) == (1, 1)
+    assert group_period(6) == (14, 2)
+    assert group_period(8) == (16, 2)
+    assert group_period(26) == (8, 4)
+
+    ad = atomicdescriptors(element_types=["C", "H", "O"])
+    f = ad.get_atom_features(6)
+    assert f.shape[0] == 6
+    assert np.all(f >= 0) and np.all(f <= 1)
+
+    ad1h = atomicdescriptors(element_types=["C", "H", "O"], one_hot=True)
+    f = ad1h.get_atom_features(8)
+    assert f.shape[0] == 9  # 3 one-hot + 6 properties
+
+
+def test_visualizer(tmp_path):
+    from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+    v = Visualizer("viztest", num_heads=2, logs_dir=str(tmp_path))
+    rng = np.random.RandomState(0)
+    t = [rng.rand(50, 1), rng.rand(50, 1)]
+    p = [x + 0.05 * rng.randn(50, 1) for x in t]
+    v.create_scatter_plots(t, p, ["a", "b"])
+    v.create_error_histograms(t, p)
+    v.plot_history({"train": [1.0, 0.5], "val": [1.1, 0.6], "test": [1.2, 0.7]})
+    v.num_nodes_plot([4, 8, 8, 2])
+    out = os.listdir(os.path.join(str(tmp_path), "viztest"))
+    assert {"scatter.png", "error_pdf.png", "history.png",
+            "num_nodes.png"} <= set(out)
+
+
+def test_slurm_nodelist_parsing():
+    from hydragnn_tpu.utils.slurm import parse_slurm_nodelist
+
+    assert parse_slurm_nodelist("frontier[00001-00003]") == [
+        "frontier00001", "frontier00002", "frontier00003"]
+    assert parse_slurm_nodelist("node1,node2") == ["node1", "node2"]
+    assert parse_slurm_nodelist("n[1,5-6]") == ["n1", "n5", "n6"]
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train.trainer import TrainState
+    from hydragnn_tpu.utils.checkpoint import (
+        latest_step,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    state = TrainState(
+        step=jnp.asarray(7),
+        params={"w": jnp.arange(4.0)},
+        batch_stats={"bn": {"mean": jnp.ones(3)}},
+        opt_state={"m": jnp.zeros(4)},
+    )
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(state, d)
+    assert latest_step(d) == 7
+    skeleton = TrainState(
+        step=jnp.asarray(0),
+        params={"w": jnp.zeros(4)},
+        batch_stats={"bn": {"mean": jnp.zeros(3)}},
+        opt_state={"m": jnp.ones(4)},
+    )
+    restored = restore_checkpoint(skeleton, d)
+    assert int(restored.step) == 7
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                  np.arange(4.0))
+
+
+def test_profiler_schedule(tmp_path, monkeypatch):
+    from hydragnn_tpu.utils import profile as prof
+
+    calls = []
+    monkeypatch.setattr(
+        "jax.profiler.start_trace", lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(
+        "jax.profiler.stop_trace", lambda: calls.append(("stop",)))
+    p = prof.Profiler({"enable": 1, "wait": 2, "warmup": 1, "active": 2,
+                       "trace_dir": str(tmp_path / "tr")})
+    for _ in range(10):
+        p.step()
+    assert [c[0] for c in calls] == ["start", "stop"]
+
+
+def test_timers():
+    from hydragnn_tpu.utils.time_utils import Timer, get_timer, reset_timers
+
+    reset_timers()
+    with Timer("region_a"):
+        pass
+    t = get_timer("region_a")
+    assert t.count == 1 and t.total >= 0.0
