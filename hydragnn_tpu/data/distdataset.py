@@ -1,0 +1,145 @@
+"""DistDataset: distributed in-memory sample store (DDStore equivalent).
+
+Parity: reference hydragnn/utils/distdataset.py:119-183 — each host keeps its
+local shard of the dataset in memory and serves remote ``get(global_idx)``
+requests; any host can read any sample.  The reference uses MPI one-sided
+windows (pyddstore); here the store is the native TCP-serving shard store
+(native/hydrastore.cpp), with host addresses exchanged through the JAX
+multi-host runtime at construction.
+
+Samples are pickled into the store; gets unpickle.  ``epoch_begin``/
+``epoch_end`` exist for API parity and are no-ops (TCP serving is always on).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import socket
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.data.abstract import AbstractBaseDataset
+
+_KEY = b"samples"
+
+
+class DistDataset(AbstractBaseDataset):
+    def __init__(self, dataset: Sequence, label: str = "dataset",
+                 port_hint: int = 0):
+        super().__init__()
+        from hydragnn_tpu.native import load_library
+        from hydragnn_tpu.parallel.comm import (
+            host_allgather,
+            num_processes,
+            process_index,
+        )
+
+        self.lib = load_library()
+        self.label = label.encode()
+        self.rank = process_index()
+        self.world_size = num_processes()
+
+        local = list(dataset)
+        blobs = [pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
+                 for s in local]
+        sizes = np.asarray([len(b) for b in blobs], np.int64)
+
+        # global index layout: rank shards are contiguous in rank order
+        counts = host_allgather(np.asarray([len(local)], np.int64)).reshape(-1)
+        self.counts = [int(c) for c in counts]
+        self.total = int(sum(self.counts))
+        self.global_start = int(sum(self.counts[: self.rank]))
+
+        self.store = self.lib.dstore_create(port_hint)
+        assert self.store, "failed to create dstore server"
+        self.port = int(self.lib.dstore_port(self.store))
+
+        packed = b"".join(blobs)
+        self.lib.dstore_add(
+            self.store, _KEY, packed,
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(local), self.global_start)
+
+        # exchange (ip, port) of every host's server
+        ip = _local_ip()
+        addr = np.zeros(5, np.int64)
+        parts = [int(p) for p in ip.split(".")]
+        addr[:4] = parts
+        addr[4] = self.port
+        all_addrs = host_allgather(addr)
+        if all_addrs.ndim == 1:
+            all_addrs = all_addrs[None]
+        self.addresses: List[str] = [
+            (".".join(str(int(v)) for v in row[:4]), int(row[4]))
+            for row in all_addrs
+        ]
+        self._conns: Dict[int, int] = {}
+        self._max_bytes = max(int(sizes.max()) if len(sizes) else 1, 1)
+        maxes = host_allgather(np.asarray([self._max_bytes], np.int64))
+        self._max_bytes = int(np.max(maxes))
+        self._buf = ctypes.create_string_buffer(self._max_bytes)
+
+    # -- ddstore API parity (train loop hooks) -----------------------------
+    def epoch_begin(self):
+        pass
+
+    def epoch_end(self):
+        pass
+
+    @property
+    def ddstore(self):
+        return self
+
+    # ----------------------------------------------------------------------
+    def _owner(self, gidx: int) -> int:
+        acc = 0
+        for r, c in enumerate(self.counts):
+            acc += c
+            if gidx < acc:
+                return r
+        raise IndexError(gidx)
+
+    def len(self) -> int:
+        return self.total
+
+    def get(self, gidx: int):
+        n = self.lib.dstore_get_local(
+            self.store, _KEY, gidx, self._buf, self._max_bytes)
+        if n < 0:
+            owner = self._owner(gidx)
+            fd = self._conns.get(owner)
+            if fd is None:
+                ip, port = self.addresses[owner]
+                fd = self.lib.dstore_connect(ip.encode(), port)
+                assert fd >= 0, f"cannot reach dstore owner {owner} at {ip}:{port}"
+                self._conns[owner] = fd
+            n = self.lib.dstore_fetch(fd, _KEY, gidx, self._buf, self._max_bytes)
+            assert n > 0, f"remote get failed for sample {gidx}"
+        return pickle.loads(self._buf.raw[:n])
+
+    def close(self):
+        for fd in self._conns.values():
+            self.lib.dstore_disconnect(fd)
+        self._conns.clear()
+        if getattr(self, "store", None):
+            self.lib.dstore_destroy(self.store)
+            self.store = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except Exception:
+        return "127.0.0.1"

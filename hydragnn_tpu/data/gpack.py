@@ -1,0 +1,275 @@
+"""gpack: packed ragged-array graph container (the ADIOS2 store analog).
+
+Schema parity with the reference's AdiosWriter/AdiosDataset
+(reference hydragnn/utils/adiosdataset.py:32-229,232-737): every sample key
+(x, pos, edge_index, y, ...) is stored as ONE flat array plus per-sample
+dims/offset index arrays, with dataset attributes (minmax, pna_deg, ...)
+in a JSON header.  Multi-host runs write one part-file per host
+(``<name>.gpack.p<rank>``); the dataset reads all parts as one global store.
+
+Reading goes through the native mmap reader (native/hydrastore.cpp) —
+zero-copy numpy views straight out of the page cache — with a pure-numpy
+fallback when the native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional, Sequence
+
+import ctypes
+import numpy as np
+
+from hydragnn_tpu.data.abstract import AbstractBaseDataset
+from hydragnn_tpu.graph.batch import GraphSample
+
+_MAGIC = b"HGPACK01"
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+# GraphSample field -> (attribute, per-sample extractor)
+_SAMPLE_KEYS = ["x", "pos", "edge_index", "edge_attr", "graph_y", "node_y",
+                "cell"]
+
+
+class GpackWriter:
+    """Pack per-sample arrays into one part-file.
+
+    ``samples`` may be GraphSamples (standard keys) or dicts of arrays.
+    """
+
+    def __init__(self, path: str, rank: int = 0,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.path = f"{path}.p{rank}" if rank or "*" not in path else path
+        self.attrs = attrs or {}
+
+    def save(self, samples: Sequence[Any]) -> str:
+        keyed: Dict[str, List[np.ndarray]] = {}
+        n = len(samples)
+        for s in samples:
+            d = self._as_dict(s)
+            for k, v in d.items():
+                keyed.setdefault(k, []).append(np.asarray(v))
+        for k, arrs in keyed.items():
+            assert len(arrs) == n, f"key {k} missing in some samples"
+
+        header = bytearray()
+        header += _MAGIC
+        attrs_json = json.dumps(self.attrs).encode()
+        header += struct.pack("<QQQ", len(keyed), n, len(attrs_json))
+        header += attrs_json
+
+        blobs: List[bytes] = []
+        key_headers: List[bytes] = []
+        # first pass: compute per-key index; data offsets fixed after header
+        entries = []
+        for name in sorted(keyed):
+            arrs = keyed[name]
+            ndim = max(a.ndim for a in arrs)
+            dtype = np.dtype(arrs[0].dtype)
+            code = _DTYPE_CODES[dtype]
+            dims = np.zeros((n, ndim), np.int64)
+            offsets = np.zeros((n,), np.int64)
+            off = 0
+            flat_parts = []
+            for i, a in enumerate(arrs):
+                a = a.reshape(a.shape if a.ndim == ndim else
+                              a.shape + (1,) * (ndim - a.ndim))
+                dims[i] = a.shape
+                offsets[i] = off
+                off += a.size
+                flat_parts.append(np.ascontiguousarray(a, dtype).reshape(-1))
+            flat = (np.concatenate(flat_parts) if flat_parts
+                    else np.zeros(0, dtype))
+            entries.append((name, code, ndim, dims, offsets, flat))
+
+        # header size: fixed part + per-key headers
+        hdr_len = len(header)
+        for name, code, ndim, dims, offsets, flat in entries:
+            hdr_len += 4 + len(name.encode()) + 4 + 4 + 8 + 8
+            hdr_len += dims.nbytes + offsets.nbytes
+        data_off = (hdr_len + 63) // 64 * 64
+
+        body = bytearray()
+        for name, code, ndim, dims, offsets, flat in entries:
+            nb = name.encode()
+            header += struct.pack("<I", len(nb)) + nb
+            header += struct.pack("<II", code, ndim)
+            header += struct.pack("<QQ", data_off + len(body), flat.nbytes)
+            header += dims.tobytes() + offsets.tobytes()
+            body += flat.tobytes()
+
+        assert len(header) == hdr_len
+        with open(self.path, "wb") as f:
+            f.write(header)
+            f.write(b"\0" * (data_off - hdr_len))
+            f.write(body)
+        return self.path
+
+    @staticmethod
+    def _as_dict(s) -> Dict[str, np.ndarray]:
+        if isinstance(s, dict):
+            return {k: v for k, v in s.items() if v is not None}
+        out = {}
+        for k in _SAMPLE_KEYS:
+            v = getattr(s, k, None)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+class _NativePart:
+    def __init__(self, path: str):
+        from hydragnn_tpu.native import load_library
+
+        self.lib = load_library()
+        self.h = self.lib.gpack_open(path.encode())
+        if not self.h:
+            raise IOError(f"cannot open gpack file {path}")
+        self.n = int(self.lib.gpack_num_samples(self.h))
+        self.keys = {}
+        for k in range(int(self.lib.gpack_num_keys(self.h))):
+            name = self.lib.gpack_key_name(self.h, k).decode()
+            self.keys[name] = (
+                k,
+                _DTYPES[self.lib.gpack_key_dtype(self.h, k)],
+                int(self.lib.gpack_key_ndim(self.h, k)),
+            )
+        self.attrs = json.loads(self.lib.gpack_attrs_json(self.h).decode())
+
+    def get(self, name: str, i: int) -> Optional[np.ndarray]:
+        if name not in self.keys:
+            return None
+        k, dtype, ndim = self.keys[name]
+        dims = (ctypes.c_int64 * ndim)()
+        count = self.lib.gpack_sample_dims(self.h, k, i, dims)
+        ptr = self.lib.gpack_sample_ptr(self.h, k, i)
+        shape = tuple(dims[d] for d in range(ndim))
+        buf = (ctypes.c_char * (count * np.dtype(dtype).itemsize)).from_address(ptr)
+        # zero-copy view over the mmap (read-only)
+        arr = np.frombuffer(buf, dtype=dtype, count=count).reshape(shape)
+        arr.flags.writeable = False
+        return arr
+
+    def close(self):
+        if self.h:
+            self.lib.gpack_close(self.h)
+            self.h = None
+
+
+class _NumpyPart:
+    """Pure-python fallback reader (same format)."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            raw = f.read()
+        assert raw[:8] == _MAGIC, f"bad magic in {path}"
+        off = 8
+        n_keys, n, attr_len = struct.unpack_from("<QQQ", raw, off)
+        off += 24
+        self.attrs = json.loads(raw[off : off + attr_len].decode())
+        off += attr_len
+        self.n = n
+        self.keys = {}
+        self._raw = raw
+        for _ in range(n_keys):
+            (name_len,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            name = raw[off : off + name_len].decode()
+            off += name_len
+            code, ndim = struct.unpack_from("<II", raw, off)
+            off += 8
+            data_off, data_nbytes = struct.unpack_from("<QQ", raw, off)
+            off += 16
+            dims = np.frombuffer(raw, np.int64, n * ndim, off).reshape(n, ndim)
+            off += dims.nbytes
+            offsets = np.frombuffer(raw, np.int64, n, off)
+            off += offsets.nbytes
+            self.keys[name] = (_DTYPES[code], ndim, data_off, dims, offsets)
+
+    def get(self, name: str, i: int) -> Optional[np.ndarray]:
+        if name not in self.keys:
+            return None
+        dtype, ndim, data_off, dims, offsets = self.keys[name]
+        shape = tuple(int(d) for d in dims[i])
+        count = int(np.prod(shape)) if shape else 1
+        start = data_off + int(offsets[i]) * np.dtype(dtype).itemsize
+        return np.frombuffer(self._raw, dtype, count, start).reshape(shape)
+
+    def close(self):
+        pass
+
+
+class GpackDataset(AbstractBaseDataset):
+    """Read one or many gpack part-files as a single dataset of GraphSamples.
+
+    ``path`` may be a single file, a ``<base>`` whose parts are
+    ``<base>.p<rank>``, or a glob.  ``subset`` restricts to global indices
+    (parity with AdiosDataset.setsubset, adiosdataset.py:558-584).
+    """
+
+    def __init__(self, path: str, preload: bool = False,
+                 subset: Optional[Sequence[int]] = None,
+                 use_native: bool = True):
+        super().__init__()
+        if os.path.exists(path):
+            files = [path]
+        else:
+            files = sorted(glob.glob(path + ".p*")) or sorted(glob.glob(path))
+        if not files:
+            raise FileNotFoundError(f"no gpack parts for {path}")
+        self.parts = []
+        for f in files:
+            if use_native:
+                try:
+                    self.parts.append(_NativePart(f))
+                    continue
+                except Exception:
+                    pass
+            self.parts.append(_NumpyPart(f))
+        self.attrs = self.parts[0].attrs
+        self._bounds = np.cumsum([0] + [p.n for p in self.parts])
+        total = int(self._bounds[-1])
+        self.indices = list(subset) if subset is not None else list(range(total))
+        self._cache = None
+        if preload:
+            self._cache = [self._read(i) for i in self.indices]
+
+    def _read(self, gidx: int) -> GraphSample:
+        part_id = int(np.searchsorted(self._bounds, gidx, side="right")) - 1
+        part = self.parts[part_id]
+        i = gidx - int(self._bounds[part_id])
+        get = lambda k: part.get(k, i)
+        x = get("x")
+        return GraphSample(
+            x=np.array(x),
+            pos=np.array(get("pos")),
+            edge_index=_maybe(get("edge_index")),
+            edge_attr=_maybe(get("edge_attr")),
+            graph_y=_maybe(get("graph_y")),
+            node_y=_maybe(get("node_y")),
+            cell=_maybe(get("cell")),
+        )
+
+    def len(self) -> int:
+        return len(self.indices)
+
+    def get(self, idx: int) -> GraphSample:
+        if self._cache is not None:
+            return self._cache[idx]
+        return self._read(self.indices[idx])
+
+    def setsubset(self, start: int, end: int, preload: bool = False) -> None:
+        self.indices = list(range(start, end))
+        self._cache = [self._read(i) for i in self.indices] if preload else None
+
+    def close(self):
+        for p in self.parts:
+            p.close()
+
+
+def _maybe(a):
+    return None if a is None else np.array(a)

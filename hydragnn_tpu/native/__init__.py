@@ -1,0 +1,92 @@
+"""ctypes bindings for the native runtime (native/hydrastore.cpp).
+
+The shared library is compiled on demand with g++ (cached next to the
+source, rebuilt when the source is newer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "hydrastore.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libhydrastore.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-pthread", "-std=c++17",
+           _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if stale) the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        _build()
+    lib = ctypes.CDLL(_LIB)
+
+    # gpack
+    lib.gpack_open.restype = ctypes.c_void_p
+    lib.gpack_open.argtypes = [ctypes.c_char_p]
+    lib.gpack_close.argtypes = [ctypes.c_void_p]
+    lib.gpack_num_samples.restype = ctypes.c_uint64
+    lib.gpack_num_samples.argtypes = [ctypes.c_void_p]
+    lib.gpack_num_keys.restype = ctypes.c_uint64
+    lib.gpack_num_keys.argtypes = [ctypes.c_void_p]
+    lib.gpack_key_name.restype = ctypes.c_char_p
+    lib.gpack_key_name.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.gpack_key_dtype.restype = ctypes.c_uint32
+    lib.gpack_key_dtype.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.gpack_key_ndim.restype = ctypes.c_uint32
+    lib.gpack_key_ndim.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.gpack_attrs_json.restype = ctypes.c_char_p
+    lib.gpack_attrs_json.argtypes = [ctypes.c_void_p]
+    lib.gpack_sample_dims.restype = ctypes.c_int64
+    lib.gpack_sample_dims.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.gpack_sample_ptr.restype = ctypes.c_void_p
+    lib.gpack_sample_ptr.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+
+    # dstore
+    lib.dstore_create.restype = ctypes.c_void_p
+    lib.dstore_create.argtypes = [ctypes.c_int]
+    lib.dstore_port.restype = ctypes.c_int
+    lib.dstore_port.argtypes = [ctypes.c_void_p]
+    lib.dstore_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64]
+    lib.dstore_get_local.restype = ctypes.c_int64
+    lib.dstore_get_local.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib.dstore_connect.restype = ctypes.c_int
+    lib.dstore_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dstore_fetch.restype = ctypes.c_int64
+    lib.dstore_fetch.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib.dstore_disconnect.argtypes = [ctypes.c_int]
+    lib.dstore_destroy.argtypes = [ctypes.c_void_p]
+
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False

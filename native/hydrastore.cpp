@@ -1,0 +1,347 @@
+// hydrastore: native runtime for the TPU input pipeline.
+//
+// Two components, both exposed through a C ABI for ctypes:
+//
+// 1. gpack reader — mmap'd zero-copy access to the packed ragged-array
+//    container written by hydragnn_tpu/data/gpack.py.  This is the TPU-native
+//    replacement of the reference's ADIOS2 global-array graph store
+//    (reference hydragnn/utils/adiosdataset.py:32-229: one flat array per key
+//    plus variable_count/variable_offset/variable_dim index arrays).  Reads
+//    are served straight from the page cache with no copies or Python-side
+//    parsing.
+//
+// 2. dstore — distributed in-memory sample store, the DDStore equivalent
+//    (reference hydragnn/utils/distdataset.py:119-183: each rank holds a
+//    shard of the dataset and serves remote get(idx) requests).  Local shards
+//    live in anonymous memory shared via POSIX shm so co-located processes
+//    can attach; remote gets are served by a background TCP thread per host
+//    (the TPU-world replacement of MPI one-sided windows, which do not exist
+//    off the MPI runtime).
+//
+// Build: g++ -O3 -fPIC -shared -pthread hydrastore.cpp -o libhydrastore.so
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <unordered_map>
+#include <thread>
+#include <mutex>
+#include <atomic>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/socket.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <arpa/inet.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// gpack reader
+// ---------------------------------------------------------------------------
+
+struct GpackKey {
+  std::string name;
+  uint32_t dtype;     // 0=f32 1=f64 2=i32 3=i64
+  uint32_t ndim;
+  uint64_t data_offset;   // bytes from file start
+  uint64_t data_nbytes;
+  const int64_t* dims;    // [n_samples * ndim], points into the map
+  const int64_t* offsets; // [n_samples], element offsets into the flat array
+};
+
+struct Gpack {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  size_t map_size = 0;
+  uint64_t n_keys = 0;
+  uint64_t n_samples = 0;
+  std::string attrs_json;
+  std::vector<GpackKey> keys;
+};
+
+static const size_t kDtypeSize[4] = {4, 8, 4, 8};
+
+void* gpack_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  uint8_t* map = (uint8_t*)mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) { close(fd); return nullptr; }
+
+  Gpack* g = new Gpack();
+  g->fd = fd;
+  g->map = map;
+  g->map_size = st.st_size;
+
+  const uint8_t* p = map;
+  if (memcmp(p, "HGPACK01", 8) != 0) { delete g; return nullptr; }
+  p += 8;
+  auto rd_u64 = [&p]() { uint64_t v; memcpy(&v, p, 8); p += 8; return v; };
+  auto rd_u32 = [&p]() { uint32_t v; memcpy(&v, p, 4); p += 4; return v; };
+
+  g->n_keys = rd_u64();
+  g->n_samples = rd_u64();
+  uint64_t attr_len = rd_u64();
+  g->attrs_json.assign((const char*)p, attr_len);
+  p += attr_len;
+
+  for (uint64_t k = 0; k < g->n_keys; ++k) {
+    GpackKey key;
+    uint32_t name_len = rd_u32();
+    key.name.assign((const char*)p, name_len);
+    p += name_len;
+    key.dtype = rd_u32();
+    key.ndim = rd_u32();
+    key.data_offset = rd_u64();
+    key.data_nbytes = rd_u64();
+    key.dims = (const int64_t*)p;
+    p += g->n_samples * key.ndim * sizeof(int64_t);
+    key.offsets = (const int64_t*)p;
+    p += g->n_samples * sizeof(int64_t);
+    g->keys.push_back(key);
+  }
+  return g;
+}
+
+void gpack_close(void* h) {
+  if (!h) return;
+  Gpack* g = (Gpack*)h;
+  if (g->map) munmap(g->map, g->map_size);
+  if (g->fd >= 0) close(g->fd);
+  delete g;
+}
+
+uint64_t gpack_num_samples(void* h) { return ((Gpack*)h)->n_samples; }
+uint64_t gpack_num_keys(void* h) { return ((Gpack*)h)->n_keys; }
+
+const char* gpack_key_name(void* h, uint64_t k) {
+  return ((Gpack*)h)->keys[k].name.c_str();
+}
+uint32_t gpack_key_dtype(void* h, uint64_t k) {
+  return ((Gpack*)h)->keys[k].dtype;
+}
+uint32_t gpack_key_ndim(void* h, uint64_t k) {
+  return ((Gpack*)h)->keys[k].ndim;
+}
+const char* gpack_attrs_json(void* h) { return ((Gpack*)h)->attrs_json.c_str(); }
+
+// Per-sample shape into out_dims[ndim]; returns element count.
+int64_t gpack_sample_dims(void* h, uint64_t k, uint64_t i, int64_t* out_dims) {
+  Gpack* g = (Gpack*)h;
+  const GpackKey& key = g->keys[k];
+  int64_t count = 1;
+  for (uint32_t d = 0; d < key.ndim; ++d) {
+    out_dims[d] = key.dims[i * key.ndim + d];
+    count *= out_dims[d];
+  }
+  return count;
+}
+
+// Zero-copy pointer to sample i of key k.
+const void* gpack_sample_ptr(void* h, uint64_t k, uint64_t i) {
+  Gpack* g = (Gpack*)h;
+  const GpackKey& key = g->keys[k];
+  return g->map + key.data_offset + key.offsets[i] * kDtypeSize[key.dtype];
+}
+
+// ---------------------------------------------------------------------------
+// dstore: sharded in-memory sample store with TCP remote get
+// ---------------------------------------------------------------------------
+
+struct DsKey {
+  std::string name;
+  std::vector<uint8_t> data;        // packed local shard
+  std::vector<int64_t> offsets;     // per-local-sample byte offset
+  std::vector<int64_t> nbytes;      // per-local-sample byte size
+  int64_t global_start = 0;         // first global index owned locally
+};
+
+struct Dstore {
+  std::unordered_map<std::string, DsKey> keys;
+  std::mutex mu;
+  int server_fd = -1;
+  int port = 0;
+  std::thread server;
+  std::atomic<bool> stop{false};
+};
+
+static bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = (uint8_t*)buf;
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r; n -= r;
+  }
+  return true;
+}
+
+static bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = (const uint8_t*)buf;
+  while (n) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) return false;
+    p += r; n -= r;
+  }
+  return true;
+}
+
+static void serve_client(Dstore* ds, int cfd) {
+  for (;;) {
+    uint32_t name_len;
+    if (!read_full(cfd, &name_len, 4)) break;
+    std::string name(name_len, '\0');
+    if (!read_full(cfd, &name[0], name_len)) break;
+    int64_t gidx;
+    if (!read_full(cfd, &gidx, 8)) break;
+
+    int64_t nbytes = -1;
+    const uint8_t* src = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(ds->mu);
+      auto it = ds->keys.find(name);
+      if (it != ds->keys.end()) {
+        DsKey& k = it->second;
+        int64_t local = gidx - k.global_start;
+        if (local >= 0 && local < (int64_t)k.offsets.size()) {
+          nbytes = k.nbytes[local];
+          src = k.data.data() + k.offsets[local];
+        }
+      }
+    }
+    if (!write_full(cfd, &nbytes, 8)) break;
+    if (nbytes > 0 && !write_full(cfd, src, nbytes)) break;
+  }
+  close(cfd);
+}
+
+static void server_loop(Dstore* ds) {
+  while (!ds->stop.load()) {
+    int cfd = accept(ds->server_fd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (ds->stop.load()) break;
+      continue;
+    }
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_client, ds, cfd).detach();
+  }
+}
+
+void* dstore_create(int port_hint) {
+  Dstore* ds = new Dstore();
+  ds->server_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(ds->server_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(port_hint);
+  if (bind(ds->server_fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(ds->server_fd);
+    delete ds;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(ds->server_fd, (sockaddr*)&addr, &len);
+  ds->port = ntohs(addr.sin_port);
+  listen(ds->server_fd, 64);
+  ds->server = std::thread(server_loop, ds);
+  return ds;
+}
+
+int dstore_port(void* h) { return ((Dstore*)h)->port; }
+
+// Register this host's shard of samples for one key: flat buffer + per-sample
+// byte sizes, owning global indices [global_start, global_start + n).
+void dstore_add(void* h, const char* name, const uint8_t* data,
+                const int64_t* sample_nbytes, int64_t n_local,
+                int64_t global_start) {
+  Dstore* ds = (Dstore*)h;
+  DsKey k;
+  k.name = name;
+  k.global_start = global_start;
+  int64_t total = 0;
+  k.offsets.resize(n_local);
+  k.nbytes.resize(n_local);
+  for (int64_t i = 0; i < n_local; ++i) {
+    k.offsets[i] = total;
+    k.nbytes[i] = sample_nbytes[i];
+    total += sample_nbytes[i];
+  }
+  k.data.assign(data, data + total);
+  std::lock_guard<std::mutex> lk(ds->mu);
+  ds->keys[name] = std::move(k);
+}
+
+// Local read: returns nbytes, copies into out (or -1 when not local).
+int64_t dstore_get_local(void* h, const char* name, int64_t gidx,
+                         uint8_t* out, int64_t out_cap) {
+  Dstore* ds = (Dstore*)h;
+  std::lock_guard<std::mutex> lk(ds->mu);
+  auto it = ds->keys.find(name);
+  if (it == ds->keys.end()) return -1;
+  DsKey& k = it->second;
+  int64_t local = gidx - k.global_start;
+  if (local < 0 || local >= (int64_t)k.offsets.size()) return -1;
+  int64_t n = k.nbytes[local];
+  if (out && n <= out_cap)
+    memcpy(out, k.data.data() + k.offsets[local], n);
+  return n;
+}
+
+// Remote read over TCP; returns nbytes (or -1).  One connection per call —
+// callers cache connections via dstore_connect/dstore_fetch for hot paths.
+int dstore_connect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+int64_t dstore_fetch(int fd, const char* name, int64_t gidx,
+                     uint8_t* out, int64_t out_cap) {
+  uint32_t name_len = (uint32_t)strlen(name);
+  if (!write_full(fd, &name_len, 4)) return -1;
+  if (!write_full(fd, name, name_len)) return -1;
+  if (!write_full(fd, &gidx, 8)) return -1;
+  int64_t nbytes;
+  if (!read_full(fd, &nbytes, 8)) return -1;
+  if (nbytes <= 0) return nbytes;
+  if (nbytes > out_cap) {
+    // drain to keep the stream aligned
+    std::vector<uint8_t> tmp(nbytes);
+    read_full(fd, tmp.data(), nbytes);
+    return -2;
+  }
+  if (!read_full(fd, out, nbytes)) return -1;
+  return nbytes;
+}
+
+void dstore_disconnect(int fd) { close(fd); }
+
+void dstore_destroy(void* h) {
+  Dstore* ds = (Dstore*)h;
+  ds->stop.store(true);
+  shutdown(ds->server_fd, SHUT_RDWR);
+  close(ds->server_fd);
+  if (ds->server.joinable()) ds->server.join();
+  delete ds;
+}
+
+}  // extern "C"

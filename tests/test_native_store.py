@@ -1,0 +1,113 @@
+"""Native runtime tests: gpack container round-trip (native + numpy readers)
+and the DistDataset store incl. a real TCP remote get against the local
+server (the single-host analog of DDStore remote reads)."""
+
+import ctypes
+import pickle
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.native import available, load_library
+
+
+def _samples(n=10, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        nn = rng.randint(3, 9)
+        ne = rng.randint(2, 12)
+        out.append(GraphSample(
+            x=rng.rand(nn, 3).astype(np.float32),
+            pos=rng.rand(nn, 3).astype(np.float32),
+            edge_index=rng.randint(0, nn, (2, ne)).astype(np.int32),
+            graph_y=rng.rand(2).astype(np.float32),
+            node_y=rng.rand(nn, 3).astype(np.float32),
+        ))
+    return out
+
+
+def test_native_library_builds():
+    assert available(), "native hydrastore library failed to build"
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_gpack_roundtrip(tmp_path, use_native):
+    from hydragnn_tpu.data.gpack import GpackDataset, GpackWriter
+
+    samples = _samples(12)
+    path = str(tmp_path / "ds.gpack")
+    GpackWriter(path, rank=0, attrs={
+        "pna_deg": [0, 3, 5], "minmax": [[0.0], [1.0]]}).save(samples)
+
+    ds = GpackDataset(path, use_native=use_native)
+    assert len(ds) == 12
+    assert ds.attrs["pna_deg"] == [0, 3, 5]
+    for i in (0, 5, 11):
+        got = ds.get(i)
+        np.testing.assert_array_equal(got.x, samples[i].x)
+        np.testing.assert_array_equal(got.pos, samples[i].pos)
+        np.testing.assert_array_equal(got.edge_index, samples[i].edge_index)
+        np.testing.assert_array_equal(got.graph_y, samples[i].graph_y)
+    ds.close()
+
+
+def test_gpack_multipart_and_subset(tmp_path):
+    from hydragnn_tpu.data.gpack import GpackDataset, GpackWriter
+
+    s0, s1 = _samples(5, seed=1), _samples(7, seed=2)
+    base = str(tmp_path / "multi.gpack")
+    GpackWriter(base, rank=0).save(s0)
+    GpackWriter(base, rank=1).save(s1)
+
+    ds = GpackDataset(base)
+    assert len(ds) == 12
+    np.testing.assert_array_equal(ds.get(3).x, s0[3].x)
+    np.testing.assert_array_equal(ds.get(5).x, s1[0].x)
+    np.testing.assert_array_equal(ds.get(11).x, s1[6].x)
+
+    ds.setsubset(5, 12, preload=True)
+    assert len(ds) == 7
+    np.testing.assert_array_equal(ds.get(0).x, s1[0].x)
+    ds.close()
+
+
+def test_distdataset_local_get():
+    from hydragnn_tpu.data.distdataset import DistDataset
+
+    samples = _samples(8, seed=3)
+    ds = DistDataset(samples)
+    assert len(ds) == 8
+    for i in (0, 4, 7):
+        got = ds.get(i)
+        np.testing.assert_array_equal(got.x, samples[i].x)
+    ds.close()
+
+
+def test_dstore_tcp_remote_get():
+    """Exercise the TCP path explicitly against the local server."""
+    lib = load_library()
+    store = lib.dstore_create(0)
+    assert store
+    port = lib.dstore_port(store)
+
+    blobs = [pickle.dumps({"i": i, "a": np.arange(i + 1)}) for i in range(5)]
+    sizes = np.asarray([len(b) for b in blobs], np.int64)
+    lib.dstore_add(store, b"k", b"".join(blobs),
+                   sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                   5, 100)  # global indices 100..104
+
+    fd = lib.dstore_connect(b"127.0.0.1", port)
+    assert fd >= 0
+    buf = ctypes.create_string_buffer(1 << 16)
+    for gidx in (100, 103, 104):
+        n = lib.dstore_fetch(fd, b"k", gidx, buf, len(buf))
+        assert n > 0
+        obj = pickle.loads(buf.raw[:n])
+        assert obj["i"] == gidx - 100
+        np.testing.assert_array_equal(obj["a"], np.arange(gidx - 100 + 1))
+    # missing index -> -1
+    assert lib.dstore_fetch(fd, b"k", 99, buf, len(buf)) == -1
+    lib.dstore_disconnect(fd)
+    lib.dstore_destroy(store)
