@@ -1,0 +1,84 @@
+"""Synthetic Lennard-Jones dataset generator.
+
+Writes configurations in the reference LJ example's text format
+(reference examples/LennardJones/train.py:81-143 reads: line 1 total energy,
+lines 2-4 the 3x3 supercell, then per-atom rows
+``type x y z potential fx fy fz``): perturbed cubic lattices with periodic
+minimum-image LJ energy and analytic forces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def lj_energy_forces(pos: np.ndarray, cell: float, epsilon: float = 1.0,
+                     sigma: float = 1.0, cutoff: float = 2.5):
+    """Total energy, per-atom potential, and forces with PBC minimum image."""
+    n = pos.shape[0]
+    delta = pos[:, None, :] - pos[None, :, :]
+    delta -= cell * np.round(delta / cell)
+    r2 = (delta ** 2).sum(-1)
+    np.fill_diagonal(r2, np.inf)
+    mask = r2 < cutoff ** 2
+    inv_r2 = np.where(mask, sigma ** 2 / np.maximum(r2, 1e-12), 0.0)
+    inv_r6 = inv_r2 ** 3
+    inv_r12 = inv_r6 ** 2
+    pair_e = np.where(mask, 4.0 * epsilon * (inv_r12 - inv_r6), 0.0)
+    per_atom = 0.5 * pair_e.sum(1)
+    total = per_atom.sum()
+    # dE/dr_i: F_i = sum_j 24 eps (2 (s/r)^12 - (s/r)^6) / r^2 * delta_ij
+    coeff = np.where(
+        mask, 24.0 * epsilon * (2.0 * inv_r12 - inv_r6) / np.maximum(r2, 1e-12),
+        0.0)
+    forces = (coeff[:, :, None] * delta).sum(1)
+    return total, per_atom, forces
+
+
+def generate(path: str, num_configs: int = 300, cells_per_dim: int = 3,
+             spacing: float = 1.122, jitter: float = 0.05, seed: int = 0,
+             min_dist: float = 1.0):
+    """Perturbed cubic lattices (spacing ~ LJ minimum 2^(1/6) sigma).
+
+    Configurations whose closest pair falls under ``min_dist`` are re-drawn —
+    the r^-12 wall otherwise produces unlearnably extreme energies/forces.
+    """
+    rng = np.random.RandomState(seed)
+    os.makedirs(path, exist_ok=True)
+    cell = cells_per_dim * spacing
+    base = np.stack(np.meshgrid(
+        *[np.arange(cells_per_dim) * spacing] * 3, indexing="ij"),
+        axis=-1).reshape(-1, 3)
+    for c in range(num_configs):
+        for _attempt in range(100):
+            pos = (base + rng.randn(*base.shape) * jitter) % cell
+            delta = pos[:, None, :] - pos[None, :, :]
+            delta -= cell * np.round(delta / cell)
+            r2 = (delta ** 2).sum(-1)
+            np.fill_diagonal(r2, np.inf)
+            if np.sqrt(r2.min()) >= min_dist:
+                break
+        total, per_atom, forces = lj_energy_forces(pos, cell)
+        lines = [f"{total:.10f}"]
+        H = np.eye(3) * cell
+        for row in H:
+            lines.append("\t".join(f"{v:.10f}" for v in row))
+        for i in range(pos.shape[0]):
+            row = [1.0, *pos[i], per_atom[i], *forces[i]]
+            lines.append("\t".join(f"{v:.10f}" for v in row))
+        with open(os.path.join(path, f"config{c:05d}.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", default="dataset/data")
+    ap.add_argument("--num_configs", type=int, default=300)
+    ap.add_argument("--cells_per_dim", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    generate(args.path, args.num_configs, args.cells_per_dim, seed=args.seed)
+    print(f"wrote {args.num_configs} configurations under {args.path}")

@@ -1,0 +1,228 @@
+"""LennardJones example: energy + forces multitask with the
+energy-gradient self-consistency loss.
+
+Canonical example-driver shape (parity: reference
+examples/LennardJones/train.py:153-394 and SURVEY.md §3.4): argparse ->
+custom AbstractBaseDataset over raw files -> split -> loaders -> finalized
+config -> model -> train loop -> test metrics.  ``--preonly`` serializes the
+dataset to the gpack container (the ADIOS path analog) and exits;
+``--ddstore`` wraps the dataset in the distributed sample store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+
+import jax
+
+from hydragnn_tpu.config.config import (
+    DatasetStats,
+    finalize,
+    head_specs_from_config,
+    label_slices_from_config,
+)
+from hydragnn_tpu.data.abstract import AbstractBaseDataset
+from hydragnn_tpu.data.dataloader import create_dataloaders
+from hydragnn_tpu.data.raw import nsplit
+from hydragnn_tpu.data.splitting import split_dataset
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.graph.neighborlist import edge_lengths, radius_graph_pbc
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    make_eval_step,
+    test,
+    train_validate_test,
+)
+
+
+class LJDataset(AbstractBaseDataset):
+    """Read LJ text files into GraphSamples (reference LJDataset,
+    examples/LennardJones/train.py:59-152): energy per atom as the graph
+    target, forces as node targets, positions feed the PBC radius graph."""
+
+    def __init__(self, dirpath: str, radius: float = 2.8,
+                 max_neighbours: int = 30, dist: bool = False):
+        super().__init__()
+        from hydragnn_tpu.parallel.comm import num_processes, process_index
+
+        files = sorted(os.listdir(dirpath))
+        if dist:
+            files = nsplit(files, num_processes())[process_index()]
+        for fname in files:
+            self.dataset.append(
+                self._parse(os.path.join(dirpath, fname), radius,
+                            max_neighbours))
+        # Standardize per-atom energies by (mu, sigma) and forces by the SAME
+        # sigma, so forces remain exactly -d(E_scaled)/dpos * n (the
+        # grad_energy_post_scaling_factor contract; reference
+        # examples/LennardJones/train.py:118-137).
+        e = np.asarray([s.graph_y[0] for s in self.dataset])
+        f = np.concatenate([s.node_y.reshape(-1) for s in self.dataset])
+        mu, s_e = float(e.mean()), float(e.std()) or 1.0
+        s_f = float(f.std()) or 1.0
+        if dist and num_processes() > 1:
+            from hydragnn_tpu.parallel.comm import host_allreduce
+
+            st = host_allreduce(np.asarray(
+                [e.sum(), (e ** 2).sum(), len(e),
+                 f.sum(), (f ** 2).sum(), len(f)]), "sum")
+            mu = st[0] / st[2]
+            s_e = float(np.sqrt(max(st[1] / st[2] - mu ** 2, 1e-12)))
+            s_f = float(np.sqrt(max(st[4] / st[5] - (st[3] / st[5]) ** 2,
+                                    1e-12)))
+        self.energy_mu, self.energy_sigma, self.forces_sigma = mu, s_e, s_f
+        for s in self.dataset:
+            n = s.num_nodes
+            s.graph_y = ((s.graph_y - mu) / s_e).astype(np.float32)
+            s.node_y = (s.node_y / s_f).astype(np.float32)
+            # d(E_scaled)/dpos * (n * s_e / s_f) == -F_scaled exactly
+            s.extras["grad_energy_post_scaling_factor"] = np.full(
+                (n, 1), float(n) * s_e / s_f, np.float32)
+
+    @staticmethod
+    def _parse(filepath: str, radius: float, max_neighbours: int) -> GraphSample:
+        with open(filepath) as f:
+            lines = f.read().splitlines()
+        total_energy = float(lines[0])
+        cell = np.asarray([[float(v) for v in lines[1 + i].split()]
+                           for i in range(3)])
+        rows = np.asarray([[float(v) for v in ln.split()]
+                           for ln in lines[4:] if ln.strip()])
+        pos = rows[:, 1:4]
+        forces = rows[:, 5:8]
+        n = rows.shape[0]
+        energy_per_atom = total_energy / n
+
+        edge_index, lengths = radius_graph_pbc(
+            pos, cell, radius, max_neighbours=max_neighbours,
+            check_duplicates=False)
+        # local-environment descriptors: smooth radial densities per atom
+        # (keeps within-batch feature variance healthy for BatchNorm models)
+        n_at = pos.shape[0]
+        d1 = np.zeros(n_at)
+        d2 = np.zeros(n_at)
+        np.add.at(d1, edge_index[1], (1.0 - lengths / radius) ** 2)
+        np.add.at(d2, edge_index[1], np.exp(-(lengths / 1.2) ** 2))
+        x_feat = np.stack([rows[:, 0], d1, d2], axis=1)
+        return GraphSample(
+            x=x_feat.astype(np.float32),         # type + env descriptors
+            pos=pos,
+            edge_index=edge_index,
+            edge_attr=lengths.reshape(-1, 1) / max(radius, 1e-9),
+            graph_y=np.asarray([energy_per_atom], np.float32),
+            node_y=forces.astype(np.float32),
+            cell=cell,
+            extras={
+                # d(energy_per_atom)/dpos must be rescaled by n before being
+                # compared with the raw forces (reference
+                # examples/LennardJones/train.py:118-137)
+                "grad_energy_post_scaling_factor": np.full((n, 1), float(n),
+                                                           np.float32),
+            },
+        )
+
+    def len(self):
+        return len(self.dataset)
+
+    def get(self, idx):
+        return self.dataset[idx]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inputfile", default=os.path.join(_HERE, "LJ.json"))
+    ap.add_argument("--data", default=os.path.join(_HERE, "dataset/data"))
+    ap.add_argument("--preonly", action="store_true",
+                    help="serialize to gpack and exit")
+    ap.add_argument("--gpack", default=os.path.join(_HERE, "dataset/LJ.gpack"))
+    ap.add_argument("--use_gpack", action="store_true")
+    ap.add_argument("--ddstore", action="store_true")
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--batch_size", type=int, default=None)
+    args = ap.parse_args()
+
+    with open(args.inputfile) as f:
+        config = json.load(f)
+    training = config["NeuralNetwork"]["Training"]
+    if args.num_epoch:
+        training["num_epoch"] = args.num_epoch
+    if args.batch_size:
+        training["batch_size"] = args.batch_size
+
+    if not os.path.isdir(args.data) or not os.listdir(args.data):
+        from generate_data import generate
+
+        print("generating LJ dataset...")
+        generate(args.data, num_configs=300)
+
+    arch = config["NeuralNetwork"]["Architecture"]
+    if args.use_gpack and os.path.exists(args.gpack + ".p0"):
+        from hydragnn_tpu.data.gpack import GpackDataset
+
+        samples = list(GpackDataset(args.gpack, preload=True))
+    else:
+        samples = list(LJDataset(
+            args.data, radius=float(arch.get("radius", 2.8)),
+            max_neighbours=int(arch.get("max_neighbours", 30))))
+
+    if args.preonly:
+        from hydragnn_tpu.data.gpack import GpackWriter
+
+        GpackWriter(args.gpack, rank=0).save(samples)
+        print(f"serialized {len(samples)} samples to {args.gpack}.p0")
+        return
+
+    trainset, valset, testset = split_dataset(
+        samples, training["perc_train"])
+    if args.ddstore:
+        from hydragnn_tpu.data.distdataset import DistDataset
+
+        trainset = list(DistDataset(trainset))
+
+    stats = DatasetStats.from_samples(
+        samples, need_deg=arch["model_type"] == "PNA")
+    config = finalize(config, stats)
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    head_specs = head_specs_from_config(config)
+    gslices, nslices = label_slices_from_config(config)
+    bs = int(training["batch_size"])
+    n_local = len(jax.local_devices())
+    if n_local > 1:
+        bs = max(1, -(-bs // n_local))
+    train_l, val_l, test_l = create_dataloaders(
+        trainset, valset, testset, bs, head_specs,
+        graph_feature_slices=gslices, node_feature_slices=nslices)
+
+    opt_spec = select_optimizer(training["Optimizer"])
+    state = create_train_state(model, next(iter(train_l)), opt_spec)
+
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, train_l, val_l, test_l,
+        config["NeuralNetwork"], "LJ", verbosity=1)
+
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    error, tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads)
+    names = config["NeuralNetwork"]["Variables_of_interest"]["output_names"]
+    print(f"test loss: {error:.6f}")
+    for i, name in enumerate(names):
+        mae = float(np.abs(np.asarray(tv[i]) - np.asarray(pv[i])).mean())
+        print(f"  head {name}: mse {tasks[i]:.6f} mae {mae:.6f}")
+    return error
+
+
+if __name__ == "__main__":
+    main()

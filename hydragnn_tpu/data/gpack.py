@@ -118,6 +118,8 @@ class GpackWriter:
             v = getattr(s, k, None)
             if v is not None:
                 out[k] = v
+        for k, v in (getattr(s, "extras", None) or {}).items():
+            out[f"extra:{k}"] = v
         return out
 
 
@@ -244,6 +246,11 @@ class GpackDataset(AbstractBaseDataset):
         i = gidx - int(self._bounds[part_id])
         get = lambda k: part.get(k, i)
         x = get("x")
+        extras = {
+            name.split(":", 1)[1]: np.array(part.get(name, i))
+            for name in getattr(part, "keys", {})
+            if name.startswith("extra:")
+        }
         return GraphSample(
             x=np.array(x),
             pos=np.array(get("pos")),
@@ -252,6 +259,7 @@ class GpackDataset(AbstractBaseDataset):
             graph_y=_maybe(get("graph_y")),
             node_y=_maybe(get("node_y")),
             cell=_maybe(get("cell")),
+            extras=extras,
         )
 
     def len(self) -> int:

@@ -31,7 +31,10 @@ class EGCL(nn.Module):
 
         diff = pos[src] - pos[dst]
         radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
-        diff = diff / (jnp.sqrt(radial) + 1.0)  # norm_diff=True
+        # eps inside the sqrt: padding self-edges have radial == 0 exactly,
+        # where sqrt's gradient is inf — this path must stay differentiable
+        # for the energy-gradient force loss (jax.grad wrt pos).
+        diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)  # norm_diff=True
 
         parts = [x[src], x[dst], radial]
         if self.edge_dim and g.edge_attr is not None:

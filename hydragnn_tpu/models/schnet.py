@@ -47,7 +47,10 @@ class SCFConv(nn.Module):
         if self.use_edge_attr and g.edge_attr is not None:
             w = jnp.linalg.norm(g.edge_attr, axis=-1)
         else:
-            w = jnp.linalg.norm(pos[src] - pos[dst] + 1e-12, axis=-1)
+            d = pos[src] - pos[dst]
+            # eps inside the sqrt keeps the gradient finite on padding
+            # self-edges (distance exactly 0) for jax.grad wrt positions
+            w = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
         rbf = gaussian_smearing(w, self.cutoff, self.num_gaussians)
 
         # cosine envelope, hard-zeroed beyond the cutoff (edge topology is
@@ -64,7 +67,7 @@ class SCFConv(nn.Module):
         if self.equivariant:
             diff = pos[src] - pos[dst]
             radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
-            diff = diff / (jnp.sqrt(radial) + 1.0)
+            diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)
             cmlp = nn.Dense(self.num_filters, name="coord_mlp_0")(filt)
             cmlp = nn.relu(cmlp)
             cmlp = nn.Dense(

@@ -71,3 +71,81 @@ def allgather_counts(local_count: int) -> List[int]:
     """Per-host counts (for rank-offset file naming, writer layouts)."""
     out = host_allgather(np.asarray([local_count], dtype=np.int64))
     return [int(c) for c in out.reshape(-1)]
+
+
+def host_allgather_variable(arr: np.ndarray) -> np.ndarray:
+    """Gather variable-length arrays across hosts by padding to the global
+    max then stripping (parity: reference gather_tensor_ranks padding trick,
+    hydragnn/train/train_validate_test.py:381-419)."""
+    import jax
+
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr
+    flat = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 else arr[:, None]
+    counts = allgather_counts(flat.shape[0])
+    width = flat.shape[1]
+    maxn = max(counts)
+    padded = np.zeros((maxn, width), flat.dtype)
+    padded[: flat.shape[0]] = flat
+    stacked = host_allgather(padded)  # [n_hosts, maxn, width]
+    parts = [stacked[r, : counts[r]] for r in range(len(counts))]
+    out = np.concatenate(parts, axis=0)
+    if arr.ndim == 1:
+        return out[:, 0]
+    return out.reshape((-1,) + arr.shape[1:])
+
+
+class HostGroup:
+    """Subgroup of hosts working on one branch of a multi-branch ensemble.
+
+    The TPU-native analog of the reference's ``MPI.COMM_WORLD.Split`` per
+    dataset corpus (reference examples/multidataset/train.py:205-247): hosts
+    are partitioned by ``color``; collectives inside a group mask out other
+    groups' contributions (gathers go through the global runtime with
+    group-slot masking, since the JAX runtime has one global world).
+    """
+
+    def __init__(self, color: int):
+        import jax
+
+        self.color = int(color)
+        colors = host_allgather(
+            np.asarray([self.color], np.int64)).reshape(-1)
+        self.members = [i for i, c in enumerate(colors) if c == self.color]
+        self.size = len(self.members)
+        self.rank = self.members.index(jax.process_index())
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        stacked = host_allgather(np.asarray(arr))
+        if stacked.ndim == np.asarray(arr).ndim:
+            return np.asarray(arr)
+        group = stacked[self.members]
+        if op == "sum":
+            return group.sum(0)
+        if op == "min":
+            return group.min(0)
+        if op == "max":
+            return group.max(0)
+        raise ValueError(op)
+
+    def mean_scalar(self, value: float) -> float:
+        return float(self.allreduce(np.asarray([value]), "sum")[0] / self.size)
+
+
+def assign_ensemble_groups(weights: Sequence[float]) -> int:
+    """Proportional host allocation over ensemble branches; returns this
+    host's branch color (parity with the reference's proportional rank
+    allocation, examples/multidataset/train.py:205-228)."""
+    import jax
+
+    n = jax.process_count()
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+    alloc = np.maximum(1, np.floor(w * n).astype(int))
+    while alloc.sum() > n:
+        alloc[int(np.argmax(alloc))] -= 1
+    while alloc.sum() < n:
+        alloc[int(np.argmax(w - alloc / n))] += 1
+    bounds = np.cumsum(alloc)
+    return int(np.searchsorted(bounds, jax.process_index(), side="right"))

@@ -498,10 +498,15 @@ def test(
     true_cat = [np.concatenate(v, axis=0) for v in true_values]
     pred_cat = [np.concatenate(v, axis=0) for v in pred_values]
     if reduce_ranks and world_size > 1:
-        from hydragnn_tpu.parallel.comm import host_allgather, host_allreduce
+        from hydragnn_tpu.parallel.comm import (
+            host_allgather_variable,
+            host_allreduce,
+        )
 
         error = float(host_allreduce(np.asarray([error]), "sum")[0]) / world_size
         tasks = host_allreduce(tasks, "sum") / world_size
-        true_cat = [np.concatenate(list(host_allgather(t)), 0) for t in true_cat]
-        pred_cat = [np.concatenate(list(host_allgather(p)), 0) for p in pred_cat]
+        # per-host sample counts differ: padded variable-size gather
+        # (parity: reference gather_tensor_ranks, train_validate_test.py:381-419)
+        true_cat = [host_allgather_variable(t) for t in true_cat]
+        pred_cat = [host_allgather_variable(p) for p in pred_cat]
     return error, tasks, true_cat, pred_cat

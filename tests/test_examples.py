@@ -1,0 +1,45 @@
+"""Run example drivers as subprocesses and assert exit 0 (parity: reference
+tests/test_examples.py:18-26, which runs qm9 and md17)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(example, args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", example, "train.py"),
+         *args],
+        cwd=os.path.join(_REPO, "examples", example),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+
+
+@pytest.mark.parametrize("example", ["LennardJones", "qm9", "md17"])
+def test_example_runs(example, tmp_path):
+    r = _run(example, ["--num_epoch", "3",
+                       "--data", str(tmp_path / "data")])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_lj_preonly_gpack_roundtrip(tmp_path):
+    data = str(tmp_path / "data")
+    gpack = str(tmp_path / "LJ.gpack")
+    r = _run("LennardJones",
+             ["--preonly", "--data", data, "--gpack", gpack])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert os.path.exists(gpack + ".p0")
+    r = _run("LennardJones",
+             ["--use_gpack", "--gpack", gpack, "--data", data,
+              "--num_epoch", "2"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
