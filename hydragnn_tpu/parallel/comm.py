@@ -142,6 +142,9 @@ def assign_ensemble_groups(weights: Sequence[float]) -> int:
     n = jax.process_count()
     w = np.asarray(weights, np.float64)
     w = w / w.sum()
+    if n < len(w):
+        # fewer hosts than branches: round-robin coverage
+        return int(jax.process_index() % len(w))
     alloc = np.maximum(1, np.floor(w * n).astype(int))
     while alloc.sum() > n:
         alloc[int(np.argmax(alloc))] -= 1

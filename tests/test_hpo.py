@@ -1,0 +1,47 @@
+"""HPO glue tests: built-in random search + halving over a tiny training,
+launch-command builders (parity: reference qm9_hpo/optuna drivers and
+utils/deephyper.py)."""
+
+import json
+import os
+
+import hydragnn_tpu
+from hydragnn_tpu.hpo import HP, build_launch_command, read_node_list, run_hpo
+from test_graphs import _generate_data
+
+
+def test_run_hpo_random():
+    with open(os.path.join(os.path.dirname(__file__), "inputs", "ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    _generate_data(config, num_samples_tot=60)
+
+    space = [
+        HP("lr", ["NeuralNetwork", "Training", "Optimizer", "learning_rate"],
+           low=1e-3, high=3e-2, log=True),
+        HP("hidden_dim", ["NeuralNetwork", "Architecture", "hidden_dim"],
+           choices=[8, 16]),
+    ]
+    best, trials = run_hpo(config, space, n_trials=2, seed=0)
+    assert len(trials) == 2
+    assert best.value < float("inf")
+    assert "lr" in best.params and "hidden_dim" in best.params
+
+
+def test_launch_command_builders(monkeypatch):
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    monkeypatch.delenv("SLURM_NODELIST", raising=False)
+    monkeypatch.delenv("LSB_HOSTS", raising=False)
+    assert read_node_list() == ["localhost"]
+
+    monkeypatch.setenv("SLURM_NODELIST", "frontier[00001-00002]")
+    assert read_node_list() == ["frontier00001", "frontier00002"]
+
+    cmd = build_launch_command("trial.py", ["n1", "n2"], procs_per_node=4,
+                               system="frontier", extra_args=["--lr", "0.1"])
+    assert cmd[0] == "srun" and "-n" in cmd and "8" in cmd
+    assert cmd[-2:] == ["--lr", "0.1"]
+
+    cmd = build_launch_command("trial.py", ["localhost"], system="")
+    assert cmd[0].endswith("python") or "python" in cmd[0]
