@@ -1,0 +1,60 @@
+"""Worker for the 2-process distributed CI leg (the reference's
+``mpirun -n 2 pytest --with-mpi`` analog, SURVEY.md §4): initializes
+jax.distributed over CPU, runs a small end-to-end training through
+run_training (rank-sharded loaders, cross-host metric reduction,
+variable-size eval gather) and prints the final losses for the parent
+test to compare across ranks."""
+
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+world = int(sys.argv[2])
+port = sys.argv[3]
+scratch = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=world,
+    process_id=rank,
+)
+assert jax.process_count() == world
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.chdir(scratch)
+os.environ["SERIALIZED_DATA_PATH"] = scratch
+
+import numpy as np
+
+import hydragnn_tpu
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+
+with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "inputs", "ci.json")) as f:
+    config = json.load(f)
+config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+config["NeuralNetwork"]["Training"]["num_epoch"] = 6
+config["Verbosity"]["level"] = 0
+
+if rank == 0:
+    for name, path in config["Dataset"]["path"].items():
+        n = 120 if name == "train" else 30
+        os.makedirs(path, exist_ok=True)
+        if not os.listdir(path):
+            deterministic_graph_data(
+                path, number_configurations=n, seed=abs(hash(name)) % 1000)
+from hydragnn_tpu.parallel.comm import host_allreduce
+
+host_allreduce(np.zeros(1))  # barrier after data gen
+
+state, history, fconfig = hydragnn_tpu.run_training(config)
+err, tasks, tv, pv = hydragnn_tpu.run_prediction(config)
+
+print(f"MPRESULT rank={rank} val={history['val'][-1]:.8f} "
+      f"err={err:.8f} ngather={len(tv[0])}")
