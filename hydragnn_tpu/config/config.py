@@ -233,6 +233,32 @@ def label_slices_from_config(config):
     return gslices, nslices
 
 
+def normalize_output_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill ``Variables_of_interest.y_minmax`` from the serialized dataset's
+    min/max headers so predictions can be denormalized (parity: reference
+    normalize_output_config/update_config_minmax,
+    hydragnn/utils/config_utils.py:192-240)."""
+    var = config["NeuralNetwork"]["Variables_of_interest"]
+    if not var.get("denormalize_output"):
+        return config
+    import pickle
+
+    ds = config["Dataset"]
+    base = os.environ.get("SERIALIZED_DATA_PATH", os.getcwd())
+    label = "" if "total" in ds["path"] else "_train"
+    fname = os.path.join(base, "serialized_dataset",
+                         f"{ds['name']}{label}.pkl")
+    with open(fname, "rb") as f:
+        minmax_node = pickle.load(f)
+        minmax_graph = pickle.load(f)
+    y_minmax = []
+    for t, idx in zip(var["type"], var["output_index"]):
+        mm = minmax_graph if t == "graph" else minmax_node
+        y_minmax.append([float(mm[0, idx]), float(mm[1, idx])])
+    var["y_minmax"] = y_minmax
+    return config
+
+
 def get_log_name_config(config: Dict[str, Any]) -> str:
     """Run-name string, same fields as reference get_log_name_config
     (hydragnn/utils/config_utils.py:243-276)."""

@@ -97,6 +97,9 @@ def dataset_loading_and_splitting(
     if world_size > 1:
         stats = _reduce_stats_across_hosts(stats)
     config = finalize(config, stats)
+    from hydragnn_tpu.config.config import normalize_output_config
+
+    config = normalize_output_config(config)
 
     head_specs = head_specs_from_config(config)
     gslices, nslices = label_slices_from_config(config)

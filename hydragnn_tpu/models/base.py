@@ -350,6 +350,87 @@ class Base(nn.Module):
         return tuple(outputs)
 
 
+def multihead_loss_nll(
+    cfg: ModelConfig,
+    outputs: Sequence[jax.Array],
+    g: GraphBatch,
+) -> Tuple[jax.Array, List[jax.Array]]:
+    """Gaussian NLL multi-task loss for UQ heads (parity with the reference's
+    disabled stub Base.loss_nll, Base.py:322-341: each head emits [mean,
+    log_sigma] pairs; loss = 0.5*log(2*pi*sigma^2) + (x-mu)^2/(2*sigma^2))."""
+    weights = cfg.norm_task_weights
+    total = 0.0
+    per_head = []
+    for ihead, (out, head_type) in enumerate(zip(outputs, cfg.output_type)):
+        label = g.labels[ihead]
+        mask = g.graph_mask if head_type == "graph" else g.node_mask
+        dim = label.shape[-1]
+        mean, log_sigma = out[..., :dim], out[..., dim : 2 * dim]
+        var = jnp.exp(2.0 * log_sigma)
+        nll = 0.5 * jnp.log(2.0 * jnp.pi * var) + (label - mean) ** 2 / (
+            2.0 * var)
+        m = mask.reshape(mask.shape + (1,) * (nll.ndim - mask.ndim))
+        head_loss = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m) * dim, 1.0)
+        per_head.append(head_loss)
+        total = total + weights[ihead] * head_loss
+    return total, per_head
+
+
+def set_initial_bias(params, cfg: ModelConfig):
+    """Set the output-layer bias of every graph head to ``cfg.initial_bias``
+    (parity: reference Base.initial_bias for UQ, Base.py:134-139)."""
+    import flax
+
+    if cfg.initial_bias is None:
+        return params
+    flat = flax.traverse_util.flatten_dict(params)
+    # last dense index per head module
+    last_dense: Dict[str, int] = {}
+    for path in flat:
+        if len(path) >= 2 and str(path[0]).startswith("head_") and str(
+                path[1]).startswith("dense_"):
+            idx = int(str(path[1]).split("_")[1])
+            last_dense[path[0]] = max(last_dense.get(path[0], -1), idx)
+    for path in list(flat):
+        if (len(path) >= 3 and str(path[0]).startswith("head_")
+                and str(path[1]) == f"dense_{last_dense.get(path[0], -1)}"
+                and path[2] == "bias"):
+            flat[path] = jnp.full_like(flat[path], cfg.initial_bias)
+    return flax.traverse_util.unflatten_dict(flat)
+
+
+def encoder_freeze_mask(updates, frozen: bool):
+    """Zero updates for encoder conv/bn params (parity: reference
+    Base.freeze_conv, Base.py:128-132 — frozen conv layers receive no
+    gradient updates and no weight decay)."""
+    if not frozen:
+        return updates
+    import jax.tree_util as jtu
+
+    def zero_enc(path, u):
+        top = str(getattr(path[0], "key", path[0]))
+        if top.startswith("encoder_"):
+            return jnp.zeros_like(u)
+        return u
+
+    return jtu.tree_map_with_path(zero_enc, updates)
+
+
+def print_model(model: "Base", params, verbosity: int = 0) -> int:
+    """Parameter-count summary (reference utils/model.py:157-165)."""
+    import numpy as np
+
+    from hydragnn_tpu.utils.print_utils import print_distributed
+
+    leaves = jax.tree.leaves(params)
+    total = int(sum(np.prod(l.shape) for l in leaves))
+    print_distributed(
+        verbosity,
+        f"{type(model).__name__}: {len(leaves)} parameter arrays, "
+        f"{total} parameters")
+    return total
+
+
 def multihead_loss(
     cfg: ModelConfig,
     outputs: Sequence[jax.Array],

@@ -129,6 +129,9 @@ def make_dp_train_step(
 
         updates, new_opt_state = opt_spec.tx.update(
             grads, state.opt_state, state.params)
+        from hydragnn_tpu.models.base import encoder_freeze_mask
+
+        updates = encoder_freeze_mask(updates, cfg.freeze_conv)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             step=state.step + 1,

@@ -60,6 +60,10 @@ def create_train_state(
         train=False,
     )
     params = variables["params"]
+    if getattr(model, "cfg", None) is not None and model.cfg.initial_bias is not None:
+        from hydragnn_tpu.models.base import set_initial_bias
+
+        params = set_initial_bias(params, model.cfg)
     batch_stats = variables.get("batch_stats", {})
     opt_state = opt_spec.tx.init(params)
     return TrainState(
@@ -156,6 +160,9 @@ def make_train_step(
             loss_fn, has_aux=True)(state.params)
         updates, new_opt_state = opt_spec.tx.update(
             grads, state.opt_state, state.params)
+        from hydragnn_tpu.models.base import encoder_freeze_mask
+
+        updates = encoder_freeze_mask(updates, cfg.freeze_conv)
         import optax
 
         new_params = optax.apply_updates(state.params, updates)
@@ -305,7 +312,12 @@ def _run_epoch(step_fn, state, loader, train: bool):
     total = 0.0
     tasks: Optional[np.ndarray] = None
     n = 0.0
-    for g in loader:
+    # HYDRAGNN_MAX_NUM_BATCH caps batches per epoch (reference get_nbatch,
+    # train_validate_test.py:40-50 — used for weak-scaling measurement)
+    nbatch = int(os.getenv("HYDRAGNN_MAX_NUM_BATCH", "0")) or None
+    for ibatch, g in enumerate(loader):
+        if nbatch is not None and ibatch >= nbatch:
+            break
         if train:
             state, metrics = step_fn(state, g)
             n_tasks = sum(1 for k in metrics if k.startswith("task_"))
@@ -404,12 +416,16 @@ def train_validate_test(
         state, train_loss, train_tasks = _run_epoch(
             train_step, state, train_loader, True)
         tr.stop("train")
-        tr.start("validate")
-        _, val_loss, _ = _run_epoch(eval_step, state, val_loader, False)
-        tr.stop("validate")
-        tr.start("test")
-        _, test_loss, _ = _run_epoch(eval_step, state, test_loader, False)
-        tr.stop("test")
+        # HYDRAGNN_VALTEST=0 skips the val/test epochs (reference knob)
+        if int(os.getenv("HYDRAGNN_VALTEST", "1")):
+            tr.start("validate")
+            _, val_loss, _ = _run_epoch(eval_step, state, val_loader, False)
+            tr.stop("validate")
+            tr.start("test")
+            _, test_loss, _ = _run_epoch(eval_step, state, test_loader, False)
+            tr.stop("test")
+        else:
+            val_loss = test_loss = train_loss
 
         if world_size > 1:
             from hydragnn_tpu.parallel.comm import host_allreduce
@@ -476,7 +492,12 @@ def test(
     tasks = np.zeros(num_heads)
     true_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
     pred_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
-    head_types = None
+    dump_file = None
+    if int(os.getenv("HYDRAGNN_DUMP_TESTDATA", "0")):
+        # per-rank raw test dump (reference train_validate_test.py:580-623)
+        from hydragnn_tpu.parallel.comm import process_index
+
+        dump_file = open(f"testdata_rank{process_index()}.pickle", "wb")
     for g in loader:
         m = eval_step(state, g)
         ng = float(m["num_graphs"])
@@ -492,6 +513,14 @@ def test(
             mask = gm if out.shape[0] == gm.shape[0] else nm
             true_values[ih].append(lab[mask])
             pred_values[ih].append(out[mask])
+        if dump_file is not None:
+            pickle.dump(
+                {f"head{ih}": {"true": true_values[ih][-1],
+                               "pred": pred_values[ih][-1]}
+                 for ih in range(num_heads)},
+                dump_file)
+    if dump_file is not None:
+        dump_file.close()
     n = max(n, 1.0)
     error = total / n
     tasks = tasks / n
