@@ -366,11 +366,14 @@ def multihead_loss_nll(
         mask = g.graph_mask if head_type == "graph" else g.node_mask
         dim = label.shape[-1]
         mean, log_sigma = out[..., :dim], out[..., dim : 2 * dim]
+        # clamp log_sigma so padded rows cannot produce inf/NaN through exp
+        log_sigma = jnp.clip(log_sigma, -15.0, 15.0)
         var = jnp.exp(2.0 * log_sigma)
         nll = 0.5 * jnp.log(2.0 * jnp.pi * var) + (label - mean) ** 2 / (
             2.0 * var)
         m = mask.reshape(mask.shape + (1,) * (nll.ndim - mask.ndim))
-        head_loss = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m) * dim, 1.0)
+        nll = jnp.where(m > 0, nll, 0.0)
+        head_loss = jnp.sum(nll) / jnp.maximum(jnp.sum(m) * dim, 1.0)
         per_head.append(head_loss)
         total = total + weights[ihead] * head_loss
     return total, per_head
