@@ -2,24 +2,60 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-The benchmarked step is the jit'd data-parallel train step of a QM9-scale
-SchNet energy model (BASELINE.md headline config) on synthetic padded batches
-— the same step function `run_training` uses.  The reference publishes no
-throughput numbers (see BASELINE.md), so ``vs_baseline`` is the ratio against
-a recorded reference-implementation measurement when available in
-``BASELINE.json["published"]``, else 1.0.
+The benchmarked step is the jit'd train step of a QM9-scale SchNet energy
+model (BASELINE.md headline config) on synthetic padded batches — the same
+step function ``run_training`` uses.  The reference publishes no throughput
+numbers (see BASELINE.md), so ``vs_baseline`` is the ratio against a recorded
+measurement in ``BASELINE.json["published"]`` when available, else 1.0.
+
+Robustness (round-1 BENCH rc=1 post-mortem): the environment pre-registers a
+TPU plugin whose backend init can either fail (UNAVAILABLE) or block forever
+when the chip/tunnel is down.  The measurement therefore runs in a CHILD
+process under a hard timeout; the parent tries the TPU twice, falls back to
+CPU, and always prints a JSON line — even on total failure (value 0 plus an
+"error" diagnostic), so the driver records something parseable.
+
+Env knobs: HYDRAGNN_BENCH_PLATFORM=tpu|cpu|auto (default auto),
+HYDRAGNN_BENCH_TIMEOUT (seconds per TPU attempt, default 420).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+METRIC = "qm9_schnet_train_throughput"
+UNIT = "graphs/sec/chip"
 
 
-def main() -> None:
+def _baseline_ratio(graphs_per_sec: float) -> float:
+    published = {}
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BASELINE.json")) as f:
+            published = json.load(f).get("published", {}) or {}
+    except Exception:
+        pass
+    base = published.get("graphs_per_sec_per_chip")
+    return (graphs_per_sec / float(base)) if base else 1.0
+
+
+def _child(platform: str) -> None:
+    """Run the measurement and print the JSON line.  May hang/crash on a bad
+    TPU backend — the parent enforces the timeout."""
     import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    devs = jax.devices()
+    print(f"bench: platform={devs[0].platform} devices={len(devs)}",
+          file=sys.stderr)
 
     from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
     from hydragnn_tpu.graph.neighborlist import radius_graph
@@ -68,10 +104,13 @@ def main() -> None:
 
     batch = jax.device_put(batch)
     # warmup + compile
+    t_c = time.perf_counter()
     state, m = step(state, batch)
     jax.block_until_ready(m["loss"])
+    print(f"bench: compile+first step {time.perf_counter() - t_c:.1f}s",
+          file=sys.stderr)
 
-    n_iters = 50
+    n_iters = 50 if devs[0].platform != "cpu" else 5
     t0 = time.perf_counter()
     for _ in range(n_iters):
         state, m = step(state, batch)
@@ -79,23 +118,76 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     graphs_per_sec = batch_size * n_iters / dt
-
-    published = {}
-    try:
-        with open("BASELINE.json") as f:
-            published = json.load(f).get("published", {}) or {}
-    except Exception:
-        pass
-    base = published.get("graphs_per_sec_per_chip")
-    vs_baseline = (graphs_per_sec / float(base)) if base else 1.0
-
+    # the recorded baseline is a TPU number — a CPU-fallback run must not be
+    # ratioed against it (it would read as a huge phantom regression)
+    ratio = (_baseline_ratio(graphs_per_sec)
+             if devs[0].platform != "cpu" else 1.0)
     print(json.dumps({
-        "metric": "qm9_schnet_train_throughput",
+        "metric": METRIC,
         "value": round(graphs_per_sec, 2),
-        "unit": "graphs/sec/chip",
-        "vs_baseline": round(vs_baseline, 4),
+        "unit": UNIT,
+        "vs_baseline": round(ratio, 4),
+        "platform": devs[0].platform,
+    }))
+
+
+def _try_child(platform: str, timeout: float):
+    """Run the child; return the parsed JSON dict or None."""
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        # let the pre-registered TPU plugin claim the backend
+        env.pop("JAX_PLATFORMS", None)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"bench: {platform} attempt timed out after {timeout:.0f}s "
+              "(backend init hang?)", file=sys.stderr)
+        return None
+    if p.stderr:
+        sys.stderr.write(p.stderr[-2000:])
+    if p.returncode != 0:
+        print(f"bench: {platform} attempt rc={p.returncode}", file=sys.stderr)
+        return None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+            if d.get("metric") == METRIC:
+                return d
+        except (json.JSONDecodeError, AttributeError):
+            continue
+    print(f"bench: {platform} attempt printed no JSON line", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    want = os.getenv("HYDRAGNN_BENCH_PLATFORM", "auto").lower()
+    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "420"))
+    attempts = []
+    if want in ("auto", "tpu"):
+        attempts += [("tpu", tpu_timeout), ("tpu", tpu_timeout)]
+    if want in ("auto", "cpu"):
+        attempts += [("cpu", 1200.0)]
+    for platform, timeout in attempts:
+        result = _try_child(platform, timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+    # total failure: still emit a parseable line with diagnostics
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        "error": "all benchmark attempts failed (see stderr)",
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
+    else:
+        main()

@@ -62,7 +62,11 @@ class SCFConv(nn.Module):
         filt = nn.Dense(self.num_filters, name="filter_1")(filt)
         filt = filt * cut[:, None] * g.edge_mask[:, None]
 
-        h = nn.Dense(self.num_filters, use_bias=False, name="lin1")(x)
+        # xavier-uniform init on lin1/lin2, zero bias — parity with reference
+        # CFConv.reset_parameters (SCFStack.py:185-188)
+        h = nn.Dense(self.num_filters, use_bias=False,
+                     kernel_init=nn.initializers.xavier_uniform(),
+                     name="lin1")(x)
 
         if self.equivariant:
             diff = pos[src] - pos[dst]
@@ -73,8 +77,11 @@ class SCFConv(nn.Module):
             cmlp = nn.Dense(
                 1,
                 use_bias=False,
+                # torch xavier_uniform_(gain=g) has std g*sqrt(2/fan_avg*... )
+                # => variance_scaling needs scale = g^2 (reference
+                # SCFStack.py:162-163, gain 0.001)
                 kernel_init=nn.initializers.variance_scaling(
-                    0.001, "fan_avg", "uniform"
+                    1e-6, "fan_avg", "uniform"
                 ),
                 name="coord_mlp_1",
             )(cmlp)
@@ -84,7 +91,9 @@ class SCFConv(nn.Module):
             pos = pos + segment.segment_mean(trans, src, n, g.edge_mask)
 
         agg = segment.segment_sum(h[src] * filt, dst, n, g.edge_mask)
-        out = nn.Dense(self.out_dim, name="lin2")(agg)
+        out = nn.Dense(self.out_dim,
+                       kernel_init=nn.initializers.xavier_uniform(),
+                       name="lin2")(agg)
         return out, pos
 
 

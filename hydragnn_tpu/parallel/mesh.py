@@ -68,7 +68,12 @@ def setup_distributed() -> Tuple[int, int]:
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
               axis: str = DATA_AXIS) -> Mesh:
-    """1-axis data mesh over all (or given) devices."""
+    """1-axis data mesh over all (or given) devices.
+
+    In a multi-process run the default covers EVERY process's devices — the
+    train step is one global computation and gradients psum across hosts
+    (DDP parity, reference train_validate_test.py:496), not per-host.
+    """
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(np.asarray(devices), (axis,))
 
@@ -79,9 +84,35 @@ def stack_batches(batches: Sequence[GraphBatch]) -> GraphBatch:
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place every state leaf replicated over the mesh."""
+    """Place every state leaf replicated over the mesh.
+
+    Works for meshes spanning non-addressable devices (multi-host): every
+    process must call this with the same host values (params come from the
+    same seed on every host).
+    """
     repl = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, repl), state)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, repl, lambda idx: x[idx])
+
+    return jax.tree.map(put, state)
+
+
+def global_batch(stacked: GraphBatch, mesh: Mesh,
+                 axis: str = DATA_AXIS) -> GraphBatch:
+    """Assemble a host-local device-stacked batch [d_local, ...] into a global
+    array [d_global, ...] sharded along ``axis`` (the multi-host analog of
+    DDP's per-rank batches; one jit sees the whole global batch)."""
+    n_proc = jax.process_count()
+
+    def conv(x):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, P(axis))
+        global_shape = (x.shape[0] * n_proc,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    return jax.tree.map(conv, stacked)
 
 
 def make_dp_train_step(
@@ -212,6 +243,14 @@ class DeviceStackLoader:
         self.loader = loader
         self.n_devices = n_devices
         self.drop_last = drop_last
+        if drop_last and len(loader) < n_devices:
+            import warnings
+
+            warnings.warn(
+                f"DeviceStackLoader: wrapped loader has {len(loader)} batches "
+                f"per epoch but {n_devices} devices; with drop_last=True the "
+                "epoch yields ZERO steps — shrink batch_size or the device "
+                "count", stacklevel=2)
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
@@ -238,3 +277,25 @@ class DeviceStackLoader:
             while len(group) < self.n_devices:
                 group.append(empty)
             yield stack_batches(group)
+
+
+class GlobalBatchLoader:
+    """Wrap a DeviceStackLoader so its host-local [d_local, ...] stacks become
+    global arrays [d_global, ...] sharded over a multi-host mesh.  Every
+    process must iterate in lockstep (per-rank batch counts are equalized by
+    the loaders' wrap-padding)."""
+
+    def __init__(self, loader, mesh: Mesh, axis: str = DATA_AXIS):
+        self.loader = loader
+        self.mesh = mesh
+        self.axis = axis
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self):
+        for stacked in self.loader:
+            yield global_batch(stacked, self.mesh, self.axis)

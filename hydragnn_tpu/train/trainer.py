@@ -367,26 +367,37 @@ def train_validate_test(
     output_names = config_nn["Variables_of_interest"].get("output_names")
 
     n_local_devices = len(jax.local_devices())
+    n_proc = jax.process_count()
     if use_mesh_dp is None:
-        use_mesh_dp = n_local_devices > 1
+        # multi-process runs MUST take the global-mesh path even with one
+        # device per process: the local-jit path would never synchronize
+        # gradients and each rank would train a divergent model.
+        use_mesh_dp = n_local_devices > 1 or n_proc > 1
     if use_mesh_dp:
         from hydragnn_tpu.parallel.mesh import (
             DeviceStackLoader,
+            GlobalBatchLoader,
             make_dp_eval_step,
             make_dp_train_step,
             make_mesh,
             replicate_state,
         )
 
-        mesh = make_mesh()
+        mesh = make_mesh()  # global: every process's devices
         state = replicate_state(state, mesh)
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names)
         eval_step = make_dp_eval_step(model, cfg, mesh)
-        n_dev = len(mesh.devices)
-        train_loader = DeviceStackLoader(train_loader, n_dev, drop_last=True)
-        val_loader = DeviceStackLoader(val_loader, n_dev, drop_last=False)
-        test_loader = DeviceStackLoader(test_loader, n_dev, drop_last=False)
+        train_loader = DeviceStackLoader(
+            train_loader, n_local_devices, drop_last=True)
+        val_loader = DeviceStackLoader(
+            val_loader, n_local_devices, drop_last=False)
+        test_loader = DeviceStackLoader(
+            test_loader, n_local_devices, drop_last=False)
+        if n_proc > 1:
+            train_loader = GlobalBatchLoader(train_loader, mesh)
+            val_loader = GlobalBatchLoader(val_loader, mesh)
+            test_loader = GlobalBatchLoader(test_loader, mesh)
     else:
         train_step = jax.jit(
             make_train_step(model, cfg, opt_spec, output_names),
@@ -427,7 +438,9 @@ def train_validate_test(
         else:
             val_loss = test_loss = train_loss
 
-        if world_size > 1:
+        if world_size > 1 and not use_mesh_dp:
+            # local-jit fallback only: the global-mesh step already psums
+            # losses across every process's devices inside the jit.
             from hydragnn_tpu.parallel.comm import host_allreduce
             reduced = host_allreduce(
                 np.asarray([train_loss, val_loss, test_loss]), op="sum")

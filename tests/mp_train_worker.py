@@ -56,5 +56,15 @@ host_allreduce(np.zeros(1))  # barrier after data gen
 state, history, fconfig = hydragnn_tpu.run_training(config)
 err, tasks, tv, pv = hydragnn_tpu.run_prediction(config)
 
+# digest of the trained params: the global-mesh DP step psums gradients
+# across processes every step, so ranks must hold bitwise-identical models
+# (the reference's DDP invariant)
+import hashlib
+
+h = hashlib.sha256()
+for leaf in jax.tree.leaves(jax.device_get(state.params)):
+    h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+digest = h.hexdigest()[:16]
+
 print(f"MPRESULT rank={rank} val={history['val'][-1]:.8f} "
-      f"err={err:.8f} ngather={len(tv[0])}")
+      f"err={err:.8f} ngather={len(tv[0])} params={digest}")

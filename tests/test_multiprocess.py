@@ -45,15 +45,17 @@ def test_two_process_training(tmp_path):
     for out in outs:
         m = re.search(
             r"MPRESULT rank=(\d) val=([\d.eE+-]+) err=([\d.eE+-]+) "
-            r"ngather=(\d+)", out)
+            r"ngather=(\d+) params=([0-9a-f]+)", out)
         assert m, out[-2000:]
         results[int(m.group(1))] = (
-            float(m.group(2)), float(m.group(3)), int(m.group(4)))
+            float(m.group(2)), float(m.group(3)), int(m.group(4)), m.group(5))
 
     # reduced metrics must agree across ranks; the gathered eval set must
     # cover the full test split on both ranks
     assert results[0][0] == pytest.approx(results[1][0], rel=1e-5)
     assert results[0][1] == pytest.approx(results[1][1], rel=1e-5)
     assert results[0][2] == results[1][2] >= 30
+    # gradient sync: trained params must be bitwise-identical across ranks
+    assert results[0][3] == results[1][3]
     # training must have actually converged on the synthetic task
     assert results[0][1] < 0.2
