@@ -138,4 +138,15 @@ def create_dataloaders(
         world_size=world_size,
         post_collate=post_collate,
     )
-    return mk(trainset, True), mk(valset, False), mk(testset, False)
+    loaders = (mk(trainset, True), mk(valset, False), mk(testset, False))
+    # HYDRAGNN_NUM_WORKERS>0 overlaps host-side collation with device compute
+    # (reference HYDRAGNN_NUM_WORKERS DataLoader workers, load_data.py:245)
+    import os
+
+    n_workers = int(os.getenv("HYDRAGNN_NUM_WORKERS", "0"))
+    if n_workers > 0:
+        from hydragnn_tpu.data.prefetch import PrefetchLoader
+
+        loaders = tuple(
+            PrefetchLoader(l, num_workers=n_workers) for l in loaders)
+    return loaders

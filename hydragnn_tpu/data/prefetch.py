@@ -85,9 +85,18 @@ class PrefetchLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is done:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                yield item
+            t.join()
+        except GeneratorExit:
+            # abandoned mid-epoch (e.g. a single next() for an example
+            # batch): drain so the producer can finish and exit
+            def drain():
+                while q.get() is not done:
+                    pass
+            threading.Thread(target=drain, daemon=True).start()
+            raise

@@ -122,17 +122,24 @@ def make_dp_train_step(
     mesh: Mesh,
     output_names: Optional[Sequence[str]] = None,
     axis: str = DATA_AXIS,
+    zero_specs=None,
 ):
     """jit'd DP train step over stacked batches [D, ...].
 
     state is replicated; the batch is split along the device axis; gradients,
     metrics and batch-norm statistics are pmean-ed across the axis (DDP
     all-reduce parity, reference train_validate_test.py:496).
+
+    With ``zero_specs`` (from parallel.zero.shard_opt_state) the optimizer
+    state stays sharded along the axis — each device updates only its slice
+    of params/moments and the new params are all_gather-ed (ZeRO-1, reference
+    optimizer.py:43-103).
     """
     import optax
     from jax import shard_map
 
     energy_head, forces_head = _force_head_indices(output_names)
+    n_dev = int(mesh.devices.size)
 
     def per_device(state: TrainState, g: GraphBatch):
         # leading device axis has size 1 inside the shard; drop it
@@ -158,12 +165,24 @@ def make_dp_train_step(
         loss = jax.lax.psum(loss * ng_local, axis) / denom
         per_head = [jax.lax.psum(p * ng_local, axis) / denom for p in per_head]
 
-        updates, new_opt_state = opt_spec.tx.update(
-            grads, state.opt_state, state.params)
         from hydragnn_tpu.models.base import encoder_freeze_mask
 
-        updates = encoder_freeze_mask(updates, cfg.freeze_conv)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero_specs is not None:
+            from hydragnn_tpu.parallel import zero
+
+            idx = jax.lax.axis_index(axis)
+            g_sh = zero.shard_tree(grads, idx, n_dev)
+            p_sh = zero.shard_tree(state.params, idx, n_dev)
+            updates, new_opt_state = opt_spec.tx.update(
+                g_sh, state.opt_state, p_sh)
+            updates = encoder_freeze_mask(updates, cfg.freeze_conv)
+            new_p_sh = optax.apply_updates(p_sh, updates)
+            new_params = zero.unshard_tree(new_p_sh, state.params, axis)
+        else:
+            updates, new_opt_state = opt_spec.tx.update(
+                grads, state.opt_state, state.params)
+            updates = encoder_freeze_mask(updates, cfg.freeze_conv)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
@@ -177,11 +196,14 @@ def make_dp_train_step(
         }
         return new_state, metrics
 
+    opt_spec_tree = P() if zero_specs is None else zero_specs
+    state_specs = TrainState(
+        step=P(), params=P(), batch_stats=P(), opt_state=opt_spec_tree)
     sharded = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(axis)),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, P(axis)),
+        out_specs=(state_specs, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=0)

@@ -96,6 +96,7 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
         rank=rank,
         world_size=world_size,
         logs_dir=logs_dir,
+        profile_config=config.get("Profile"),
     )
 
     save_state(state, log_name, logs_dir, rank=rank)

@@ -103,29 +103,30 @@ def _loss_and_metrics(
     (loss, (per_head, new_batch_stats, outputs))."""
     variables = {"params": params, "batch_stats": batch_stats}
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
-    if train:
-        outputs, mutated = model.apply(
-            variables, g, train=True, mutable=["batch_stats"], rngs=rngs)
-        new_stats = mutated.get("batch_stats", batch_stats)
-    else:
-        outputs = model.apply(variables, g, train=False)
-        new_stats = batch_stats
-    total, per_head = multihead_loss(cfg, outputs, g)
+
+    def apply_fn(gg):
+        if train:
+            out, mutated = model.apply(
+                variables, gg, train=True, mutable=["batch_stats"], rngs=rngs)
+            return out, mutated.get("batch_stats", batch_stats)
+        return model.apply(variables, gg, train=False), batch_stats
 
     if energy_head >= 0 and forces_head >= 0:
         # Energy-gradient force self-consistency (reference
         # train_validate_test.py:478-488): forces are the negative gradient,
         # so the mismatch is |dE/dpos * scale + F_label| summed over real
-        # nodes.  The gradient is taken through the full conv stack.
-        def energy_of(pos):
-            out = model.apply(
-                {"params": params, "batch_stats": batch_stats},
-                g.replace(pos=pos),
-                train=False,
-            )
-            return jnp.sum(out[energy_head] * g.graph_mask[:, None])
+        # nodes.  dE/dpos comes from the SAME forward that produces the head
+        # outputs (one forward + one extra backward, matching the reference's
+        # create_graph autograd.grad on the live graph) — not a second apply.
 
-        grads_energy = jax.grad(energy_of)(g.pos)  # [N, 3]
+        def energy_of(pos):
+            out, stats = apply_fn(g.replace(pos=pos))
+            e = jnp.sum(out[energy_head] * g.graph_mask[:, None])
+            return e, (out, stats)
+
+        (_, (outputs, new_stats)), grads_energy = jax.value_and_grad(
+            energy_of, has_aux=True)(g.pos)  # grads: [N, 3]
+        total, per_head = multihead_loss(cfg, outputs, g)
         scale = g.extras.get("grad_energy_post_scaling_factor")
         if scale is not None:
             if scale.ndim == 1:
@@ -136,6 +137,9 @@ def _loss_and_metrics(
             grads_energy.reshape(f_label.shape) + f_label
         ) * g.node_mask[:, None]
         total = total + jnp.sum(mism)
+    else:
+        outputs, new_stats = apply_fn(g)
+        total, per_head = multihead_loss(cfg, outputs, g)
 
     return total, (per_head, new_stats, outputs)
 
@@ -258,13 +262,16 @@ class CheckpointTracker:
         self.path = path
         self.count = 0
         self.best = float("inf")
+        # e.g. ZeRO opt-state consolidation before serialization (reference
+        # consolidate_state_dict before save, utils/model.py:61-62)
+        self.transform = lambda s: s
 
     def __call__(self, state: TrainState, metric: float) -> bool:
         self.count += 1
         if self.count < self.warmup or metric >= self.best:
             return False
         self.best = metric
-        save_state(state, self.name, self.path)
+        save_state(self.transform(state), self.name, self.path)
         return True
 
 
@@ -308,10 +315,14 @@ def load_state(state: TrainState, log_name: str, path: str = "./logs/") -> Train
 # ---------------------------------------------------------------------------
 
 
-def _run_epoch(step_fn, state, loader, train: bool):
-    total = 0.0
-    tasks: Optional[np.ndarray] = None
-    n = 0.0
+def _run_epoch(step_fn, state, loader, train: bool, profiler=None):
+    # Metrics accumulate as DEVICE scalars: no float() in the batch loop, so
+    # steps dispatch back-to-back with no device->host sync (the reference
+    # accumulates on device and reduces at epoch end,
+    # train_validate_test.py:505-508).  One device_get at epoch end.
+    total = None
+    tasks = None
+    n = None
     # HYDRAGNN_MAX_NUM_BATCH caps batches per epoch (reference get_nbatch,
     # train_validate_test.py:40-50 — used for weak-scaling measurement)
     nbatch = int(os.getenv("HYDRAGNN_MAX_NUM_BATCH", "0")) or None
@@ -325,13 +336,20 @@ def _run_epoch(step_fn, state, loader, train: bool):
         else:
             metrics = step_fn(state, g)
             per_head = metrics["per_head"]
-        ng = float(metrics["num_graphs"])
-        total += float(metrics["loss"]) * ng
-        ph = np.asarray([float(t) for t in per_head])
-        tasks = ph * ng if tasks is None else tasks + ph * ng
-        n += ng
-    n = max(n, 1.0)
-    return state, total / n, (tasks / n if tasks is not None else np.zeros(0))
+        ng = metrics["num_graphs"]
+        loss_w = metrics["loss"] * ng
+        ph = jnp.stack(per_head) * ng if per_head else jnp.zeros(0)
+        if total is None:
+            total, tasks, n = loss_w, ph, ng
+        else:
+            total, tasks, n = total + loss_w, tasks + ph, n + ng
+        if profiler is not None:
+            profiler.step()
+    if total is None:
+        return state, 0.0, np.zeros(0)
+    total, tasks, n = jax.device_get((total, tasks, n))
+    n = max(float(n), 1.0)
+    return state, float(total) / n, np.asarray(tasks) / n
 
 
 def train_validate_test(
@@ -350,6 +368,7 @@ def train_validate_test(
     world_size: int = 1,
     logs_dir: str = "./logs/",
     use_mesh_dp: Optional[bool] = None,
+    profile_config: Optional[Dict[str, Any]] = None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Epoch loop with LR plateau scheduling, early stopping, checkpointing.
 
@@ -384,9 +403,20 @@ def train_validate_test(
         )
 
         mesh = make_mesh()  # global: every process's devices
-        state = replicate_state(state, mesh)
+        zero_specs = zero_dims = None
+        if opt_spec.use_zero_redundancy:
+            # ZeRO-1: optimizer state lives sharded along the data axis
+            # (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
+            from hydragnn_tpu.parallel.zero import shard_opt_state
+
+            opt_sharded, zero_specs, zero_dims = shard_opt_state(
+                jax.device_get(state.opt_state), mesh, "data")
+            state = replicate_state(state.replace(opt_state=()), mesh)
+            state = state.replace(opt_state=opt_sharded)
+        else:
+            state = replicate_state(state, mesh)
         train_step = make_dp_train_step(
-            model, cfg, opt_spec, mesh, output_names)
+            model, cfg, opt_spec, mesh, output_names, zero_specs=zero_specs)
         eval_step = make_dp_eval_step(model, cfg, mesh)
         train_loader = DeviceStackLoader(
             train_loader, n_local_devices, drop_last=True)
@@ -412,9 +442,19 @@ def train_validate_test(
     if training.get("Checkpoint") and rank == 0:
         checkpointer = CheckpointTracker(
             log_name, warmup=training.get("checkpoint_warmup", 0), path=logs_dir)
+        if use_mesh_dp and zero_dims is not None:
+            from hydragnn_tpu.parallel.zero import consolidate_opt_state
+
+            checkpointer.transform = lambda s: s.replace(
+                opt_state=consolidate_opt_state(s.opt_state, zero_dims, mesh))
 
     from hydragnn_tpu.utils.print_utils import print_distributed
     from hydragnn_tpu.utils import tracer as tr
+    from hydragnn_tpu.utils.profile import Profiler
+
+    # per-batch wait/warmup/active trace schedule (reference wires
+    # profiler.step() per train batch, train_validate_test.py:503)
+    profiler = Profiler(profile_config, log_name, logs_dir)
 
     history: Dict[str, List[float]] = {
         "train": [], "val": [], "test": [], "lr": []}
@@ -425,7 +465,7 @@ def train_validate_test(
         train_loader.set_epoch(epoch)
         tr.start("train")
         state, train_loss, train_tasks = _run_epoch(
-            train_step, state, train_loader, True)
+            train_step, state, train_loader, True, profiler=profiler)
         tr.stop("train")
         # HYDRAGNN_VALTEST=0 skips the val/test epochs (reference knob)
         if int(os.getenv("HYDRAGNN_VALTEST", "1")):
@@ -486,6 +526,12 @@ def train_validate_test(
                     f"Stopping at epoch {epoch}: insufficient SLURM walltime")
                 break
 
+    profiler.disable()
+    if use_mesh_dp and zero_dims is not None:
+        from hydragnn_tpu.parallel.zero import consolidate_opt_state
+
+        state = state.replace(
+            opt_state=consolidate_opt_state(state.opt_state, zero_dims, mesh))
     return state, history
 
 

@@ -47,6 +47,45 @@ def _setup(freeze=False, initial_bias=None, nll=False):
     return model, cfg, opt, state, batch
 
 
+def test_force_selfconsistency_single_forward():
+    """Energy+forces heads: the self-consistency term comes from dE/dpos of
+    the SAME forward (reference train_validate_test.py:478-488); the train
+    step must run, produce finite decreasing loss, and update params."""
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(4):
+        pos = rng.rand(6, 3).astype(np.float32) * 2
+        samples.append(GraphSample(
+            x=rng.rand(6, 1), pos=pos,
+            edge_index=radius_graph(pos, 1.2, 8),
+            graph_y=rng.rand(1).astype(np.float32),
+            node_y=(rng.rand(6, 3).astype(np.float32) - 0.5) * 0.1,
+            extras={"grad_energy_post_scaling_factor":
+                    np.ones((6, 1), np.float32)}))
+    heads = [HeadSpec("total_energy", "graph", 1),
+             HeadSpec("atomic_forces", "node", 3)]
+    batch = collate(samples, PadSpec.for_batch(4, 6, 30), heads)
+    from hydragnn_tpu.models.base import NodeHeadCfg
+
+    cfg = ModelConfig(
+        model_type="SchNet", input_dim=1, hidden_dim=8,
+        output_dim=(1, 3), output_type=("graph", "node"),
+        graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=NodeHeadCfg(1, (8,)), task_weights=(1.0, 1.0),
+        num_conv_layers=2, num_gaussians=8, num_filters=8, radius=1.2)
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 0.01})
+    state = create_train_state(model, batch, opt)
+    step = jax.jit(make_train_step(
+        model, cfg, opt, output_names=["total_energy", "atomic_forces"]))
+    losses = []
+    for _ in range(15):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_freeze_conv_keeps_encoder_fixed():
     model, cfg, opt, state, batch = _setup(freeze=True)
     step = jax.jit(make_train_step(model, cfg, opt))
