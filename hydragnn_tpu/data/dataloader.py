@@ -10,6 +10,7 @@ with wrap-around padding — DistributedSampler semantics.
 from __future__ import annotations
 
 import math
+import os
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -24,7 +25,16 @@ from hydragnn_tpu.graph.batch import (
 
 
 class GraphDataLoader:
-    """Iterates padded GraphBatches over a list of host-side GraphSamples."""
+    """Iterates padded GraphBatches over a list of host-side GraphSamples.
+
+    With ``pad_specs`` (a small sorted list of bucket PadSpecs, see
+    :func:`bucket_pad_specs`), each batch is padded to the SMALLEST bucket it
+    fits, so skewed size distributions (QM9: 3-29 atoms) don't pay worst-case
+    padding on every batch; the jit'd step compiles once per bucket — a
+    bounded compile count.  ``bucket_group`` > 1 forces that many consecutive
+    batches to share one bucket (required when batches are later stacked
+    across local devices by DeviceStackLoader).
+    """
 
     def __init__(
         self,
@@ -40,6 +50,8 @@ class GraphDataLoader:
         world_size: int = 1,
         drop_last: bool = False,
         post_collate=None,
+        pad_specs: Optional[Sequence[PadSpec]] = None,
+        bucket_group: int = 1,
     ):
         self.samples = list(samples)
         self.head_specs = list(head_specs)
@@ -53,13 +65,35 @@ class GraphDataLoader:
         self.graph_feature_slices = graph_feature_slices
         self.node_feature_slices = node_feature_slices
         self.post_collate = post_collate
-        if pad_spec is None:
-            pad_spec = pad_spec_for(self.samples, self.batch_size)
+        if pad_specs is not None:
+            self.pad_specs = sorted(pad_specs, key=lambda p: p.num_nodes)
+            pad_spec = self.pad_specs[-1]  # worst-case bucket
+        else:
+            if pad_spec is None:
+                pad_spec = pad_spec_for(self.samples, self.batch_size)
+            self.pad_specs = [pad_spec]
         self.pad_spec = pad_spec
+        self.bucket_group = max(1, int(bucket_group))
+        # padding-waste accounting (real vs padded node slots), reset per epoch
+        self.real_nodes = 0
+        self.padded_nodes = 0
 
     def set_epoch(self, epoch: int) -> None:
         """Reseed the shuffle (parity: DistributedSampler.set_epoch)."""
         self.epoch = epoch
+
+    def padding_efficiency(self) -> float:
+        """real node slots / padded node slots over batches yielded so far."""
+        return self.real_nodes / max(self.padded_nodes, 1)
+
+    def _pick_spec(self, batches: Sequence[Sequence[GraphSample]]) -> PadSpec:
+        """Smallest bucket that fits every batch in the group."""
+        need_nodes = max(sum(s.num_nodes for s in b) for b in batches)
+        need_edges = max(sum(s.num_edges for s in b) for b in batches)
+        for spec in self.pad_specs:
+            if spec.num_nodes - 1 >= need_nodes and spec.num_edges >= need_edges:
+                return spec
+        return self.pad_specs[-1]
 
     def _local_indices(self) -> np.ndarray:
         n = len(self.samples)
@@ -80,22 +114,51 @@ class GraphDataLoader:
             return n // self.batch_size
         return int(math.ceil(n / self.batch_size))
 
-    def __iter__(self) -> Iterator[GraphBatch]:
+    def _batch_plan(self) -> List[Tuple[List[GraphSample], PadSpec]]:
+        """The epoch's (samples, pad_spec) per batch — cheap host metadata.
+
+        Separated from collation so PrefetchLoader can run collations on a
+        thread pool in plan order (parallel but order-preserving: stacked
+        device groups must not straddle bucket boundaries).
+        """
         order = self._local_indices()
         nb = len(self)
-        for b in range(nb):
-            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-            batch = [self.samples[i] for i in idx]
-            out = collate(
-                batch,
-                self.pad_spec,
-                self.head_specs,
-                self.graph_feature_slices,
-                self.node_feature_slices,
-            )
-            if self.post_collate is not None:
-                out = self.post_collate(out)
-            yield out
+        self.real_nodes = 0
+        self.padded_nodes = 0
+        plan: List[Tuple[List[GraphSample], PadSpec]] = []
+        for g0 in range(0, nb, self.bucket_group):
+            group = [
+                [self.samples[i]
+                 for i in order[b * self.batch_size:(b + 1) * self.batch_size]]
+                for b in range(g0, min(g0 + self.bucket_group, nb))
+            ]
+            spec = (self.pad_spec if len(self.pad_specs) == 1
+                    else self._pick_spec(group))
+            for batch in group:
+                self.real_nodes += sum(s.num_nodes for s in batch)
+                self.padded_nodes += spec.num_nodes
+                plan.append((batch, spec))
+        return plan
+
+    def _collate_plan_item(
+        self, item: Tuple[List[GraphSample], PadSpec]
+    ) -> GraphBatch:
+        """Pure (thread-safe) collation of one planned batch."""
+        batch, spec = item
+        out = collate(
+            batch,
+            spec,
+            self.head_specs,
+            self.graph_feature_slices,
+            self.node_feature_slices,
+        )
+        if self.post_collate is not None:
+            out = self.post_collate(out)
+        return out
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        for item in self._batch_plan():
+            yield self._collate_plan_item(item)
 
 
 def pad_spec_for(
@@ -105,6 +168,66 @@ def pad_spec_for(
     max_nodes = max(s.num_nodes for s in samples)
     max_edges = max(max(s.num_edges for s in samples), 1)
     return PadSpec.for_batch(batch_size, max_nodes, max_edges, round_to)
+
+
+def bucket_pad_specs(
+    samples: Sequence[GraphSample],
+    batch_size: int,
+    n_buckets: int = 3,
+    round_to: int = 8,
+    n_sim: int = 256,
+    seed: int = 0,
+) -> List[PadSpec]:
+    """2-4 bucket PadSpecs sized from the dataset's *batch-sum* distribution.
+
+    XLA needs static shapes, so a batch of variable-size graphs is padded to a
+    bucket; one worst-case bucket wastes most of the MXU work on skewed
+    datasets.  We simulate shuffled batches to estimate the distribution of
+    per-batch total nodes/edges (sums concentrate near batch_size*mean, far
+    below batch_size*max), then place bucket capacities at evenly spaced
+    quantiles with the top bucket = exact worst case, so every batch fits
+    somewhere.  Compile count is bounded by ``n_buckets``.
+    """
+    n_buckets = max(1, int(n_buckets))
+    worst = pad_spec_for(samples, batch_size, round_to)
+    if n_buckets == 1 or len(samples) <= batch_size:
+        return [worst]
+    nodes = np.asarray([s.num_nodes for s in samples], np.int64)
+    edges = np.asarray([max(s.num_edges, 0) for s in samples], np.int64)
+    rng = np.random.RandomState(seed)
+    sums_n = np.empty(n_sim, np.int64)
+    sums_e = np.empty(n_sim, np.int64)
+    for i in range(n_sim):
+        idx = rng.choice(len(samples), size=batch_size, replace=False)
+        sums_n[i] = nodes[idx].sum()
+        sums_e[i] = edges[idx].sum()
+    specs: List[PadSpec] = []
+
+    def _round(x: int) -> int:
+        return int(-(-x // round_to) * round_to)
+
+    # lower buckets at quantiles of the simulated batch sums; e.g. 3 buckets
+    # -> q50, q99, worst-case
+    qs = list(np.linspace(50.0, 99.0, n_buckets - 1)) if n_buckets > 2 else [90.0]
+    for q in qs:
+        qn = _round(int(np.percentile(sums_n, q)) + 1)
+        qe = _round(int(np.percentile(sums_e, q)) + 1)
+        if qn < worst.num_nodes:
+            specs.append(PadSpec(
+                num_nodes=qn,
+                num_edges=min(qe, worst.num_edges),
+                num_graphs=worst.num_graphs,
+            ))
+    specs.append(worst)
+    # dedupe (quantiles can coincide)
+    seen = set()
+    uniq = []
+    for s in specs:
+        key = (s.num_nodes, s.num_edges)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(s)
+    return uniq
 
 
 def create_dataloaders(
@@ -119,17 +242,39 @@ def create_dataloaders(
     world_size: int = 1,
     seed: int = 0,
     post_collate=None,
+    n_buckets: Optional[int] = None,
+    bucket_group: Optional[int] = None,
 ) -> Tuple["GraphDataLoader", "GraphDataLoader", "GraphDataLoader"]:
-    """Three loaders sharing one PadSpec (so train/val/test share the same
-    compiled executable).  Parity: reference create_dataloaders
-    (hydragnn/preprocess/load_data.py:226-297)."""
+    """Three loaders sharing one PadSpec set (so train/val/test share the
+    same compiled executables).  Parity: reference create_dataloaders
+    (hydragnn/preprocess/load_data.py:226-297).
+
+    ``n_buckets`` (or env HYDRAGNN_NUM_BUCKETS) > 1 enables graph-size
+    bucketing: each batch pads to the smallest of n_buckets PadSpecs that
+    fits.  ``bucket_group`` defaults to the local device count so batches
+    stacked per-device by the mesh DP path share a bucket.
+    """
     all_samples = list(trainset) + list(valset) + list(testset)
-    pad = pad_spec_for(all_samples, batch_size)
+    if n_buckets is None:
+        n_buckets = int(os.getenv("HYDRAGNN_NUM_BUCKETS", "1"))
+    if world_size > 1:
+        # multi-process: every rank must assemble the same global array
+        # shape each step, but bucket choice depends on rank-local samples —
+        # keep the single worst-case spec
+        n_buckets = 1
+    if n_buckets > 1:
+        pads = bucket_pad_specs(all_samples, batch_size, n_buckets)
+        if bucket_group is None:
+            import jax
+
+            bucket_group = len(jax.local_devices())
+    else:
+        pads = [pad_spec_for(all_samples, batch_size)]
+        bucket_group = 1
     mk = lambda split, shuffle: GraphDataLoader(
         split,
         head_specs,
         batch_size,
-        pad_spec=pad,
         shuffle=shuffle,
         seed=seed,
         graph_feature_slices=graph_feature_slices,
@@ -137,12 +282,12 @@ def create_dataloaders(
         rank=rank,
         world_size=world_size,
         post_collate=post_collate,
+        pad_specs=pads,
+        bucket_group=bucket_group,
     )
     loaders = (mk(trainset, True), mk(valset, False), mk(testset, False))
     # HYDRAGNN_NUM_WORKERS>0 overlaps host-side collation with device compute
     # (reference HYDRAGNN_NUM_WORKERS DataLoader workers, load_data.py:245)
-    import os
-
     n_workers = int(os.getenv("HYDRAGNN_NUM_WORKERS", "0"))
     if n_workers > 0:
         from hydragnn_tpu.data.prefetch import PrefetchLoader

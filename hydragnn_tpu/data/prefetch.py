@@ -56,32 +56,42 @@ class PrefetchLoader:
                     pass
 
         def producer():
+            err = None
             try:
-                with ThreadPoolExecutor(
-                        max_workers=self.num_workers,
-                        initializer=worker_init) as pool:
-                    futures = []
-                    it = iter(self.loader)
-                    # the loader's __iter__ does the collation work; submit
-                    # next() pulls so collation overlaps consumption
-                    lock = threading.Lock()
+                plan_fn = getattr(self.loader, "_batch_plan", None)
+                collate_fn = getattr(self.loader, "_collate_plan_item", None)
+                if plan_fn is not None and collate_fn is not None:
+                    # GraphDataLoader protocol: the plan (indices + pad spec
+                    # per batch) is cheap; collations run on the pool and are
+                    # consumed in PLAN ORDER — parallel but order-preserving.
+                    # Order matters: DeviceStackLoader stacks consecutive
+                    # batches, which must share a bucket PadSpec.
+                    from collections import deque
 
-                    def pull():
-                        with lock:
-                            try:
-                                return next(it)
-                            except StopIteration:
-                                return done
-
-                    n = len(self.loader)
-                    for _ in range(n):
-                        futures.append(pool.submit(pull))
-                    for f in futures:
-                        item = f.result()
-                        if item is not done:
-                            q.put(item)
+                    plan = plan_fn()
+                    window = self.num_workers + self.prefetch
+                    with ThreadPoolExecutor(
+                            max_workers=self.num_workers,
+                            initializer=worker_init) as pool:
+                        futures: deque = deque()
+                        idx = 0
+                        while idx < len(plan) or futures:
+                            while idx < len(plan) and len(futures) < window:
+                                futures.append(
+                                    pool.submit(collate_fn, plan[idx]))
+                                idx += 1
+                            # q.put blocks when full: backpressure bounds
+                            # in-flight batches to window + prefetch
+                            q.put(futures.popleft().result())
+                else:
+                    # arbitrary iterable: sequential background iteration
+                    # (still overlaps collation with device compute)
+                    for item in self.loader:
+                        q.put(item)
+            except BaseException as e:  # surfaced in the consumer thread
+                err = e
             finally:
-                q.put(done)
+                q.put((done, err) if err is not None else done)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -90,13 +100,22 @@ class PrefetchLoader:
                 item = q.get()
                 if item is done:
                     break
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is done:
+                    # producer died: re-raise so a truncated epoch is never
+                    # mistaken for a complete one
+                    raise item[1]
                 yield item
             t.join()
         except GeneratorExit:
             # abandoned mid-epoch (e.g. a single next() for an example
             # batch): drain so the producer can finish and exit
             def drain():
-                while q.get() is not done:
-                    pass
+                while True:
+                    item = q.get()
+                    if item is done or (
+                            isinstance(item, tuple) and len(item) == 2
+                            and item[0] is done):
+                        break
             threading.Thread(target=drain, daemon=True).start()
             raise

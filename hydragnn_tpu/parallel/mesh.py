@@ -285,17 +285,15 @@ class DeviceStackLoader:
 
     def __iter__(self):
         group: List[GraphBatch] = []
-        first = None
         for g in self.loader:
-            if first is None:
-                first = g
             group.append(g)
             if len(group) == self.n_devices:
                 yield stack_batches(group)
                 group = []
         if group and not self.drop_last:
-            # pad with empty copies of the first batch (zero graph_mask)
-            empty = jax.tree.map(np.zeros_like, first)
+            # pad with empty copies shaped like THIS group (zero graph_mask);
+            # with bucketing, earlier groups may use a different PadSpec
+            empty = jax.tree.map(np.zeros_like, group[0])
             while len(group) < self.n_devices:
                 group.append(empty)
             yield stack_batches(group)

@@ -202,8 +202,10 @@ static void serve_client(Dstore* ds, int cfd) {
     int64_t gidx;
     if (!read_full(cfd, &gidx, 8)) break;
 
+    // Copy the sample under the lock: a concurrent dstore_add may replace
+    // the shard vector, so a pointer into it must not outlive the guard.
     int64_t nbytes = -1;
-    const uint8_t* src = nullptr;
+    std::vector<uint8_t> payload;
     {
       std::lock_guard<std::mutex> lk(ds->mu);
       auto it = ds->keys.find(name);
@@ -212,12 +214,13 @@ static void serve_client(Dstore* ds, int cfd) {
         int64_t local = gidx - k.global_start;
         if (local >= 0 && local < (int64_t)k.offsets.size()) {
           nbytes = k.nbytes[local];
-          src = k.data.data() + k.offsets[local];
+          const uint8_t* src = k.data.data() + k.offsets[local];
+          payload.assign(src, src + nbytes);
         }
       }
     }
     if (!write_full(cfd, &nbytes, 8)) break;
-    if (nbytes > 0 && !write_full(cfd, src, nbytes)) break;
+    if (nbytes > 0 && !write_full(cfd, payload.data(), nbytes)) break;
   }
   close(cfd);
 }

@@ -1,0 +1,28 @@
+"""Shared cached generation of the deterministic CI dataset.
+
+One place for the cache-invalidation logic: the seed comes from zlib.crc32
+(str hash() is randomized per process, so a hash-derived seed would make the
+cached dataset differ run-to-run — and some draws miss the accuracy
+thresholds), and a seed-stamp marker file makes caches generated under a
+different seed scheme or sample count self-invalidating.
+"""
+
+import os
+import zlib
+
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+
+
+def generate_cached(name: str, path: str, n: int) -> None:
+    """Generate ``n`` LSMS files under ``path`` if the cache is missing or
+    was created with a different (seed, n)."""
+    os.makedirs(path, exist_ok=True)
+    seed = zlib.crc32(name.encode()) % 1000
+    # stamp lives BESIDE the dir: raw loaders treat every file inside as data
+    stamp = os.path.normpath(path) + f".seed{seed}_n{n}.stamp"
+    if os.path.exists(stamp) and os.listdir(path):
+        return
+    for f in os.listdir(path):
+        os.remove(os.path.join(path, f))
+    deterministic_graph_data(path, number_configurations=n, seed=seed)
+    open(stamp, "w").close()

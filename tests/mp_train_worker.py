@@ -27,13 +27,13 @@ jax.distributed.initialize(
 assert jax.process_count() == world
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 os.chdir(scratch)
 os.environ["SERIALIZED_DATA_PATH"] = scratch
 
 import numpy as np
 
 import hydragnn_tpu
-from hydragnn_tpu.data.synthetic import deterministic_graph_data
 
 with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "inputs", "ci.json")) as f:
@@ -45,10 +45,9 @@ config["Verbosity"]["level"] = 0
 if rank == 0:
     for name, path in config["Dataset"]["path"].items():
         n = 120 if name == "train" else 30
-        os.makedirs(path, exist_ok=True)
-        if not os.listdir(path):
-            deterministic_graph_data(
-                path, number_configurations=n, seed=abs(hash(name)) % 1000)
+        from ci_data import generate_cached
+
+        generate_cached(name, path, n)
 from hydragnn_tpu.parallel.comm import host_allreduce
 
 host_allreduce(np.zeros(1))  # barrier after data gen

@@ -1,0 +1,172 @@
+"""Graph-size bucketing: batches pad to the smallest fitting PadSpec so
+skewed datasets (QM9: 3-29 atoms) don't pay worst-case padding every step
+(SURVEY §5: static-shape padding/bucketing is the first-class TPU problem)."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.dataloader import (
+    GraphDataLoader,
+    bucket_pad_specs,
+    create_dataloaders,
+    pad_spec_for,
+)
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec
+from hydragnn_tpu.graph.neighborlist import radius_graph
+
+
+def _qm9_like_samples(n=600, seed=0):
+    """Sizes drawn from a QM9-like distribution: mostly ~18 atoms, tail to 29."""
+    rng = np.random.RandomState(seed)
+    sizes = np.clip(rng.normal(18, 4, size=n).astype(int), 3, 29)
+    samples = []
+    for sz in sizes:
+        pos = rng.rand(sz, 3).astype(np.float32) * 3.0
+        samples.append(GraphSample(
+            x=rng.rand(sz, 1), pos=pos,
+            edge_index=radius_graph(pos, 1.5, 32),
+            graph_y=rng.rand(1), node_y=rng.rand(sz, 1)))
+    return samples
+
+
+def test_bucket_specs_sorted_and_bounded():
+    samples = _qm9_like_samples()
+    specs = bucket_pad_specs(samples, batch_size=32, n_buckets=3)
+    assert 1 < len(specs) <= 3
+    nodes = [s.num_nodes for s in specs]
+    assert nodes == sorted(nodes)
+    # top bucket covers the worst case exactly
+    worst = pad_spec_for(samples, 32)
+    assert specs[-1].num_nodes == worst.num_nodes
+    assert specs[-1].num_edges == worst.num_edges
+
+
+def test_padding_efficiency_above_70pct():
+    samples = _qm9_like_samples()
+    heads = [HeadSpec("e", "graph", 1)]
+    specs = bucket_pad_specs(samples, batch_size=32, n_buckets=3)
+    loader = GraphDataLoader(
+        samples, heads, batch_size=32, shuffle=True, pad_specs=specs)
+    seen_shapes = set()
+    for g in loader:
+        seen_shapes.add(g.num_nodes)
+    eff = loader.padding_efficiency()
+    assert eff > 0.70, f"padding efficiency {eff:.2f} <= 0.70"
+    # bounded compile count: at most n_buckets distinct node shapes
+    assert len(seen_shapes) <= 3
+
+    # single worst-case bucket is measurably worse on this distribution
+    base = GraphDataLoader(samples, heads, batch_size=32, shuffle=True)
+    for g in base:
+        pass
+    assert loader.padding_efficiency() > base.padding_efficiency()
+
+
+def test_bucket_group_shares_spec():
+    """Batches within a bucket_group share one PadSpec (required when the
+    mesh DP path stacks consecutive batches across local devices)."""
+    samples = _qm9_like_samples(256)
+    heads = [HeadSpec("e", "graph", 1)]
+    specs = bucket_pad_specs(samples, batch_size=16, n_buckets=3)
+    loader = GraphDataLoader(
+        samples, heads, batch_size=16, shuffle=True,
+        pad_specs=specs, bucket_group=4)
+    shapes = [g.num_nodes for g in loader]
+    for i in range(0, len(shapes) - 3, 4):
+        assert len(set(shapes[i:i + 4])) == 1
+
+
+def test_every_batch_fits_smallest_chosen_bucket():
+    samples = _qm9_like_samples(300, seed=1)
+    heads = [HeadSpec("e", "graph", 1)]
+    specs = bucket_pad_specs(samples, batch_size=16, n_buckets=4)
+    loader = GraphDataLoader(
+        samples, heads, batch_size=16, shuffle=True, pad_specs=specs, seed=3)
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for g in loader:  # collate raises if a batch exceeds its spec
+            assert float(np.sum(np.asarray(g.node_mask))) <= g.num_nodes
+
+
+def test_training_with_buckets_matches_single_spec():
+    """A short training run with bucketing converges like the unbucketed one
+    (loss is masked, so the pad size must not change the math)."""
+    import jax
+
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+
+    samples = _qm9_like_samples(200, seed=2)
+    # analytic target: mean node feature per graph
+    for s in samples:
+        s.graph_y = np.asarray([s.x.mean()], np.float32)
+    heads = [HeadSpec("e", "graph", 1)]
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 0.01})
+
+    def run(loader):
+        example = next(iter(loader))
+        state = create_train_state(model, example, opt, seed=0)
+        step = jax.jit(make_train_step(model, cfg, opt))
+        losses = []
+        for epoch in range(8):
+            loader.set_epoch(epoch)
+            ep = []
+            for g in loader:
+                state, m = step(state, g)
+                ep.append(float(m["loss"]))
+            losses.append(np.mean(ep))
+        return losses
+
+    specs = bucket_pad_specs(samples, 16, n_buckets=3)
+    bucketed = run(GraphDataLoader(
+        samples, heads, 16, shuffle=True, pad_specs=specs))
+    single = run(GraphDataLoader(samples, heads, 16, shuffle=True))
+    assert bucketed[-1] < bucketed[0] * 0.5
+    assert abs(bucketed[-1] - single[-1]) < max(0.05, single[-1] * 2)
+
+
+def test_create_dataloaders_bucket_env(monkeypatch):
+    samples = _qm9_like_samples(120, seed=4)
+    heads = [HeadSpec("e", "graph", 1)]
+    monkeypatch.setenv("HYDRAGNN_NUM_BUCKETS", "3")
+    tr, va, te = create_dataloaders(
+        samples[:80], samples[80:100], samples[100:], 16, heads)
+    # unwrap a possible PrefetchLoader
+    inner = getattr(tr, "loader", tr)
+    assert len(inner.pad_specs) > 1
+    # multi-process forces a single spec
+    tr2, _, _ = create_dataloaders(
+        samples[:80], samples[80:100], samples[100:], 16, heads,
+        rank=0, world_size=2)
+    inner2 = getattr(tr2, "loader", tr2)
+    assert len(inner2.pad_specs) == 1
+
+
+def test_prefetch_preserves_order_with_buckets():
+    """PrefetchLoader must yield batches in plan order even with parallel
+    collation workers — stacked device groups must not straddle buckets."""
+    from hydragnn_tpu.data.prefetch import PrefetchLoader
+
+    samples = _qm9_like_samples(300, seed=5)
+    heads = [HeadSpec("e", "graph", 1)]
+    specs = bucket_pad_specs(samples, batch_size=16, n_buckets=3)
+    loader = GraphDataLoader(
+        samples, heads, 16, shuffle=True, pad_specs=specs, bucket_group=4,
+        seed=7)
+    loader.set_epoch(1)
+    expected = [np.asarray(g.x) for g in loader]
+    for workers in (1, 4):
+        pre = PrefetchLoader(loader, num_workers=workers)
+        pre.set_epoch(1)
+        got = [np.asarray(g.x) for g in pre]
+        assert len(got) == len(expected)
+        for a, b in zip(got, expected):
+            assert a.shape == b.shape
+            np.testing.assert_array_equal(a, b)

@@ -10,7 +10,6 @@ import numpy as np
 import pytest
 
 import hydragnn_tpu
-from hydragnn_tpu.data.synthetic import deterministic_graph_data
 
 # RMSE-threshold / sample-MAE-threshold per model (reference
 # tests/test_graphs.py:126-136)
@@ -36,11 +35,9 @@ def _generate_data(config, num_samples_tot=500):
             n = int(num_samples_tot * pt)
         else:
             n = int(num_samples_tot * (1 - pt) * 0.5)
-        os.makedirs(path, exist_ok=True)
-        if not os.listdir(path):
-            deterministic_graph_data(
-                path, number_configurations=n, seed=abs(hash(name)) % 1000
-            )
+        from ci_data import generate_cached
+
+        generate_cached(name, path, n)
 
 
 def unittest_train_model(model_type, ci_input, use_lengths=False):
