@@ -1,0 +1,135 @@
+"""DFTB UV-spectrum example: molecules -> full smooth absorption spectrum
+predicted by one WIDE graph head (1000 spectral bins).
+
+Parity with reference examples/dftb_uv_spectrum/train_smooth_uv_spectrum.py
+(PNA with a 37500-dim graph head over DFTB+ spectra; the discrete variant
+predicts peak lists).  The TPU-relevant property is the decoder shape: a
+single graph head of O(1000) outputs exercises the shared-MLP + head-MLP
+decoder path as one big MXU matmul per graph.  The real DFTB dataset is not
+downloadable here; the stand-in synthesizes molecules whose spectrum is a sum
+of Gaussians at composition-derived excitation energies — the same
+learnable structure->spectrum map shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+
+import jax
+
+from hydragnn_tpu.config.config import (
+    DatasetStats,
+    finalize,
+    head_specs_from_config,
+    label_slices_from_config,
+)
+from hydragnn_tpu.data.dataloader import create_dataloaders
+from hydragnn_tpu.data.splitting import split_dataset
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    make_eval_step,
+    test,
+    train_validate_test,
+)
+
+N_BINS = 1000  # spectral grid (reference smooth spectrum: 37500 bins)
+
+
+def synthesize_spectra(n_mol: int, seed: int = 0, radius: float = 2.0):
+    """Molecules with Gaussian-peak spectra at composition-derived energies."""
+    rng = np.random.RandomState(seed)
+    grid = np.linspace(0.0, 1.0, N_BINS)
+    samples = []
+    for _ in range(n_mol):
+        n = rng.randint(8, 18)
+        z = rng.choice([1, 6, 7, 8], size=n, p=[0.45, 0.35, 0.1, 0.1])
+        pos = rng.rand(n, 3) * (n ** (1 / 3)) * 1.3
+        ei = radius_graph(pos, radius, max_neighbours=12)
+        if ei.shape[1] == 0:
+            continue
+        # excitation energies from composition: heavier atoms shift peaks
+        centers = 0.15 + 0.6 * (np.bincount(z, minlength=9)[[6, 7, 8]] /
+                                max(n, 1))
+        widths = 0.02 + 0.02 * rng.rand(3)
+        amps = 0.5 + rng.rand(3)
+        spec = np.zeros(N_BINS)
+        for c, w, a in zip(centers, widths, amps):
+            spec += a * np.exp(-((grid - c) ** 2) / (2 * w * w))
+        samples.append(GraphSample(
+            x=z[:, None].astype(np.float32),
+            pos=pos.astype(np.float32),
+            edge_index=ei,
+            graph_y=spec.astype(np.float32),
+        ))
+    return samples
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inputfile",
+                    default=os.path.join(_HERE, "dftb_smooth_uv_spectrum.json"))
+    ap.add_argument("--data", default="")  # harness compat
+    ap.add_argument("--num_mols", type=int, default=300)
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--batch_size", type=int, default=None)
+    args = ap.parse_args()
+
+    with open(args.inputfile) as f:
+        config = json.load(f)
+    training = config["NeuralNetwork"]["Training"]
+    if args.num_epoch:
+        training["num_epoch"] = args.num_epoch
+    if args.batch_size:
+        training["batch_size"] = args.batch_size
+    arch = config["NeuralNetwork"]["Architecture"]
+
+    samples = synthesize_spectra(
+        args.num_mols, radius=float(arch.get("radius", 2.0)))
+
+    trainset, valset, testset = split_dataset(samples, training["perc_train"])
+    stats = DatasetStats.from_samples(
+        samples, need_deg=arch["model_type"] == "PNA")
+    config = finalize(config, stats)
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    head_specs = head_specs_from_config(config)
+    gslices, nslices = label_slices_from_config(config)
+    bs = int(training["batch_size"])
+    n_local = len(jax.local_devices())
+    if n_local > 1:
+        bs = max(1, -(-bs // n_local))
+    train_l, val_l, test_l = create_dataloaders(
+        trainset, valset, testset, bs, head_specs,
+        graph_feature_slices=gslices, node_feature_slices=nslices)
+
+    opt_spec = select_optimizer(training["Optimizer"])
+    state = create_train_state(model, next(iter(train_l)), opt_spec)
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, train_l, val_l, test_l,
+        config["NeuralNetwork"], "dftb_uv", verbosity=1)
+
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    error, tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads,
+                                output_types=cfg.output_type)
+    mae = float(np.abs(np.asarray(tv[0]) - np.asarray(pv[0])).mean())
+    print(f"test loss: {error:.6f}  spectrum MAE per bin: {mae:.6f}")
+    return error
+
+
+if __name__ == "__main__":
+    main()

@@ -154,7 +154,8 @@ def main():
         config["NeuralNetwork"], "md17", verbosity=1)
 
     eval_step = jax.jit(make_eval_step(model, cfg))
-    error, tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads)
+    error, tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads,
+                                output_types=cfg.output_type)
     print(f"test loss: {error:.6f}")
     for i, name in enumerate(
             config["NeuralNetwork"]["Variables_of_interest"]["output_names"]):

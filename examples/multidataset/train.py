@@ -137,7 +137,8 @@ def main():
             model, model_cfg, state, opt_spec, tl, vl, sl,
             cfg_c["NeuralNetwork"], f"multi_corpus{c}", verbosity=1)
         es = jax.jit(make_eval_step(model, model_cfg))
-        err, tasks, _, _ = test(es, state, sl, model_cfg.num_heads)
+        err, tasks, _, _ = test(es, state, sl, model_cfg.num_heads,
+                                output_types=model_cfg.output_type)
         results[c] = err
         print(f"corpus {c}: test loss {err:.6f}")
     return results

@@ -154,7 +154,8 @@ def main():
         config["NeuralNetwork"], "qm9", verbosity=1)
 
     eval_step = jax.jit(make_eval_step(model, cfg))
-    error, tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads)
+    error, tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads,
+                                output_types=cfg.output_type)
     mae = float(np.abs(np.asarray(tv[0]) - np.asarray(pv[0])).mean())
     print(f"test loss: {error:.6f}  energy MAE (standardized): {mae:.6f}")
     return error

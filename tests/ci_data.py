@@ -16,12 +16,19 @@ from hydragnn_tpu.data.synthetic import deterministic_graph_data
 def generate_cached(name: str, path: str, n: int) -> None:
     """Generate ``n`` LSMS files under ``path`` if the cache is missing or
     was created with a different (seed, n)."""
+    import glob
+
     os.makedirs(path, exist_ok=True)
     seed = zlib.crc32(name.encode()) % 1000
     # stamp lives BESIDE the dir: raw loaders treat every file inside as data
-    stamp = os.path.normpath(path) + f".seed{seed}_n{n}.stamp"
+    base = os.path.normpath(path)
+    stamp = base + f".seed{seed}_n{n}.stamp"
     if os.path.exists(stamp) and os.listdir(path):
         return
+    # drop ALL stale stamps for this path first, or a later regeneration with
+    # a different n would leave the old stamp matching a wrong-size cache
+    for old in glob.glob(base + ".seed*.stamp"):
+        os.remove(old)
     for f in os.listdir(path):
         os.remove(os.path.join(path, f))
     deterministic_graph_data(path, number_configurations=n, seed=seed)

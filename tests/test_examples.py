@@ -32,6 +32,48 @@ def test_example_runs(example, tmp_path):
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
 
 
+def test_csce_gap_runs(tmp_path):
+    """SMILES-CSV gap driver (reference examples/csce/train_gap.py):
+    synthesizes the CSV at --datafile when missing, then trains on it."""
+    csv_path = str(tmp_path / "csce.csv")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "csce", "train_gap.py"),
+         "--num_epoch", "3", "--num_mols", "80", "--datafile", csv_path],
+        cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert os.path.exists(csv_path)
+
+
+def test_dftb_uv_spectrum_runs(tmp_path):
+    """Wide-head (1000-dim spectrum) decoder stress (reference
+    examples/dftb_uv_spectrum/train_smooth_uv_spectrum.py)."""
+    r = _run("dftb_uv_spectrum",
+             ["--num_epoch", "2", "--num_mols", "60"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_open_catalyst_runs(tmp_path):
+    """OC20-IS2RE-style driver (BASELINE scale config: OC20 + DimeNet)."""
+    r = _run("open_catalyst",
+             ["--num_epoch", "2", "--num_frames", "40"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_open_catalyst_preonly_gpack(tmp_path):
+    gpack = str(tmp_path / "oc.gpack")
+    r = _run("open_catalyst",
+             ["--preonly", "--gpack", gpack, "--num_frames", "30"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert os.path.exists(gpack + ".p0")
+    r = _run("open_catalyst",
+             ["--use_gpack", "--gpack", gpack, "--num_epoch", "2"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
 def test_lj_preonly_gpack_roundtrip(tmp_path):
     data = str(tmp_path / "data")
     gpack = str(tmp_path / "LJ.gpack")
