@@ -1,0 +1,204 @@
+"""MPTrj-style example: multi-species periodic crystal trajectories, energy +
+forces multitask with PNA (the BASELINE.md pod-scale ensemble config).
+
+Parity with reference examples/mptrj/train.py: MPTrj JSON blobs (pymatgen
+structures with corrected_total_energy / energy_per_atom, forces, stresses)
+-> per-atom energy graph target + per-atom force node targets.  The real
+MPTrj archive is not downloadable here, so the stand-in synthesizes
+trajectories: multi-species perturbed crystals (binary LJ with
+Lorentz-Berthelot mixing) where consecutive frames are jittered relaxation
+steps of one material — same statistical shape (shared composition within a
+trajectory, energy/forces from the interatomic potential).
+
+``--preonly`` serializes to the gpack container; ``--use_gpack`` trains from
+it.  With multiple processes this driver pairs with the multidataset
+ensemble path (each corpus a branch; see examples/multidataset).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+
+import jax
+
+from hydragnn_tpu.config.config import (
+    DatasetStats,
+    finalize,
+    head_specs_from_config,
+    label_slices_from_config,
+)
+from hydragnn_tpu.data.dataloader import create_dataloaders
+from hydragnn_tpu.data.splitting import split_dataset
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.graph.neighborlist import radius_graph_pbc
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    make_eval_step,
+    test,
+    train_validate_test,
+)
+
+
+def _binary_lj(pos, z, cell, eps, sig, cutoff=2.5):
+    """Energy/forces for a 2-species LJ crystal, PBC minimum image,
+    Lorentz-Berthelot mixing."""
+    delta = pos[:, None, :] - pos[None, :, :]
+    delta -= cell * np.round(delta / cell)
+    r2 = (delta ** 2).sum(-1)
+    np.fill_diagonal(r2, np.inf)
+    e_ij = np.sqrt(eps[z][:, None] * eps[z][None, :])
+    s_ij = 0.5 * (sig[z][:, None] + sig[z][None, :])
+    mask = r2 < cutoff ** 2
+    inv_r2 = np.where(mask, s_ij ** 2 / np.maximum(r2, 1e-12), 0.0)
+    inv_r6 = inv_r2 ** 3
+    inv_r12 = inv_r6 ** 2
+    per_atom = 0.5 * np.where(mask, 4 * e_ij * (inv_r12 - inv_r6), 0.0).sum(1)
+    coeff = np.where(
+        mask, 24 * e_ij * (2 * inv_r12 - inv_r6) / np.maximum(r2, 1e-12), 0.0)
+    forces = (coeff[:, :, None] * delta).sum(1)
+    return per_atom.sum(), forces
+
+
+def synthesize_trajectories(n_traj: int = 40, frames: int = 5, seed: int = 0,
+                            radius: float = 2.2, max_neighbours: int = 24):
+    """Trajectories of perturbed binary crystals with LJ energy/forces."""
+    rng = np.random.RandomState(seed)
+    eps = np.asarray([1.0, 0.7])
+    sig = np.asarray([1.0, 0.88])
+    samples = []
+    for _t in range(n_traj):
+        cpd = rng.randint(2, 4)
+        spacing = 1.122
+        cell = cpd * spacing
+        base = np.stack(np.meshgrid(
+            *[np.arange(cpd) * spacing] * 3, indexing="ij"),
+            axis=-1).reshape(-1, 3)
+        z = rng.randint(0, 2, size=len(base))  # fixed composition per traj
+        for fr in range(frames):
+            jit = 0.03 + 0.01 * fr  # later frames jitter more
+            for _attempt in range(50):
+                pos = (base + rng.randn(*base.shape) * jit) % cell
+                d = pos[:, None, :] - pos[None, :, :]
+                d -= cell * np.round(d / cell)
+                r2 = (d ** 2).sum(-1)
+                np.fill_diagonal(r2, np.inf)
+                if r2.min() > 0.8 ** 2:
+                    break
+            total, forces = _binary_lj(pos, z, cell, eps, sig)
+            n = len(pos)
+            cellm = np.eye(3) * cell
+            ei, lengths = radius_graph_pbc(
+                pos, cellm, radius, max_neighbours=max_neighbours,
+                check_duplicates=False)
+            d1 = np.zeros(n)
+            d2 = np.zeros(n)
+            np.add.at(d1, ei[1], (1.0 - lengths / radius) ** 2)
+            np.add.at(d2, ei[1], np.exp(-(lengths / 1.2) ** 2))
+            samples.append(GraphSample(
+                x=np.stack([z.astype(float), d1, d2], 1).astype(np.float32),
+                pos=pos.astype(np.float32),
+                edge_index=ei,
+                edge_attr=(lengths.reshape(-1, 1) / radius).astype(np.float32),
+                graph_y=np.asarray([total / n], np.float32),
+                node_y=np.concatenate(
+                    [np.stack([z, d1, d2], 1), forces], 1).astype(np.float32),
+                cell=cellm.astype(np.float32),
+            ))
+    # standardize energy; scale forces by the same convention as LJ example
+    e = np.asarray([s.graph_y[0] for s in samples])
+    f = np.concatenate([s.node_y[:, 3:].reshape(-1) for s in samples])
+    mu, s_e = float(e.mean()), float(e.std()) or 1.0
+    s_f = float(f.std()) or 1.0
+    for s in samples:
+        s.graph_y = ((s.graph_y - mu) / s_e).astype(np.float32)
+        s.node_y = s.node_y.copy()
+        s.node_y[:, 3:] /= s_f
+    return samples
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inputfile", default=os.path.join(_HERE, "mptrj.json"))
+    ap.add_argument("--data", default="")  # harness compat
+    ap.add_argument("--num_traj", type=int, default=40)
+    ap.add_argument("--preonly", action="store_true")
+    ap.add_argument("--gpack", default=os.path.join(_HERE, "dataset/mptrj.gpack"))
+    ap.add_argument("--use_gpack", action="store_true")
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--batch_size", type=int, default=None)
+    args = ap.parse_args()
+
+    with open(args.inputfile) as f:
+        config = json.load(f)
+    training = config["NeuralNetwork"]["Training"]
+    if args.num_epoch:
+        training["num_epoch"] = args.num_epoch
+    if args.batch_size:
+        training["batch_size"] = args.batch_size
+    arch = config["NeuralNetwork"]["Architecture"]
+
+    if args.use_gpack and os.path.exists(args.gpack + ".p0"):
+        from hydragnn_tpu.data.gpack import GpackDataset
+
+        samples = list(GpackDataset(args.gpack, preload=True))
+    else:
+        samples = synthesize_trajectories(
+            args.num_traj, radius=float(arch.get("radius", 2.2)),
+            max_neighbours=int(arch.get("max_neighbours", 24)))
+
+    if args.preonly:
+        from hydragnn_tpu.data.gpack import GpackWriter
+
+        os.makedirs(os.path.dirname(args.gpack), exist_ok=True)
+        GpackWriter(args.gpack, rank=0).save(samples)
+        print(f"serialized {len(samples)} frames to {args.gpack}.p0")
+        return
+
+    trainset, valset, testset = split_dataset(samples, training["perc_train"])
+    stats = DatasetStats.from_samples(
+        samples, need_deg=arch["model_type"] == "PNA")
+    config = finalize(config, stats)
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    hs = head_specs_from_config(config)
+    gs, ns = label_slices_from_config(config)
+    bs = int(training["batch_size"])
+    n_local = len(jax.local_devices())
+    if n_local > 1:
+        bs = max(1, -(-bs // n_local))
+    tl, vl, sl = create_dataloaders(
+        trainset, valset, testset, bs, hs,
+        graph_feature_slices=gs, node_feature_slices=ns)
+
+    opt_spec = select_optimizer(training["Optimizer"])
+    state = create_train_state(model, next(iter(tl)), opt_spec)
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, tl, vl, sl,
+        config["NeuralNetwork"], "mptrj", verbosity=1)
+
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    error, tasks, tv, pv = test(eval_step, state, sl, cfg.num_heads,
+                                output_types=cfg.output_type)
+    names = config["NeuralNetwork"]["Variables_of_interest"]["output_names"]
+    print(f"test loss: {error:.6f}")
+    for i, name in enumerate(names):
+        mae = float(np.abs(np.asarray(tv[i]) - np.asarray(pv[i])).mean())
+        print(f"  head {name}: mae {mae:.6f}")
+    return error
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,7 @@ def main():
                   if need_deg else None)
 
     weights = [len(c) for c in corpora]
+    group = None
     if num_processes() > 1:
         my_color = assign_ensemble_groups(weights)
         group = HostGroup(my_color)
@@ -127,18 +128,31 @@ def main():
         n_local = len(jax.local_devices())
         if n_local > 1:
             bs = max(1, -(-bs // n_local))
+        # group members shard the corpus between them (DistributedSampler
+        # parity within the branch's sub-communicator)
         tl, vl, sl = create_dataloaders(
             trainset, valset, testset, bs, hs,
-            graph_feature_slices=gs, node_feature_slices=ns)
+            graph_feature_slices=gs, node_feature_slices=ns,
+            rank=group.rank if group else 0,
+            world_size=group.size if group else 1)
 
         opt_spec = select_optimizer(training["Optimizer"])
         state = create_train_state(model, next(iter(tl)), opt_spec)
+        # each branch trains over ITS OWN group mesh: gradients psum within
+        # the branch only (reference: one DDP model per comm.Split subcomm)
         state, hist = train_validate_test(
             model, model_cfg, state, opt_spec, tl, vl, sl,
-            cfg_c["NeuralNetwork"], f"multi_corpus{c}", verbosity=1)
+            cfg_c["NeuralNetwork"], f"multi_corpus{c}", verbosity=1,
+            mesh=group.mesh() if group else None)
         es = jax.jit(make_eval_step(model, model_cfg))
+        if group is not None:
+            # state leaves are replicated over the group mesh; pull the local
+            # full copy so the local-jit eval can consume it
+            state = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state)
         err, tasks, _, _ = test(es, state, sl, model_cfg.num_heads,
                                 output_types=model_cfg.output_type)
+        if group is not None:
+            err = group.mean_scalar(err)
         results[c] = err
         print(f"corpus {c}: test loss {err:.6f}")
     return results

@@ -1,0 +1,64 @@
+"""Asynchronous multi-job HPO over subprocess training trials.
+
+Parity with reference examples/multidataset_hpo/gfm_deephyper_multi.py:22-41
+(DeepHyper launching concurrent srun trials, each training on a node subset,
+validation loss scraped from stdout).  Here :func:`run_hpo_async` provides
+the async scheduler: a queue of node subsets feeds up to --n_concurrent
+simultaneous trials; each trial runs ``trial.py`` as a subprocess with its
+sampled hyperparameters passed as ``--hpo key=value`` args.
+
+Under SLURM the launch commands become ``srun --nodelist=...``; on a
+workstation they degrade to plain ``python`` subprocesses — same driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+
+from hydragnn_tpu.hpo import HP, run_hpo_async
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n_trials", type=int, default=4)
+    ap.add_argument("--n_concurrent", type=int, default=2)
+    ap.add_argument("--nodes_per_trial", type=int, default=1)
+    ap.add_argument("--num_epoch", type=int, default=4)
+    ap.add_argument("--num_mols", type=int, default=120)
+    args = ap.parse_args()
+
+    space = [
+        HP("lr", ("NeuralNetwork", "Training", "Optimizer", "learning_rate"),
+           low=1e-4, high=3e-2, log=True),
+        HP("hidden_dim", ("NeuralNetwork", "Architecture", "hidden_dim"),
+           choices=[8, 16, 32]),
+        HP("num_conv_layers",
+           ("NeuralNetwork", "Architecture", "num_conv_layers"),
+           choices=[2, 3]),
+    ]
+
+    best, trials = run_hpo_async(
+        os.path.join(_HERE, "trial.py"),
+        space,
+        n_trials=args.n_trials,
+        n_concurrent=args.n_concurrent,
+        nodes_per_trial=args.nodes_per_trial,
+        timeout=1200,
+        extra_args=["--num_epoch", str(args.num_epoch),
+                    "--num_mols", str(args.num_mols)],
+    )
+    for t in trials:
+        print(f"trial {t.number}: {t.state} val={t.value:.6f} "
+              f"params={t.params}")
+    print(f"BEST val loss: {best.value:.6f} params={best.params}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""One HPO trial: trains the qm9-style synthetic task with hyperparameters
+from ``--hpo key=value`` args and prints per-epoch "val loss:" lines for the
+async driver to scrape (reference gfm.py trial scripts print Val Loss the
+same way; gfm_deephyper_multi.py:35-41)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "examples", "qm9"))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from hydragnn_tpu.config.config import (
+    DatasetStats,
+    finalize,
+    head_specs_from_config,
+    label_slices_from_config,
+)
+from hydragnn_tpu.data.dataloader import create_dataloaders
+from hydragnn_tpu.data.splitting import split_dataset
+from hydragnn_tpu.hpo import apply_hpo_args
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import create_train_state, train_validate_test
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hpo", action="append", default=[],
+                    help="key.path=value overrides")
+    ap.add_argument("--num_epoch", type=int, default=4)
+    ap.add_argument("--num_mols", type=int, default=120)
+    args = ap.parse_args()
+
+    with open(os.path.join(_REPO, "examples", "qm9", "qm9.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+    config = apply_hpo_args(config, args.hpo)
+
+    from train import synthesize_molecules  # examples/qm9 driver
+
+    arch = config["NeuralNetwork"]["Architecture"]
+    samples = synthesize_molecules(
+        args.num_mols, radius=float(arch.get("radius", 2.0)))
+    trainset, valset, testset = split_dataset(
+        samples, config["NeuralNetwork"]["Training"]["perc_train"])
+    stats = DatasetStats.from_samples(
+        samples, need_deg=arch["model_type"] == "PNA")
+    config = finalize(config, stats)
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    hs = head_specs_from_config(config)
+    gs, ns = label_slices_from_config(config)
+    bs = int(config["NeuralNetwork"]["Training"]["batch_size"])
+    tl, vl, sl = create_dataloaders(
+        trainset, valset, testset, bs, hs,
+        graph_feature_slices=gs, node_feature_slices=ns)
+
+    opt = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = create_train_state(model, next(iter(tl)), opt)
+    # verbosity=1 prints "val loss:" per epoch — scraped by the driver
+    train_validate_test(
+        model, cfg, state, opt, tl, vl, sl,
+        config["NeuralNetwork"], "hpo_trial", verbosity=1)
+
+
+if __name__ == "__main__":
+    main()

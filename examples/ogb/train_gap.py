@@ -1,0 +1,33 @@
+"""OGB-style SMILES band-gap example (reference examples/ogb/train_gap.py).
+
+Same driver shape as examples/csce/train_gap.py — a CSV of SMILES strings
+with a gap column — but with the OGB node-type vocabulary (the reference's
+ogb driver differs from csce mainly in dataset format/column layout).  The
+shared loading/synthesis machinery is imported from the csce driver.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "examples", "csce"))
+
+import train_gap as csce
+
+
+def main():
+    # same pipeline; OGB CSVs carry the gap in the last column exactly like
+    # the csce loader expects, so the csce driver is reused with the ogb
+    # config (reference ogb/train_gap.py mirrors csce/train_gap.py)
+    if "--inputfile" not in sys.argv:
+        sys.argv += ["--inputfile",
+                     os.path.join(_HERE, "ogb_gap.json")]
+    return csce.main()
+
+
+if __name__ == "__main__":
+    main()

@@ -208,6 +208,101 @@ def build_launch_command(
     return list(cmd) + list(extra_args)
 
 
+def run_hpo_async(
+    trial_script: str,
+    space: Sequence[HP],
+    n_trials: int = 8,
+    n_concurrent: int = 2,
+    nodes: Optional[Sequence[str]] = None,
+    nodes_per_trial: int = 1,
+    procs_per_node: int = 1,
+    seed: int = 0,
+    timeout: float = 3600,
+    loss_pattern: str = "val loss:",
+    extra_args: Sequence[str] = (),
+) -> Tuple[Trial, List[Trial]]:
+    """Asynchronous multi-job HPO: up to ``n_concurrent`` subprocess trials
+    run simultaneously, each on its own node subset (the DeepHyper pattern —
+    reference examples/multidataset_hpo/gfm_deephyper_multi.py:22-41 launches
+    concurrent srun trials and regex-scrapes the validation loss).
+
+    Node subsets are managed by a queue: a finishing trial returns its nodes
+    so a queued trial can start — true async scheduling, not batched waves.
+    Each trial passes its sampled params as ``--hpo key=value`` args that the
+    trial script applies to its config.
+    """
+    import queue as _queue
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    nodes = list(nodes if nodes is not None else read_node_list())
+    under_scheduler = bool(os.getenv("SLURM_JOB_ID")) or \
+        os.getenv("HYDRAGNN_SYSTEM", "") in ("frontier", "perlmutter", "summit")
+    if under_scheduler:
+        groups: List[List[str]] = [
+            nodes[i:i + nodes_per_trial]
+            for i in range(0, len(nodes) - nodes_per_trial + 1,
+                           nodes_per_trial)
+        ] or [nodes]
+    else:
+        # workstation: build_launch_command ignores the node list, so don't
+        # let one 'localhost' entry serialize the trials — replicate it
+        groups = [list(nodes)] * max(n_concurrent, 1)
+    n_workers = max(1, min(n_concurrent, len(groups)))
+    free: "_queue.Queue" = _queue.Queue()
+    for g in groups:
+        free.put(g)
+
+    rng = np.random.RandomState(seed)
+    trials = [Trial(i, {hp.name: hp.sample(rng) for hp in space})
+              for i in range(n_trials)]
+
+    paths = {hp.name: ".".join(str(k) for k in hp.path) for hp in space}
+
+    def run_one(trial: Trial) -> Trial:
+        group = free.get()  # blocks until a node subset frees up
+        try:
+            hpo_args: List[str] = []
+            for k, v in trial.params.items():
+                hpo_args += ["--hpo", f"{paths[k]}={v}"]
+            cmd = build_launch_command(
+                trial_script, group, procs_per_node,
+                extra_args=list(extra_args) + hpo_args)
+            try:
+                trial.value = launch_trial_subprocess(
+                    cmd, timeout=timeout, loss_pattern=loss_pattern)
+                trial.state = ("complete"
+                               if math.isfinite(trial.value) else "failed")
+            except Exception as e:
+                trial.value, trial.state = float("inf"), f"failed: {e}"
+            return trial
+        finally:
+            free.put(group)  # hand the nodes to the next queued trial
+
+    with ThreadPoolExecutor(max_workers=n_workers) as pool:
+        trials = list(pool.map(run_one, trials))
+    best = min(trials, key=lambda t: t.value)
+    return best, trials
+
+
+def apply_hpo_args(config: Dict[str, Any],
+                   hpo_kvs: Sequence[str]) -> Dict[str, Any]:
+    """Apply ``key=value`` pairs from ``--hpo`` args to a config.  ``key`` is
+    a dot-path into the nested config (e.g.
+    ``NeuralNetwork.Training.Optimizer.learning_rate=0.01``)."""
+    import ast
+
+    for kv in hpo_kvs:
+        key, _, raw = kv.partition("=")
+        try:
+            value = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            value = raw
+        _set_path(config, key.split("."), value)
+    return config
+
+
 def launch_trial_subprocess(cmd: Sequence[str], timeout: float = 3600,
                             loss_pattern: str = "val loss:") -> float:
     """Run a trial subprocess and scrape its final validation loss (the

@@ -132,6 +132,23 @@ class HostGroup:
     def mean_scalar(self, value: float) -> float:
         return float(self.allreduce(np.asarray([value]), "sum")[0] / self.size)
 
+    def mesh(self, axis: str = "data"):
+        """1-axis data mesh over the member processes' devices.
+
+        The TPU-native analog of training on a sub-communicator: each
+        ensemble branch runs its OWN shard_map'd train step over its own
+        group mesh, so gradients psum only within the branch (reference
+        trains a DDP model per comm.Split subcommunicator,
+        examples/multidataset/train.py:229-247).  Groups execute disjoint
+        programs on disjoint devices — no cross-group collectives.
+        """
+        import jax
+        from hydragnn_tpu.parallel.mesh import make_mesh
+
+        members = set(self.members)
+        devs = [d for d in jax.devices() if d.process_index in members]
+        return make_mesh(devs, axis=axis)
+
 
 def assign_ensemble_groups(weights: Sequence[float]) -> int:
     """Proportional host allocation over ensemble branches; returns this

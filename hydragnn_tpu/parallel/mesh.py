@@ -99,12 +99,20 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     return jax.tree.map(put, state)
 
 
+def mesh_process_count(mesh: Mesh) -> int:
+    """Number of distinct processes owning this mesh's devices (== world size
+    for the default global mesh, == group size for a HostGroup mesh)."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
 def global_batch(stacked: GraphBatch, mesh: Mesh,
                  axis: str = DATA_AXIS) -> GraphBatch:
     """Assemble a host-local device-stacked batch [d_local, ...] into a global
     array [d_global, ...] sharded along ``axis`` (the multi-host analog of
-    DDP's per-rank batches; one jit sees the whole global batch)."""
-    n_proc = jax.process_count()
+    DDP's per-rank batches; one jit sees the whole global batch).  Works for
+    group meshes spanning a subset of processes: the global shape covers only
+    the mesh's processes."""
+    n_proc = mesh_process_count(mesh)
 
     def conv(x):
         x = np.asarray(x)

@@ -86,6 +86,120 @@ class Visualizer:
         fig.savefig(os.path.join(self.outdir, "error_pdf.png"))
         plt.close(fig)
 
+    # -- global analysis (reference visualizer.py:134-279) -----------------
+    @staticmethod
+    def _err_condmean(true_values: np.ndarray, predicted_values: np.ndarray,
+                      nbins: int = 20):
+        """Mean absolute error conditioned on the true value (binned)."""
+        t = np.asarray(true_values).reshape(-1)
+        p = np.asarray(predicted_values).reshape(-1)
+        err = np.abs(p - t)
+        edges = np.linspace(t.min(), t.max() + 1e-12, nbins + 1)
+        which = np.clip(np.digitize(t, edges) - 1, 0, nbins - 1)
+        centers, means = [], []
+        for b in range(nbins):
+            m = which == b
+            if m.any():
+                centers.append(0.5 * (edges[b] + edges[b + 1]))
+                means.append(err[m].mean())
+        return np.asarray(centers), np.asarray(means)
+
+    def create_plot_global_analysis(
+        self,
+        varname: str,
+        true_values,
+        predicted_values,
+        save_plot: bool = True,
+    ) -> None:
+        """Scatter + conditional-mean-error + error-PDF panel for one head
+        (reference create_plot_global_analysis, visualizer.py:134-279).
+
+        Scalar heads get one 1x3 row; vector heads get two rows analysing the
+        vector LENGTH and the component SUM per sample (the reference's
+        vlen/vsum panels)."""
+        plt = _plt()
+        t = np.asarray(true_values)
+        p = np.asarray(predicted_values)
+        if t.ndim == 1:
+            t, p = t[:, None], p[:, None]
+        dim = t.shape[1]
+
+        def _row(axs, tv, pv, label):
+            ax = axs[0]
+            ax.scatter(tv, pv, s=6, edgecolor="b", facecolor="none")
+            lo = float(min(tv.min(), pv.min()))
+            hi = float(max(tv.max(), pv.max()))
+            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            ax.set_title(f"{label}")
+            ax.set_xlabel("True")
+            ax.set_ylabel("Predicted")
+            ax = axs[1]
+            xs, em = self._err_condmean(tv, pv)
+            ax.plot(xs, em, "ro")
+            ax.set_title("Conditional mean abs. error")
+            ax.set_xlabel("True")
+            ax.set_ylabel("abs. error")
+            ax = axs[2]
+            hist1d, edges = np.histogram(pv - tv, bins=40, density=True)
+            ax.plot(0.5 * (edges[:-1] + edges[1:]), hist1d, "ro")
+            ax.set_title(f"{label}: error PDF")
+            ax.set_xlabel("Error")
+            ax.set_ylabel("PDF")
+
+        if dim == 1:
+            fig, axs = plt.subplots(1, 3, figsize=(15, 4.5))
+            _row(axs, t.reshape(-1), p.reshape(-1), f"{varname}")
+        else:
+            fig, axs = plt.subplots(2, 3, figsize=(15, 9))
+            tl = np.linalg.norm(t, axis=1)
+            pl = np.linalg.norm(p, axis=1)
+            _row(axs[0], tl, pl, f"{varname} |v|")
+            _row(axs[1], t.sum(axis=1), p.sum(axis=1), f"{varname} sum")
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(os.path.join(
+                self.outdir, f"global_analysis_{varname}.png"))
+        plt.close(fig)
+
+    def create_parity_plot_vector(
+        self,
+        varname: str,
+        true_values,
+        predicted_values,
+        head_dim: int,
+        iepoch: Optional[int] = None,
+        save_plot: bool = True,
+    ) -> None:
+        """Per-component parity grid for a vector head (reference
+        create_parity_plot_vector, visualizer.py:467-613)."""
+        import math
+
+        plt = _plt()
+        t = np.asarray(true_values).reshape(-1, head_dim)
+        p = np.asarray(predicted_values).reshape(-1, head_dim)
+        nrow = max(int(math.floor(math.sqrt(head_dim))), 1)
+        ncol = int(math.ceil(head_dim / nrow))
+        markers = ["o", "s", "d"]
+        fig, axs = plt.subplots(
+            nrow, ncol, figsize=(ncol * 4, nrow * 4), squeeze=False)
+        flat = axs.flatten()
+        for ic in range(head_dim):
+            ax = flat[ic]
+            ax.scatter(t[:, ic], p[:, ic], s=6, c="b",
+                       marker=markers[ic % len(markers)])
+            lo = float(min(t[:, ic].min(), p[:, ic].min()))
+            hi = float(max(t[:, ic].max(), p[:, ic].max()))
+            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            ax.set_title(f"comp:{ic}")
+        for ie in range(head_dim, flat.size):
+            flat[ie].axis("off")
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(os.path.join(
+                self.outdir, f"parity_vector_{varname}{suffix}.png"))
+        plt.close(fig)
+
     # -- loss history (reference visualizer.py:629-690) --------------------
     def plot_history(self, history: Dict[str, List[float]]) -> None:
         plt = _plt()

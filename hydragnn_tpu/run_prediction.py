@@ -72,4 +72,22 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
             predicted_values,
         )
 
+    viz = config.get("Visualization", {})
+    if viz.get("create_plots") and rank == 0:
+        from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+        var = config["NeuralNetwork"]["Variables_of_interest"]
+        names = var.get("output_names",
+                        [f"head{i}" for i in range(cfg.num_heads)])
+        v = Visualizer(log_name, num_heads=cfg.num_heads,
+                       head_dims=cfg.output_dim, logs_dir=logs_dir)
+        v.create_scatter_plots(true_values, predicted_values, names)
+        for ih in range(cfg.num_heads):
+            v.create_plot_global_analysis(
+                names[ih], true_values[ih], predicted_values[ih])
+            if int(cfg.output_dim[ih]) > 1:
+                v.create_parity_plot_vector(
+                    names[ih], true_values[ih], predicted_values[ih],
+                    int(cfg.output_dim[ih]))
+
     return error, tasks_error, true_values, predicted_values

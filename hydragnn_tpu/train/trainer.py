@@ -369,6 +369,7 @@ def train_validate_test(
     logs_dir: str = "./logs/",
     use_mesh_dp: Optional[bool] = None,
     profile_config: Optional[Dict[str, Any]] = None,
+    mesh=None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Epoch loop with LR plateau scheduling, early stopping, checkpointing.
 
@@ -390,8 +391,9 @@ def train_validate_test(
     if use_mesh_dp is None:
         # multi-process runs MUST take the global-mesh path even with one
         # device per process: the local-jit path would never synchronize
-        # gradients and each rank would train a divergent model.
-        use_mesh_dp = n_local_devices > 1 or n_proc > 1
+        # gradients and each rank would train a divergent model.  An explicit
+        # ``mesh`` (e.g. a HostGroup ensemble-branch mesh) also forces it.
+        use_mesh_dp = n_local_devices > 1 or n_proc > 1 or mesh is not None
     if use_mesh_dp:
         from hydragnn_tpu.parallel.mesh import (
             DeviceStackLoader,
@@ -399,10 +401,12 @@ def train_validate_test(
             make_dp_eval_step,
             make_dp_train_step,
             make_mesh,
+            mesh_process_count,
             replicate_state,
         )
 
-        mesh = make_mesh()  # global: every process's devices
+        if mesh is None:
+            mesh = make_mesh()  # global: every process's devices
         zero_specs = zero_dims = None
         if opt_spec.use_zero_redundancy:
             # ZeRO-1: optimizer state lives sharded along the data axis
@@ -424,7 +428,7 @@ def train_validate_test(
             val_loader, n_local_devices, drop_last=False)
         test_loader = DeviceStackLoader(
             test_loader, n_local_devices, drop_last=False)
-        if n_proc > 1:
+        if mesh_process_count(mesh) > 1:
             train_loader = GlobalBatchLoader(train_loader, mesh)
             val_loader = GlobalBatchLoader(val_loader, mesh)
             test_loader = GlobalBatchLoader(test_loader, mesh)

@@ -1,0 +1,146 @@
+"""Worker for the multi-host ensemble test: 4 jax.distributed CPU processes,
+2 branches of 2 hosts each (reference: one DDP model per comm.Split
+subcommunicator, examples/multidataset/train.py:205-247).
+
+Each branch trains the same architecture on ITS OWN corpus over a HostGroup
+mesh.  Asserted by the parent test: params bitwise-identical WITHIN a branch
+(in-group gradient sync), different ACROSS branches (no cross-group mixing),
+and group-reduced metrics agree within the branch.
+
+Usage: mp_ensemble_worker.py <rank> <world> <port> <scratch>
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+rank, world, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+scratch = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # one device per process
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=world,
+    process_id=rank,
+)
+assert jax.process_count() == world
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.chdir(scratch)
+
+import numpy as np
+
+from hydragnn_tpu.config.config import (
+    DatasetStats,
+    finalize,
+    head_specs_from_config,
+    label_slices_from_config,
+)
+from hydragnn_tpu.data.dataloader import create_dataloaders
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.parallel.comm import HostGroup, assign_ensemble_groups
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import create_train_state, train_validate_test
+
+
+def make_corpus(color: int, n: int = 96):
+    """Branch-specific synthetic corpus: target scale differs per branch so
+    the two branches provably learn different models."""
+    rng = np.random.RandomState(100 + color)
+    samples = []
+    for _ in range(n):
+        sz = rng.randint(6, 12)
+        pos = rng.rand(sz, 3).astype(np.float32) * 2.0
+        ei = radius_graph(pos, 1.2, 16)
+        if ei.shape[1] == 0:
+            continue
+        x = rng.rand(sz, 1).astype(np.float32)
+        y = (1.0 + color) * x.mean()  # branch-dependent target map
+        samples.append(GraphSample(
+            x=x, pos=pos, edge_index=ei,
+            graph_y=np.asarray([y], np.float32)))
+    return samples
+
+
+color = assign_ensemble_groups([1.0, 1.0])
+group = HostGroup(color)
+assert group.size == world // 2, (color, group.members)
+
+samples = make_corpus(color)
+
+config = {
+    "Dataset": {
+        "name": f"branch{color}",
+        "graph_features": {"name": ["y"], "dim": [1]},
+        "node_features": {"name": ["x"], "dim": [1]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "model_type": "SAGE",
+            "radius": 1.2,
+            "max_neighbours": 16,
+            "hidden_dim": 8,
+            "num_conv_layers": 2,
+            "output_heads": {
+                "graph": {"num_sharedlayers": 1, "dim_sharedlayers": 8,
+                          "num_headlayers": 1, "dim_headlayers": [8]}
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_names": ["y"],
+            "output_index": [0],
+            "output_dim": [1],
+            "type": ["graph"],
+        },
+        "Training": {
+            "num_epoch": 6,
+            "perc_train": 0.75,
+            "loss_function_type": "mse",
+            "batch_size": 8,
+            "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+        },
+    },
+}
+
+n_tr = int(len(samples) * 0.75)
+trainset, valset = samples[:n_tr], samples[n_tr:]
+stats = DatasetStats.from_samples(samples, need_deg=False)
+config = finalize(config, stats)
+cfg = ModelConfig.from_config(config["NeuralNetwork"])
+model = create_model(cfg)
+hs = head_specs_from_config(config)
+gs, ns = label_slices_from_config(config)
+
+# members shard the branch corpus between them
+tl, vl, sl = create_dataloaders(
+    trainset, valset, valset, 8, hs,
+    graph_feature_slices=gs, node_feature_slices=ns,
+    rank=group.rank, world_size=group.size)
+
+opt = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+state = create_train_state(model, next(iter(tl)), opt, seed=0)
+state, hist = train_validate_test(
+    model, cfg, state, opt, tl, vl, sl,
+    config["NeuralNetwork"], f"ens{color}", verbosity=0,
+    mesh=group.mesh(), logs_dir=os.path.join(scratch, "logs"))
+
+# digest of trained params: must match within the branch, differ across
+flat = np.concatenate([
+    np.asarray(jax.device_get(x)).ravel()
+    for x in jax.tree.leaves(state.params)])
+digest = hashlib.sha1(flat.astype(np.float64).tobytes()).hexdigest()[:16]
+val = group.mean_scalar(hist["val"][-1])
+print(f"ENSRESULT rank={rank} color={color} val={val:.8f} "
+      f"params={digest} train_last={hist['train'][-1]:.8f}", flush=True)

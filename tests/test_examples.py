@@ -48,6 +48,58 @@ def test_csce_gap_runs(tmp_path):
     assert os.path.exists(csv_path)
 
 
+def test_lsms_runs(tmp_path):
+    """LSMS config-driven driver through plain run_training.  cwd=tmp_path so
+    logs/ and serialized_dataset/ artifacts never land in the source tree."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SERIALIZED_DATA_PATH"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "lsms", "train.py"),
+         "--num_epoch", "3", "--num_configs", "80",
+         "--data", str(tmp_path / "data")],
+        cwd=str(tmp_path), env=env,
+        capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_ogb_gap_runs(tmp_path):
+    """OGB SMILES-gap variant of the csce driver."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "ogb", "train_gap.py"),
+         "--num_epoch", "2", "--num_mols", "60",
+         "--datafile", str(tmp_path / "ogb.csv")],
+        cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_hpo_multi_async_runs(tmp_path):
+    """Async multi-job HPO driver: 2 concurrent subprocess trials."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "multidataset_hpo", "hpo_multi.py"),
+         "--n_trials", "2", "--n_concurrent", "2",
+         "--num_epoch", "2", "--num_mols", "50"],
+        cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "BEST val loss" in r.stdout
+
+
+def test_mptrj_runs(tmp_path):
+    """MPTrj-style trajectories: energy+forces multitask with PNA."""
+    r = _run("mptrj", ["--num_epoch", "2", "--num_traj", "10"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
 def test_dftb_uv_spectrum_runs(tmp_path):
     """Wide-head (1000-dim spectrum) decoder stress (reference
     examples/dftb_uv_spectrum/train_smooth_uv_spectrum.py)."""

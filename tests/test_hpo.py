@@ -5,6 +5,8 @@ utils/deephyper.py)."""
 import json
 import os
 
+import pytest
+
 import hydragnn_tpu
 from hydragnn_tpu.hpo import HP, build_launch_command, read_node_list, run_hpo
 from test_graphs import _generate_data
@@ -45,3 +47,44 @@ def test_launch_command_builders(monkeypatch):
 
     cmd = build_launch_command("trial.py", ["localhost"], system="")
     assert cmd[0].endswith("python") or "python" in cmd[0]
+
+
+def test_apply_hpo_args():
+    from hydragnn_tpu.hpo import apply_hpo_args
+
+    cfg = {"NeuralNetwork": {"Training": {"Optimizer": {"learning_rate": 1.0},
+                                          "batch_size": 8}}}
+    apply_hpo_args(cfg, [
+        "NeuralNetwork.Training.Optimizer.learning_rate=0.005",
+        "NeuralNetwork.Training.batch_size=16",
+    ])
+    assert cfg["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"] == 0.005
+    assert cfg["NeuralNetwork"]["Training"]["batch_size"] == 16
+
+
+def test_run_hpo_async_subprocess(tmp_path):
+    """Async multi-job driver: concurrent subprocess trials, node-queue
+    scheduling, val-loss scraping, hyperparameters passed as config paths
+    (reference gfm_deephyper_multi.py:22-41)."""
+    from hydragnn_tpu.hpo import HP, run_hpo_async
+
+    trial = tmp_path / "trial.py"
+    trial.write_text(
+        "import argparse\n"
+        "ap = argparse.ArgumentParser()\n"
+        "ap.add_argument('--hpo', action='append', default=[])\n"
+        "a = ap.parse_args()\n"
+        "kv = dict(x.split('=') for x in a.hpo)\n"
+        "lr = float(kv['Training.Optimizer.learning_rate'])\n"
+        "print(f'val loss: {abs(lr - 0.01):.8f},')\n"
+    )
+    space = [HP("lr", ("Training", "Optimizer", "learning_rate"),
+                low=1e-3, high=1e-1, log=True)]
+    best, trials = run_hpo_async(
+        str(trial), space, n_trials=6, n_concurrent=3,
+        nodes=["localhost"], timeout=120)
+    assert len(trials) == 6
+    assert all(t.state == "complete" for t in trials)
+    # objective = |lr - 0.01|: the best trial is the sampled lr nearest 0.01
+    vals = {t.number: abs(t.params["lr"] - 0.01) for t in trials}
+    assert best.value == pytest.approx(min(vals.values()), abs=1e-6)

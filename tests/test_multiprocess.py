@@ -59,3 +59,51 @@ def test_two_process_training(tmp_path):
     assert results[0][3] == results[1][3]
     # training must have actually converged on the synthetic task
     assert results[0][1] < 0.2
+
+
+def test_ensemble_groups_two_branches(tmp_path):
+    """4 processes, 2 ensemble branches of 2 hosts (HostGroup meshes):
+    params must sync within a branch and diverge across branches, and
+    group-reduced metrics must agree within each branch (reference
+    comm.Split ensemble, examples/multidataset/train.py:205-247)."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "mp_ensemble_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), "4", str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for r in range(4)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=500)
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        m = re.search(
+            r"ENSRESULT rank=(\d) color=(\d) val=([\d.eE+-]+) "
+            r"params=([0-9a-f]+)", out)
+        assert m, out[-2000:]
+        results[int(m.group(1))] = (
+            int(m.group(2)), float(m.group(3)), m.group(4))
+
+    by_color = {}
+    for rank, (color, val, params) in results.items():
+        by_color.setdefault(color, []).append((val, params))
+    assert sorted(by_color) == [0, 1]
+    for color, rows in by_color.items():
+        assert len(rows) == 2
+        # in-group gradient sync: bitwise-identical params, equal metrics
+        assert rows[0][1] == rows[1][1], f"branch {color} params diverged"
+        assert rows[0][0] == pytest.approx(rows[1][0], rel=1e-6)
+    # branches trained different corpora -> different models
+    assert by_color[0][0][1] != by_color[1][0][1]
