@@ -1,0 +1,39 @@
+"""ANI-1x example: CHNO organic-molecule energies + forces (reference
+examples/ani1_x — HDF5 conformations, wB97x energies/forces).
+
+Same conformers-across-chemical-space shape as qm7x but with denser
+conformer sampling (ANI-1x oversamples normal-mode displacements).  The
+qm7x synthesis and the md17 training pipeline are loaded explicitly by file
+path (several example dirs each define a ``train.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+
+from examples.example_driver import default_inputfile, load_example_module
+
+
+def main():
+    default_inputfile(os.path.join(_HERE, "ani1x.json"))
+    md17 = load_example_module(
+        "md17_train", os.path.join(_REPO, "examples", "md17", "train.py"))
+    qm7x = load_example_module(
+        "qm7x_train", os.path.join(_REPO, "examples", "qm7x", "train.py"))
+
+    original = md17.synthesize_md_trajectory
+    md17.synthesize_md_trajectory = lambda radius=2.2, **kw: \
+        qm7x.synthesize_qm7x(n_mols=80, conformers=6, seed=1, radius=radius)
+    try:
+        return md17.main()
+    finally:
+        md17.synthesize_md_trajectory = original
+
+
+if __name__ == "__main__":
+    main()

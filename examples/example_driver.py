@@ -1,0 +1,127 @@
+"""Shared driver for the small synthetic-energy example dirs.
+
+Several reference example dirs (eam, ising_model, alexandria, ...) share the
+same flow: parse args -> load JSON config -> synthesize samples -> split ->
+finalize config from dataset stats -> build model/optimizer -> train -> test
+and print a MAE.  Each example supplies only its synthesis function and
+config; the flow lives here once so fixes land once (the heavier examples —
+LennardJones, open_catalyst, mptrj — keep their own drivers because they add
+gpack/preonly/force paths).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def default_inputfile(path: str) -> None:
+    """Append ``--inputfile path`` unless the caller already passed one
+    (either as ``--inputfile PATH`` or ``--inputfile=PATH`` — a bare
+    substring test would miss the ``=`` form and silently override it)."""
+    if not any(a == "--inputfile" or a.startswith("--inputfile=")
+               for a in sys.argv[1:]):
+        sys.argv += ["--inputfile", path]
+
+
+def load_example_module(name: str, path: str):
+    """Load another example's ``train.py`` by FILE PATH under a unique module
+    name (several example dirs each define a ``train.py``, so a bare
+    ``import train`` binds whichever dir happens to be first on sys.path).
+    Cached: repeated loads share one module object, so monkeypatches made by
+    one example are visible to another that builds on it."""
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def standardize_graph_energy(samples):
+    """In-place zero-mean/unit-std of the scalar graph target."""
+    e = np.asarray([s.graph_y[0] for s in samples])
+    mu, sd = float(e.mean()), float(e.std()) or 1.0
+    for s in samples:
+        s.graph_y = ((s.graph_y - mu) / sd).astype(np.float32)
+    return samples
+
+
+def run_energy_example(inputfile_default: str, log_name: str, synthesize,
+                       num_configs_default: int = 250,
+                       metric_label: str = "energy MAE (standardized)"):
+    """``synthesize(num_configs, arch_config) -> list[GraphSample]``."""
+    import jax
+
+    from hydragnn_tpu.config.config import (
+        DatasetStats,
+        finalize,
+        head_specs_from_config,
+        label_slices_from_config,
+    )
+    from hydragnn_tpu.data.dataloader import create_dataloaders
+    from hydragnn_tpu.data.splitting import split_dataset
+    from hydragnn_tpu.models.base import ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import (
+        create_train_state,
+        make_eval_step,
+        test,
+        train_validate_test,
+    )
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inputfile", default=inputfile_default)
+    ap.add_argument("--data", default="")  # harness compat
+    ap.add_argument("--num_configs", type=int, default=num_configs_default)
+    ap.add_argument("--num_epoch", type=int, default=None)
+    args = ap.parse_args()
+
+    with open(args.inputfile) as f:
+        config = json.load(f)
+    training = config["NeuralNetwork"]["Training"]
+    if args.num_epoch is not None:
+        training["num_epoch"] = args.num_epoch
+    arch = config["NeuralNetwork"]["Architecture"]
+
+    samples = synthesize(args.num_configs, arch)
+    trainset, valset, testset = split_dataset(samples, training["perc_train"])
+    stats = DatasetStats.from_samples(
+        samples, need_deg=arch["model_type"] == "PNA")
+    config = finalize(config, stats)
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    hs = head_specs_from_config(config)
+    gs, ns = label_slices_from_config(config)
+    bs = int(training["batch_size"])
+    n_local = len(jax.local_devices())
+    if n_local > 1:
+        bs = max(1, -(-bs // n_local))
+    tl, vl, sl = create_dataloaders(
+        trainset, valset, testset, bs, hs,
+        graph_feature_slices=gs, node_feature_slices=ns)
+
+    opt_spec = select_optimizer(training["Optimizer"])
+    state = create_train_state(model, next(iter(tl)), opt_spec)
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, tl, vl, sl,
+        config["NeuralNetwork"], log_name, verbosity=1)
+
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    error, tasks, tv, pv = test(eval_step, state, sl, cfg.num_heads,
+                                output_types=cfg.output_type)
+    mae = float(np.abs(np.asarray(tv[0]) - np.asarray(pv[0])).mean())
+    print(f"test loss: {error:.6f}  {metric_label}: {mae:.6f}")
+    return error

@@ -14,18 +14,18 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
 sys.path.insert(0, _REPO)
-sys.path.insert(0, os.path.join(_REPO, "examples", "csce"))
 
-import train_gap as csce
+from examples.example_driver import default_inputfile, load_example_module
+
+csce = load_example_module(
+    "csce_train_gap", os.path.join(_REPO, "examples", "csce", "train_gap.py"))
 
 
 def main():
     # same pipeline; OGB CSVs carry the gap in the last column exactly like
     # the csce loader expects, so the csce driver is reused with the ogb
     # config (reference ogb/train_gap.py mirrors csce/train_gap.py)
-    if "--inputfile" not in sys.argv:
-        sys.argv += ["--inputfile",
-                     os.path.join(_HERE, "ogb_gap.json")]
+    default_inputfile(os.path.join(_HERE, "ogb_gap.json"))
     return csce.main()
 
 

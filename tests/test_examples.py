@@ -110,18 +110,18 @@ def test_dftb_uv_spectrum_runs(tmp_path):
 
 def test_open_catalyst_runs(tmp_path):
     """OC20-IS2RE-style driver (BASELINE scale config: OC20 + DimeNet)."""
-    r = _run("open_catalyst",
+    r = _run("open_catalyst_2020",
              ["--num_epoch", "2", "--num_frames", "40"])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
 
 
 def test_open_catalyst_preonly_gpack(tmp_path):
     gpack = str(tmp_path / "oc.gpack")
-    r = _run("open_catalyst",
+    r = _run("open_catalyst_2020",
              ["--preonly", "--gpack", gpack, "--num_frames", "30"])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert os.path.exists(gpack + ".p0")
-    r = _run("open_catalyst",
+    r = _run("open_catalyst_2020",
              ["--use_gpack", "--gpack", gpack, "--num_epoch", "2"])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
 
@@ -136,4 +136,18 @@ def test_lj_preonly_gpack_roundtrip(tmp_path):
     r = _run("LennardJones",
              ["--use_gpack", "--gpack", gpack, "--data", data,
               "--num_epoch", "2"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+@pytest.mark.parametrize("example,extra", [
+    ("ising_model", ["--num_configs", "60"]),
+    ("eam", ["--num_configs", "50"]),
+    ("qm7x", []),
+    ("ani1_x", []),
+    ("alexandria", ["--num_configs", "40"]),
+    ("open_catalyst_2022", ["--num_frames", "30"]),
+])
+def test_more_example_dirs(example, extra, tmp_path):
+    """Breadth coverage of the remaining reference example dirs."""
+    r = _run(example, ["--num_epoch", "2", *extra])
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
