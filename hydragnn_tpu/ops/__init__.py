@@ -1,0 +1,7 @@
+"""TPU kernels and backend-selectable aggregation primitives."""
+
+from hydragnn_tpu.ops.aggregate import (  # noqa: F401
+    aggr_backend,
+    segment_sum_onehot,
+    segment_sum_pallas,
+)

@@ -1,0 +1,153 @@
+"""Aggregation backends for the message-passing scatter-add.
+
+The reference exposes HYDRAGNN_AGGR_BACKEND to switch PyG's aggregation
+between torch-scatter and its native fallback (reference
+hydragnn/train/train_validate_test.py:373-378).  Here the same knob selects
+how ``graph/segment.py:segment_sum`` lowers on the device:
+
+- ``scatter`` (default): ``jax.ops.segment_sum`` — XLA's sort/scatter path.
+- ``onehot``: one-hot × messages matmul in plain jnp.  O(E·N·F) FLOPs, but
+  they run on the MXU systolic array at full rate, which on TPU often beats
+  the scatter path for the padded static shapes this framework batches to.
+- ``pallas``: hand-written Pallas kernel of the same one-hot contraction,
+  blocked over edges so the one-hot tile is built on the fly in VMEM and
+  never materialized in HBM (the jnp version materializes an [E, N] array).
+
+All backends are exact (no atomics — deterministic accumulation order) and
+differentiable; ``segment_sum``'s gradient is a gather, which the custom VJP
+implements directly instead of differentiating through the kernel.
+
+Measured on the real chip (v-era TPU, f32): isolated segment_sum at
+E=32768/N=2560/F=64 runs 0.9-1.5ms for onehot vs 1.2ms scatter vs 1.2ms
+pallas; end-to-end on the flagship QM9-SchNet bench the XLA scatter path
+wins (60.1k graphs/s vs 58.2k onehot, 38.4k pallas — the standalone kernel
+can't fuse into neighboring elementwise ops the way XLA's scatter does), so
+``scatter`` stays the default and the others are shape-dependent tuning
+knobs, not a blanket win.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+_EDGE_BLOCK = 256  # edges per grid step; onehot tile = _EDGE_BLOCK x N_pad
+
+
+def aggr_backend() -> str:
+    """Current backend name.  The env knob is read at TRACE time: a jitted
+    caller (every real train/eval step) pins whichever backend was active
+    when it was first traced, so set the knob before building the step —
+    flipping it mid-process does not retrace cached executables."""
+    return os.environ.get("HYDRAGNN_AGGR_BACKEND", "scatter").lower()
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# onehot backend: plain jnp, XLA fuses the one-hot build into the matmul
+# ---------------------------------------------------------------------------
+
+def segment_sum_onehot(data, segment_ids, num_segments):
+    """sum_e onehot[e, n] * data[e, f] on the MXU.  data: [E, ...]."""
+    shape = data.shape
+    flat = data.reshape(shape[0], -1)
+    onehot = jax.nn.one_hot(segment_ids, num_segments, dtype=flat.dtype)
+    # HIGHEST matches scatter bit-accuracy (default bf16 passes round the
+    # messages to 8 mantissa bits) and measured the same speed on-chip —
+    # this contraction is HBM-bandwidth-bound, not MXU-bound
+    out = jax.lax.dot_general(
+        onehot, flat, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).astype(flat.dtype)
+    return out.reshape((num_segments,) + shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# pallas backend: blocked one-hot contraction, accumulated across grid steps
+# ---------------------------------------------------------------------------
+
+def _segment_kernel(seg_ref, data_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    seg = seg_ref[:]                                   # [BE, 1] int32
+    n_pad = out_ref.shape[0]
+    # compute in f32 regardless of input dtype: bf16->f32 upcast is exact and
+    # Mosaic rejects bf16 operands under an fp32 contract precision
+    onehot = (seg == jax.lax.broadcasted_iota(
+        jnp.int32, (seg.shape[0], n_pad), 1)).astype(jnp.float32)
+    out_ref[:] += jax.lax.dot_general(
+        onehot, data_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+
+
+def _pallas_segment_sum_impl(data2d, segment_ids, n_pad: int,
+                             interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e, f = data2d.shape
+    e_pad = _round_up(max(e, 1), _EDGE_BLOCK)
+    f_pad = _round_up(max(f, 1), 128)
+    # padded edges carry zero data -> contribute zeros wherever they scatter
+    data_p = jnp.zeros((e_pad, f_pad), data2d.dtype).at[:e, :f].set(data2d)
+    seg_p = jnp.zeros((e_pad, 1), jnp.int32).at[:e, 0].set(
+        segment_ids.astype(jnp.int32))
+
+    # accumulator is ALWAYS f32 (bf16 inputs accumulate in f32 on the MXU;
+    # a bf16 out_ref would both reject the f32 store and lose the guarantee)
+    return pl.pallas_call(
+        _segment_kernel,
+        grid=(e_pad // _EDGE_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((_EDGE_BLOCK, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_EDGE_BLOCK, f_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_pad, f_pad), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
+        interpret=interpret,
+    )(seg_p, data_p)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _pallas_segment_sum(data2d, segment_ids, num_segments):
+    interpret = jax.default_backend() != "tpu"
+    n_pad = _round_up(num_segments, 128)
+    out = _pallas_segment_sum_impl(data2d, segment_ids, n_pad, interpret)
+    return out[:num_segments, :data2d.shape[1]].astype(data2d.dtype)
+
+
+def _fwd(data2d, segment_ids, num_segments):
+    return _pallas_segment_sum(data2d, segment_ids, num_segments), segment_ids
+
+
+def _bwd(num_segments, segment_ids, g):
+    # d/d(data)[e] = g[segment_ids[e]] — a row gather, no kernel needed.
+    # Out-of-range ids (padded edges) were DROPPED in the forward, so their
+    # gradient is zero; a bare gather would clamp them onto the last row.
+    valid = (segment_ids >= 0) & (segment_ids < num_segments)
+    safe = jnp.clip(segment_ids, 0, num_segments - 1)
+    return jnp.where(valid[:, None], g[safe], 0.0), None
+
+
+_pallas_segment_sum.defvjp(_fwd, _bwd)
+
+
+def segment_sum_pallas(data, segment_ids, num_segments):
+    shape = data.shape
+    out = _pallas_segment_sum(
+        data.reshape(shape[0], -1), segment_ids, num_segments)
+    return out.reshape((num_segments,) + shape[1:])
