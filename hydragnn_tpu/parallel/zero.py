@@ -62,6 +62,23 @@ def shard_opt_state(opt_state, mesh: Mesh, axis: str):
     return sharded, specs, orig_dims
 
 
+def shard_state_for_zero(state, mesh: Mesh, axis: str = "data"):
+    """Replicate a TrainState EXCEPT its optimizer state, which is sharded
+    along ``axis``.  Returns (state, zero_specs, zero_dims) ready for
+    ``make_dp_train_step(..., zero_specs=zero_specs)``.
+
+    The order matters: the opt state must be pulled to host and sharded
+    BEFORE the rest of the state is replicated (replicating the full state
+    first would materialize the duplicate moments ZeRO exists to avoid).
+    """
+    from hydragnn_tpu.parallel.mesh import replicate_state
+
+    opt_sharded, zero_specs, zero_dims = shard_opt_state(
+        jax.device_get(state.opt_state), mesh, axis)
+    state = replicate_state(state.replace(opt_state=()), mesh)
+    return state.replace(opt_state=opt_sharded), zero_specs, zero_dims
+
+
 def consolidate_opt_state(sharded_opt_state, orig_dims, mesh: Mesh):
     """Gather + unpad a ZeRO-sharded optimizer state back to full shapes
     (the reference's consolidate_state_dict before checkpoint save)."""

@@ -411,12 +411,10 @@ def train_validate_test(
         if opt_spec.use_zero_redundancy:
             # ZeRO-1: optimizer state lives sharded along the data axis
             # (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
-            from hydragnn_tpu.parallel.zero import shard_opt_state
+            from hydragnn_tpu.parallel.zero import shard_state_for_zero
 
-            opt_sharded, zero_specs, zero_dims = shard_opt_state(
-                jax.device_get(state.opt_state), mesh, "data")
-            state = replicate_state(state.replace(opt_state=()), mesh)
-            state = state.replace(opt_state=opt_sharded)
+            state, zero_specs, zero_dims = shard_state_for_zero(
+                state, mesh, "data")
         else:
             state = replicate_state(state, mesh)
         train_step = make_dp_train_step(
