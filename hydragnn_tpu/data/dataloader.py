@@ -251,12 +251,19 @@ def create_dataloaders(
 
     ``n_buckets`` (or env HYDRAGNN_NUM_BUCKETS) > 1 enables graph-size
     bucketing: each batch pads to the smallest of n_buckets PadSpecs that
-    fits.  ``bucket_group`` defaults to the local device count so batches
-    stacked per-device by the mesh DP path share a bucket.
+    fits.  The reference's HYDRAGNN_USE_VARIABLE_GRAPH_SIZE knob
+    (train_validate_test.py:373-375) maps to the same machinery: setting it
+    enables bucketing with a default of 4 buckets.  ``bucket_group``
+    defaults to the local device count so batches stacked per-device by the
+    mesh DP path share a bucket.
     """
     all_samples = list(trainset) + list(valset) + list(testset)
     if n_buckets is None:
-        n_buckets = int(os.getenv("HYDRAGNN_NUM_BUCKETS", "1"))
+        n_buckets = int(os.getenv("HYDRAGNN_NUM_BUCKETS", "0") or 0)
+        if n_buckets < 1:
+            # "0"/"false" must DISABLE (repo convention: HYDRAGNN_VALTEST=0)
+            flag = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", "")
+            n_buckets = 4 if flag not in ("", "0", "false", "False") else 1
     if world_size > 1:
         # multi-process: every rank must assemble the same global array
         # shape each step, but bucket choice depends on rank-local samples —

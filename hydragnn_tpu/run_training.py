@@ -64,13 +64,20 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
     example = next(iter(train_loader))
     state = create_train_state(model, example, opt_spec, seed=seed)
 
-    # warm start (reference load_existing_model_config, utils/model.py:81-84)
+    # warm start (reference load_existing_model_config, utils/model.py:81-84).
+    # An orbax full-state checkpoint (step counter + opt state included) is
+    # preferred over the best-model pickle when one exists.
     training = config["NeuralNetwork"]["Training"]
     if training.get("continue", 0):
         from hydragnn_tpu.train.trainer import load_state
+        from hydragnn_tpu.utils.checkpoint import latest_step, restore_checkpoint
 
         start_from = training.get("startfrom", log_name)
-        state = load_state(state, start_from, logs_dir)
+        orbax_dir = os.path.join(logs_dir, start_from, "orbax")
+        if latest_step(orbax_dir) is not None:
+            state = restore_checkpoint(state, orbax_dir)
+        else:
+            state = load_state(state, start_from, logs_dir)
 
     writer = None
     if rank == 0:

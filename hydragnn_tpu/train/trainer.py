@@ -440,15 +440,28 @@ def train_validate_test(
     earlystopper = None
     if training.get("EarlyStopping"):
         earlystopper = EarlyStopping(patience=training.get("patience", 10))
+    # ZeRO-sharded optimizer state must be consolidated (all_gather over the
+    # mesh — a collective EVERY process participates in) before any
+    # serialization; one definition serves the pickle and orbax paths.
+    consolidate = lambda s: s  # noqa: E731
+    if use_mesh_dp and zero_dims is not None:
+        from hydragnn_tpu.parallel.zero import consolidate_opt_state
+
+        consolidate = lambda s: s.replace(  # noqa: E731
+            opt_state=consolidate_opt_state(s.opt_state, zero_dims, mesh))
+
     checkpointer = None
     if training.get("Checkpoint") and rank == 0:
         checkpointer = CheckpointTracker(
             log_name, warmup=training.get("checkpoint_warmup", 0), path=logs_dir)
-        if use_mesh_dp and zero_dims is not None:
-            from hydragnn_tpu.parallel.zero import consolidate_opt_state
+        checkpointer.transform = consolidate
 
-            checkpointer.transform = lambda s: s.replace(
-                opt_state=consolidate_opt_state(s.opt_state, zero_dims, mesh))
+    # Orbax FULL-train-state checkpoint (step counter + params + batch stats
+    # + opt state) every N epochs — beyond the reference's best-model pickle,
+    # which restarts at epoch 0 (utils/model.py:58-103).  run_training's
+    # ``continue`` path prefers this over the pickle when present.
+    orbax_every = int(training.get("full_state_checkpoint", 0) or 0)
+    orbax_dir = os.path.join(logs_dir, log_name, "orbax")
 
     from hydragnn_tpu.utils.print_utils import print_distributed
     from hydragnn_tpu.utils import tracer as tr
@@ -515,6 +528,13 @@ def train_validate_test(
 
         if checkpointer is not None:
             checkpointer(state, val_loss)
+        if orbax_every and (epoch + 1) % orbax_every == 0:
+            # EVERY process calls this: the ZeRO consolidation jit and
+            # orbax's CheckpointManager are both cross-process collectives —
+            # a rank-0 gate would deadlock multi-host runs.
+            from hydragnn_tpu.utils.checkpoint import save_checkpoint
+
+            save_checkpoint(consolidate(state), orbax_dir)
         if earlystopper is not None and earlystopper(val_loss):
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break

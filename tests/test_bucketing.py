@@ -147,6 +147,13 @@ def test_create_dataloaders_bucket_env(monkeypatch):
         rank=0, world_size=2)
     inner2 = getattr(tr2, "loader", tr2)
     assert len(inner2.pad_specs) == 1
+    # reference knob name: variable graph size -> bucketing (4 by default)
+    monkeypatch.delenv("HYDRAGNN_NUM_BUCKETS")
+    monkeypatch.setenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", "1")
+    tr3, _, _ = create_dataloaders(
+        samples[:80], samples[80:100], samples[100:], 16, heads)
+    inner3 = getattr(tr3, "loader", tr3)
+    assert len(inner3.pad_specs) > 1
 
 
 def test_prefetch_preserves_order_with_buckets():

@@ -68,3 +68,41 @@ def test_state_roundtrip(tmp_path):
     restored = load_state(state, "roundtrip", str(tmp_path))
     for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_full_state_resume(tmp_path, monkeypatch):
+    """Training.full_state_checkpoint writes orbax full-state epochs;
+    Training.continue restores it (step counter included) through
+    run_training — the step-level-resume capability the reference lacks."""
+    import jax
+
+    monkeypatch.chdir(tmp_path)
+    with open(os.path.join(os.path.dirname(__file__), "inputs", "ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "GIN"
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    config["NeuralNetwork"]["Training"]["full_state_checkpoint"] = 1
+    _generate_data(config, num_samples_tot=60)
+
+    state1, _, _ = hydragnn_tpu.run_training(
+        config, logs_dir=str(tmp_path / "logs"))
+    step1 = int(state1.step)
+    assert step1 > 0
+    # the orbax dir must exist — otherwise `continue` silently falls back to
+    # the pickle (which also carries step) and this test asserts nothing
+    from hydragnn_tpu.config.config import get_log_name_config
+    from hydragnn_tpu.utils.checkpoint import latest_step
+
+    orbax_dir = str(tmp_path / "logs" / get_log_name_config(config) / "orbax")
+    assert latest_step(orbax_dir) is not None, "orbax checkpoint not written"
+
+    config["NeuralNetwork"]["Training"]["continue"] = 1
+    state2, _, _ = hydragnn_tpu.run_training(
+        config, logs_dir=str(tmp_path / "logs"))
+    # resumed run starts from the restored step counter, not zero
+    assert int(state2.step) > step1
+    leaves1 = jax.tree.leaves(state1.params)
+    leaves2 = jax.tree.leaves(state2.params)
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(leaves1, leaves2)), "continued run did not train"
