@@ -254,12 +254,19 @@ class EarlyStopping:
 
 
 class CheckpointTracker:
-    """Best-metric checkpointing with warmup (reference utils/model.py:191-224)."""
+    """Best-metric checkpointing with warmup (reference utils/model.py:191-224).
 
-    def __init__(self, name: str, warmup: int = 0, path: str = "./logs/"):
+    Runs on EVERY rank: the metric is globally reduced, so the save decision
+    is identical everywhere, and the transform may be a cross-process
+    collective (ZeRO consolidation all_gather) that would deadlock behind a
+    rank-0 gate.  Only rank 0 actually writes the file."""
+
+    def __init__(self, name: str, warmup: int = 0, path: str = "./logs/",
+                 rank: int = 0):
         self.name = name
         self.warmup = warmup
         self.path = path
+        self.rank = rank
         self.count = 0
         self.best = float("inf")
         # e.g. ZeRO opt-state consolidation before serialization (reference
@@ -271,7 +278,7 @@ class CheckpointTracker:
         if self.count < self.warmup or metric >= self.best:
             return False
         self.best = metric
-        save_state(self.transform(state), self.name, self.path)
+        save_state(self.transform(state), self.name, self.path, rank=self.rank)
         return True
 
 
@@ -364,7 +371,7 @@ def train_validate_test(
     log_name: str,
     verbosity: int = 0,
     writer=None,
-    rank: int = 0,
+    rank: Optional[int] = None,
     world_size: int = 1,
     logs_dir: str = "./logs/",
     use_mesh_dp: Optional[bool] = None,
@@ -385,6 +392,17 @@ def train_validate_test(
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     output_names = config_nn["Variables_of_interest"].get("output_names")
+
+    if rank is None:
+        # who writes artifacts for this log_name: with an explicit (branch)
+        # mesh, the branch's lowest process is its leader — rank 0 within the
+        # branch even when global process 0 is in another branch; otherwise
+        # the global process index (0 for single-process runs).
+        if mesh is not None:
+            leader = min(d.process_index for d in mesh.devices.flat)
+            rank = 0 if jax.process_index() == leader else 1
+        else:
+            rank = jax.process_index()
 
     n_local_devices = len(jax.local_devices())
     n_proc = jax.process_count()
@@ -451,9 +469,10 @@ def train_validate_test(
             opt_state=consolidate_opt_state(s.opt_state, zero_dims, mesh))
 
     checkpointer = None
-    if training.get("Checkpoint") and rank == 0:
+    if training.get("Checkpoint"):
         checkpointer = CheckpointTracker(
-            log_name, warmup=training.get("checkpoint_warmup", 0), path=logs_dir)
+            log_name, warmup=training.get("checkpoint_warmup", 0),
+            path=logs_dir, rank=rank)
         checkpointer.transform = consolidate
 
     # Orbax FULL-train-state checkpoint (step counter + params + batch stats
