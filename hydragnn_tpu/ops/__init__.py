@@ -4,4 +4,5 @@ from hydragnn_tpu.ops.aggregate import (  # noqa: F401
     aggr_backend,
     segment_sum_onehot,
     segment_sum_pallas,
+    segment_sum_sorted,
 )

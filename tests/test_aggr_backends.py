@@ -77,6 +77,56 @@ def test_bf16_inputs(backend):
         rtol=0.05, atol=0.05)
 
 
+def _sorted_case(n=600, max_deg=12, f=7, seed=0):
+    rng = np.random.RandomState(seed)
+    counts = rng.randint(0, max_deg + 1, size=n)
+    ids = np.repeat(np.arange(n), counts)
+    data = rng.randn(len(ids), f).astype(np.float32)
+    return jnp.asarray(data), jnp.asarray(ids), n, max_deg
+
+
+def test_sorted_forward_and_grad_match_scatter():
+    from hydragnn_tpu.ops.aggregate import segment_sum_sorted
+
+    data, ids, n, k = _sorted_case()
+    want = jax.ops.segment_sum(data, ids, n)
+    got = segment_sum_sorted(data, ids, n, k)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    w = jnp.asarray(np.random.RandomState(1).randn(n, data.shape[1]),
+                    jnp.float32)
+    g_want = jax.grad(
+        lambda d: jnp.sum(jax.ops.segment_sum(d, ids, n) * w))(data)
+    g_got = jax.grad(
+        lambda d: jnp.sum(segment_sum_sorted(d, ids, n, k) * w))(data)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sorted_on_collated_receivers():
+    """The real invariant source: collate's receivers with a padded tail."""
+    from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+    from hydragnn_tpu.ops.aggregate import segment_sum_sorted
+
+    rng = np.random.RandomState(2)
+    samples = []
+    for _ in range(6):
+        pos = rng.rand(10, 3).astype(np.float32) * 2.5
+        samples.append(GraphSample(
+            x=rng.rand(10, 1).astype(np.float32), pos=pos,
+            edge_index=radius_graph(pos, 1.3, 8),
+            graph_y=rng.rand(1).astype(np.float32)))
+    b = collate(samples, PadSpec.for_batch(6, 12, 90),
+                [HeadSpec("e", "graph", 1)])
+    data = jnp.asarray(
+        rng.randn(b.num_edges, 5).astype(np.float32)) * b.edge_mask[:, None]
+    want = jax.ops.segment_sum(data, b.receivers, b.num_nodes)
+    got = segment_sum_sorted(data, b.receivers, b.num_nodes, 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 @pytest.mark.parametrize("backend", ["onehot", "pallas"])
 def test_env_knob_dispatch(backend, monkeypatch):
     """segment.segment_sum honors HYDRAGNN_AGGR_BACKEND, including masks.
