@@ -85,10 +85,11 @@ def _sorted_case(n=600, max_deg=12, f=7, seed=0):
     return jnp.asarray(data), jnp.asarray(ids), n, max_deg
 
 
-def test_sorted_forward_and_grad_match_scatter():
+@pytest.mark.parametrize("n", [600, 2500])  # 2500 spans >2 node blocks of 1024
+def test_sorted_forward_and_grad_match_scatter(n):
     from hydragnn_tpu.ops.aggregate import segment_sum_sorted
 
-    data, ids, n, k = _sorted_case()
+    data, ids, n, k = _sorted_case(n=n)
     want = jax.ops.segment_sum(data, ids, n)
     got = segment_sum_sorted(data, ids, n, k)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
