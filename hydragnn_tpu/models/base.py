@@ -36,6 +36,18 @@ from hydragnn_tpu.models.layers import (
 )
 
 
+def _validated_compute_dtype(arch) -> str:
+    """"bfloat16" via ``mixed_precision: true`` or an explicit
+    ``compute_dtype``; anything unrecognized raises instead of silently
+    training in f32 while the user believes bf16 is on."""
+    dt = ("bfloat16" if arch.get("mixed_precision")
+          else arch.get("compute_dtype", "float32"))
+    if dt not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"compute_dtype must be 'float32' or 'bfloat16', got {dt!r}")
+    return dt
+
+
 @dataclasses.dataclass(frozen=True)
 class GraphHeadCfg:
     num_sharedlayers: int
@@ -76,6 +88,9 @@ class ModelConfig:
     dropout: float = 0.25
     freeze_conv: bool = False
     initial_bias: Optional[float] = None
+    # "bfloat16" = mixed precision: f32 params/grads/loss, bf16 compute
+    # (cast at the train-step boundary, hydragnn_tpu/train/trainer.py)
+    compute_dtype: str = "float32"
     # --- architecture-specific knobs ---
     pna_avg_deg_log: Optional[float] = None
     pna_avg_deg_lin: Optional[float] = None
@@ -163,6 +178,7 @@ class ModelConfig:
             edge_dim=arch.get("edge_dim"),
             freeze_conv=bool(arch.get("freeze_conv_layers", False)),
             initial_bias=arch.get("initial_bias"),
+            compute_dtype=_validated_compute_dtype(arch),
             pna_avg_deg_log=avg_log,
             pna_avg_deg_lin=avg_lin,
             max_degree=arch.get("max_neighbours"),

@@ -100,16 +100,49 @@ def _loss_and_metrics(
     dropout_rng: Optional[jax.Array] = None,
 ):
     """Forward + weighted loss (+ self-consistency term); returns
-    (loss, (per_head, new_batch_stats, outputs))."""
+    (loss, (per_head, new_batch_stats, outputs)).
+
+    Mixed precision (``Architecture.mixed_precision`` -> cfg.compute_dtype
+    "bfloat16"): params and node/edge FEATURES are cast to bf16 at THIS
+    boundary — one choke point instead of threading dtype through every
+    layer.  Deliberately kept f32: positions (bf16's 8-bit mantissa would
+    quantize interatomic distances by ~0.1 A at catalyst-cell coordinate
+    magnitudes, corrupting RBFs and the dE/dpos force term), the running
+    batch statistics (an EMA accumulated through bf16 loses late-training
+    drifts), the loss, and the gradients (transpose of the cast accumulates
+    in f32).  Anything the f32 geometry touches promotes back to f32;
+    the feature stack stays bf16."""
+    compute_dtype = (jnp.bfloat16 if getattr(cfg, "compute_dtype", "float32")
+                     == "bfloat16" else None)
+
+    def _cast(tree, dtype):
+        return jax.tree.map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
     variables = {"params": params, "batch_stats": batch_stats}
+    if compute_dtype is not None:
+        variables = {"params": _cast(params, compute_dtype),
+                     "batch_stats": batch_stats}
     rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
 
     def apply_fn(gg):
+        if compute_dtype is not None:
+            gg = gg.replace(
+                x=gg.x.astype(compute_dtype),
+                edge_attr=(None if gg.edge_attr is None
+                           else gg.edge_attr.astype(compute_dtype)))
         if train:
             out, mutated = model.apply(
                 variables, gg, train=True, mutable=["batch_stats"], rngs=rngs)
-            return out, mutated.get("batch_stats", batch_stats)
-        return model.apply(variables, gg, train=False), batch_stats
+            stats = mutated.get("batch_stats", batch_stats)
+        else:
+            out, stats = model.apply(variables, gg, train=False), batch_stats
+        if compute_dtype is not None:
+            out = [o.astype(jnp.float32) for o in out]
+            stats = jax.tree.map(
+                lambda s, o: s.astype(o.dtype), stats, batch_stats)
+        return out, stats
 
     if energy_head >= 0 and forces_head >= 0:
         # Energy-gradient force self-consistency (reference

@@ -1,0 +1,101 @@
+"""Mixed precision (Architecture.mixed_precision -> bf16 compute): params,
+gradients, loss, and batch statistics stay f32 while the forward computes in
+bfloat16 — cast at the train-step boundary, no per-layer dtype plumbing."""
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+
+
+def _setup(model_type="SchNet"):
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(8):
+        pos = rng.rand(10, 3).astype(np.float32) * 2.5
+        x = rng.randint(0, 4, (10, 1)).astype(np.float32)
+        ei = radius_graph(pos, 1.3, max_neighbours=8)
+        samples.append(GraphSample(
+            x=x, pos=pos, edge_index=ei,
+            graph_y=rng.rand(1).astype(np.float32)))
+    batch = collate(samples, PadSpec.for_batch(8, 12, 60),
+                    [HeadSpec("e", "graph", 1)])
+    cfg = ModelConfig(
+        model_type=model_type, input_dim=1, hidden_dim=16,
+        output_dim=(1,), output_type=("graph",),
+        graph_head=GraphHeadCfg(1, 16, 1, (16,)), node_head=None,
+        task_weights=(1.0,), num_conv_layers=2, num_gaussians=8,
+        num_filters=16, radius=1.3, max_neighbours=8)
+    return cfg, batch
+
+
+def test_bf16_step_matches_f32_within_tolerance():
+    cfg, batch = _setup()
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    state = create_train_state(model, batch, opt)
+
+    losses = {}
+    for dt in ("float32", "bfloat16"):
+        cfg_dt = dataclasses.replace(cfg, compute_dtype=dt)
+        step = jax.jit(make_train_step(create_model(cfg_dt), cfg_dt, opt))
+        new_state, metrics = step(state, batch)
+        losses[dt] = float(metrics["loss"])
+        # params, grads-updated params, and batch stats remain f32
+        for leaf in jax.tree.leaves(new_state.params):
+            assert leaf.dtype == jnp.float32
+        for leaf in jax.tree.leaves(new_state.batch_stats):
+            assert leaf.dtype == jnp.float32
+    assert np.isfinite(losses["bfloat16"])
+    assert abs(losses["bfloat16"] - losses["float32"]) < 0.05 * (
+        abs(losses["float32"]) + 1e-3)
+
+
+def test_bf16_training_decreases_loss():
+    cfg, batch = _setup("SAGE")
+    cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 5e-3})
+    state = create_train_state(model, batch, opt)
+    step = jax.jit(make_train_step(model, cfg, opt))
+    first = None
+    for _ in range(30):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert np.isfinite(last) and last < first
+
+
+def test_mixed_precision_config_key():
+    arch = {
+        "model_type": "SAGE", "input_dim": 1, "hidden_dim": 8,
+        "output_dim": [1], "output_type": ["graph"],
+        "output_heads": {"graph": {"num_sharedlayers": 1,
+                                   "dim_sharedlayers": 8,
+                                   "num_headlayers": 1,
+                                   "dim_headlayers": [8]}},
+        "task_weights": [1.0], "num_conv_layers": 2,
+        "mixed_precision": True,
+    }
+    cfg = ModelConfig.from_config(
+        {"Architecture": arch, "Training": {},
+         "Variables_of_interest": {}})
+    assert cfg.compute_dtype == "bfloat16"
+
+    import pytest
+
+    bad = dict(arch, mixed_precision=False, compute_dtype="fp16")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        ModelConfig.from_config(
+            {"Architecture": bad, "Training": {},
+             "Variables_of_interest": {}})
