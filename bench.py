@@ -97,6 +97,9 @@ def _child(platform: str) -> None:
         num_filters=64,
         radius=1.8,
         max_neighbours=20,
+        # validated by ModelConfig.__post_init__ — a typo raises rather than
+        # silently benchmarking f32 while claiming bf16
+        compute_dtype=os.getenv("HYDRAGNN_BENCH_DTYPE", "float32").strip(),
     )
     model = create_model(cfg)
     opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})

@@ -110,6 +110,16 @@ class ModelConfig:
     int_emb_size: Optional[int] = None
     out_emb_size: Optional[int] = None
 
+    def __post_init__(self):
+        # validate HERE so every construction path (from_config, direct
+        # dataclass use, dataclasses.replace, env knobs) is covered — the
+        # trainer maps anything != "bfloat16" to f32 without error, so an
+        # unvalidated typo like "bf16" would silently train in f32
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "compute_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.compute_dtype!r}")
+
     @property
     def use_edge_attr(self) -> bool:
         return self.edge_dim is not None and self.edge_dim > 0
