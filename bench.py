@@ -110,9 +110,10 @@ def _child(platform: str) -> None:
     # (~100us/step here) otherwise dominates and readings varied 3x with host
     # CPU contention.  The on-device loop gives chip-side training
     # throughput — representative when the input pipeline keeps up (prefetch
-    # overlaps collation; see data/prefetch.py).  run_k is the ONLY compiled
-    # executable — compiling a separate single-step jit too would double the
-    # compile time inside the child's timeout budget.
+    # overlaps collation; see data/prefetch.py).  run_k is the only
+    # executable compiled BEFORE the measurement; the single-step compile
+    # for roofline cost analysis happens after the timing, where it can't
+    # eat into the warmup/measure budget.
     from jax import lax
 
     train_step = make_train_step(model, cfg, opt_spec)
@@ -152,13 +153,42 @@ def _child(platform: str) -> None:
     # ratioed against it (it would read as a huge phantom regression)
     ratio = (_baseline_ratio(graphs_per_sec)
              if devs[0].platform != "cpu" else 1.0)
-    print(json.dumps({
+    result = {
         "metric": METRIC,
         "value": round(graphs_per_sec, 2),
         "unit": UNIT,
         "vs_baseline": round(ratio, 4),
         "platform": devs[0].platform,
-    }))
+    }
+    # print the measured result BEFORE the roofline compile below: if that
+    # second compile ran long the child would hit the parent's timeout and
+    # throw away a finished measurement (the parent parses partial stdout
+    # on timeout, and scans lines in reverse so a later augmented line wins)
+    print(json.dumps(result), flush=True)
+    # Roofline context from XLA's own cost model (per-step flops / bytes of
+    # the compiled loop, divided by n_iters).  Measured on the v5e: the step
+    # is HBM-bandwidth-bound (~2 flop/byte), so MFU is structurally tiny for
+    # this small-hidden-dim GNN and hbm_util is the number that matters.
+    try:
+        # analyze ONE train step, not run_k: XLA's cost model reports only
+        # the outer computation of a fori_loop, omitting the loop body
+        ca = jax.jit(train_step).lower(state, batch).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+        step_s = dt / n_iters
+        if flops > 0:
+            result["flops_per_step"] = round(flops)
+            result["achieved_tflops"] = round(flops / step_s / 1e12, 3)
+        if byts > 0:
+            result["hbm_gbps"] = round(byts / step_s / 1e9, 1)
+        if devs[0].platform == "tpu" and flops > 0:
+            # v5e peak: 197 TFLOP/s bf16; f32 runs the MXU at ~1/4 rate
+            peak = 197e12 if cfg.compute_dtype == "bfloat16" else 49e12
+            result["mfu_pct"] = round(flops / step_s / peak * 100, 2)
+        print(json.dumps(result), flush=True)
+    except Exception:
+        pass  # cost analysis is best-effort context, never fails the bench
 
 
 def _try_child(platform: str, timeout: float):
@@ -169,28 +199,39 @@ def _try_child(platform: str, timeout: float):
     else:
         # let the pre-registered TPU plugin claim the backend
         env.pop("JAX_PLATFORMS", None)
+    def parse(stdout):
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                d = json.loads(line)
+                if d.get("metric") == METRIC:
+                    return d
+            except (json.JSONDecodeError, AttributeError):
+                continue
+        return None
+
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform],
             env=env, capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         print(f"bench: {platform} attempt timed out after {timeout:.0f}s "
               "(backend init hang?)", file=sys.stderr)
-        return None
+        # the child prints the measured line before any best-effort extras,
+        # so a timeout may still leave a finished measurement in stdout
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return parse(out)
     if p.stderr:
         sys.stderr.write(p.stderr[-2000:])
     if p.returncode != 0:
         print(f"bench: {platform} attempt rc={p.returncode}", file=sys.stderr)
         return None
-    for line in reversed(p.stdout.strip().splitlines()):
-        try:
-            d = json.loads(line)
-            if d.get("metric") == METRIC:
-                return d
-        except (json.JSONDecodeError, AttributeError):
-            continue
-    print(f"bench: {platform} attempt printed no JSON line", file=sys.stderr)
-    return None
+    got = parse(p.stdout)
+    if got is None:
+        print(f"bench: {platform} attempt printed no JSON line",
+              file=sys.stderr)
+    return got
 
 
 def main() -> None:

@@ -1,9 +1,13 @@
 from hydragnn_tpu.parallel.mesh import (
     DATA_AXIS,
+    DCN_AXIS,
+    ICI_AXIS,
     DeviceStackLoader,
     make_dp_eval_step,
     make_dp_train_step,
     make_mesh,
+    make_multislice_mesh,
+    mesh_dp_axes,
     replicate_state,
     setup_distributed,
     stack_batches,

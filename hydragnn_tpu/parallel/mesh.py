@@ -34,6 +34,11 @@ from hydragnn_tpu.train.optimizer import OptimizerSpec
 from hydragnn_tpu.train.trainer import TrainState, _force_head_indices, _loss_and_metrics
 
 DATA_AXIS = "data"
+# multi-slice pods: outer axis crosses slices over DCN, inner axis stays on
+# a slice's ICI.  DP spans both; ZeRO-1 shards along ICI only so its
+# all_gather never rides the slow inter-slice links.
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
 
 
 def setup_distributed() -> Tuple[int, int]:
@@ -78,6 +83,65 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(np.asarray(devices), (axis,))
 
 
+def make_multislice_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: Optional[int] = None,
+) -> Mesh:
+    """2-axis (dcn, ici) mesh for multi-slice pods.
+
+    The outer axis crosses slice boundaries (DCN), the inner axis stays
+    within a slice (ICI).  Data parallelism spans both axes — XLA reduces
+    gradients hierarchically (intra-slice first, then one exchange per slice
+    over DCN) — while ZeRO-1 shards optimizer state along ``ici`` only, so
+    its per-step all_gather of updated params never crosses DCN.
+
+    Slices are inferred from each device's ``slice_index`` (real multi-slice
+    TPU jobs expose it); pass ``num_slices`` explicitly to emulate slices on
+    a flat device list (CPU tests, single-slice experiments).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    groups: Dict[int, List[jax.Device]] = {}
+    for d in devices:
+        groups.setdefault(int(getattr(d, "slice_index", 0) or 0), []).append(d)
+    if len(groups) > 1:
+        # real multi-slice hardware: ALWAYS group by the physical
+        # slice_index — a blind reshape of a non-slice-contiguous device
+        # list would misalign "dcn" with the actual slice boundaries and
+        # silently send the ZeRO all_gather over DCN
+        ordered = [groups[k] for k in sorted(groups)]
+        if num_slices is not None and num_slices != len(ordered):
+            raise ValueError(
+                f"num_slices={num_slices} but devices span {len(ordered)} "
+                "physical slices")
+        per = len(ordered[0])
+        if any(len(g) != per for g in ordered):
+            raise ValueError(
+                f"uneven slices: {[len(g) for g in ordered]} devices per slice")
+        arr = np.asarray(ordered)
+    elif num_slices is not None:
+        # flat device list (CPU tests, single-slice emulation)
+        if num_slices < 1 or len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not divide into {num_slices} slices")
+        arr = np.asarray(devices).reshape(num_slices, -1)
+    else:
+        raise ValueError(
+            "devices report a single slice and no num_slices was given — "
+            "use make_mesh for single-slice DP")
+    return Mesh(arr, (DCN_AXIS, ICI_AXIS))
+
+
+def _dp_axes(axis) -> Tuple[str, ...]:
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def mesh_dp_axes(mesh: Mesh):
+    """The DP axis argument matching a mesh: the plain data axis for 1-axis
+    meshes, the (dcn, ici) tuple for multi-slice meshes."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
 def stack_batches(batches: Sequence[GraphBatch]) -> GraphBatch:
     """Stack per-device batches along a new leading device axis."""
     return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
@@ -106,17 +170,18 @@ def mesh_process_count(mesh: Mesh) -> int:
 
 
 def global_batch(stacked: GraphBatch, mesh: Mesh,
-                 axis: str = DATA_AXIS) -> GraphBatch:
+                 axis=None) -> GraphBatch:
     """Assemble a host-local device-stacked batch [d_local, ...] into a global
     array [d_global, ...] sharded along ``axis`` (the multi-host analog of
     DDP's per-rank batches; one jit sees the whole global batch).  Works for
     group meshes spanning a subset of processes: the global shape covers only
     the mesh's processes."""
     n_proc = mesh_process_count(mesh)
+    axes = mesh_dp_axes(mesh) if axis is None else axis
 
     def conv(x):
         x = np.asarray(x)
-        sharding = NamedSharding(mesh, P(axis))
+        sharding = NamedSharding(mesh, P(axes))
         global_shape = (x.shape[0] * n_proc,) + x.shape[1:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
@@ -129,32 +194,62 @@ def make_dp_train_step(
     opt_spec: OptimizerSpec,
     mesh: Mesh,
     output_names: Optional[Sequence[str]] = None,
-    axis: str = DATA_AXIS,
+    axis=DATA_AXIS,
     zero_specs=None,
+    zero_axis: Optional[str] = None,
 ):
     """jit'd DP train step over stacked batches [D, ...].
 
     state is replicated; the batch is split along the device axis; gradients,
     metrics and batch-norm statistics are pmean-ed across the axis (DDP
-    all-reduce parity, reference train_validate_test.py:496).
+    all-reduce parity, reference train_validate_test.py:496).  ``axis`` may
+    be a tuple of mesh axes — e.g. ("dcn", "ici") from
+    :func:`make_multislice_mesh` — in which case DP spans their product.
 
     With ``zero_specs`` (from parallel.zero.shard_opt_state) the optimizer
-    state stays sharded along the axis — each device updates only its slice
-    of params/moments and the new params are all_gather-ed (ZeRO-1, reference
-    optimizer.py:43-103).
+    state stays sharded along ``zero_axis`` (default: the innermost DP axis,
+    so the ZeRO all_gather stays on ICI) — each device updates only its
+    slice of params/moments and the new params are all_gather-ed (ZeRO-1,
+    reference optimizer.py:43-103).
     """
     import optax
     from jax import shard_map
 
     energy_head, forces_head = _force_head_indices(output_names)
-    n_dev = int(mesh.devices.size)
+    axes = _dp_axes(axis)
+    if zero_specs is not None:
+        # derive the shard axis from the specs the opt state was ACTUALLY
+        # placed with — a separately-guessed axis would slice gradients
+        # along one axis into moments sharded along another, silently
+        # corrupting every update
+        spec_names = {
+            s[0]
+            for s in jax.tree_util.tree_leaves(
+                zero_specs, is_leaf=lambda x: isinstance(x, P))
+            if isinstance(s, P) and len(s) > 0 and s[0] is not None
+        }
+        if len(spec_names) > 1:
+            raise ValueError(
+                f"zero_specs shard along multiple axes: {spec_names}")
+        if spec_names:
+            derived = spec_names.pop()
+            if zero_axis is not None and zero_axis != derived:
+                raise ValueError(
+                    f"zero_axis={zero_axis!r} but zero_specs were built "
+                    f"for axis {derived!r}")
+            zero_axis = derived
+    zero_axis = zero_axis or axes[-1]
+    n_zero = int(mesh.shape[zero_axis])
 
     def per_device(state: TrainState, g: GraphBatch):
         # leading device axis has size 1 inside the shard; drop it
         g = jax.tree.map(lambda x: x[0], g)
+        dev_idx = jax.lax.axis_index(axes[0])
+        for a in axes[1:]:
+            dev_idx = dev_idx * mesh.shape[a] + jax.lax.axis_index(a)
         dropout_rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(0xD0), state.step),
-            jax.lax.axis_index(axis),
+            dev_idx,
         )
 
         def loss_fn(params):
@@ -164,28 +259,30 @@ def make_dp_train_step(
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        # gradient pmean across devices = DDP all-reduce parity
-        grads = jax.lax.pmean(grads, axis)
-        new_stats = jax.lax.pmean(new_stats, axis)
+        # gradient pmean across devices = DDP all-reduce parity (over a
+        # multi-slice mesh XLA reduces hierarchically: ICI first, then DCN)
+        grads = jax.lax.pmean(grads, axes)
+        new_stats = jax.lax.pmean(new_stats, axes)
         ng_local = g.n_real_graphs
-        num_graphs = jax.lax.psum(ng_local, axis)
+        num_graphs = jax.lax.psum(ng_local, axes)
         denom = jnp.maximum(num_graphs, 1.0)
-        loss = jax.lax.psum(loss * ng_local, axis) / denom
-        per_head = [jax.lax.psum(p * ng_local, axis) / denom for p in per_head]
+        loss = jax.lax.psum(loss * ng_local, axes) / denom
+        per_head = [jax.lax.psum(p * ng_local, axes) / denom
+                    for p in per_head]
 
         from hydragnn_tpu.models.base import encoder_freeze_mask
 
         if zero_specs is not None:
             from hydragnn_tpu.parallel import zero
 
-            idx = jax.lax.axis_index(axis)
-            g_sh = zero.shard_tree(grads, idx, n_dev)
-            p_sh = zero.shard_tree(state.params, idx, n_dev)
+            idx = jax.lax.axis_index(zero_axis)
+            g_sh = zero.shard_tree(grads, idx, n_zero)
+            p_sh = zero.shard_tree(state.params, idx, n_zero)
             updates, new_opt_state = opt_spec.tx.update(
                 g_sh, state.opt_state, p_sh)
             updates = encoder_freeze_mask(updates, cfg.freeze_conv)
             new_p_sh = optax.apply_updates(p_sh, updates)
-            new_params = zero.unshard_tree(new_p_sh, state.params, axis)
+            new_params = zero.unshard_tree(new_p_sh, state.params, zero_axis)
         else:
             updates, new_opt_state = opt_spec.tx.update(
                 grads, state.opt_state, state.params)
@@ -210,7 +307,7 @@ def make_dp_train_step(
     sharded = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(state_specs, P(axis)),
+        in_specs=(state_specs, P(axes)),
         out_specs=(state_specs, P()),
         check_vma=False,
     )
@@ -221,10 +318,13 @@ def make_dp_eval_step(
     model: Base,
     cfg: ModelConfig,
     mesh: Mesh,
-    axis: str = DATA_AXIS,
+    axis=DATA_AXIS,
 ):
-    """jit'd DP eval step over stacked batches [D, ...]."""
+    """jit'd DP eval step over stacked batches [D, ...].  ``axis`` may be a
+    tuple of mesh axes (multi-slice meshes)."""
     from jax import shard_map
+
+    axes = _dp_axes(axis)
 
     def per_device(state: TrainState, g: GraphBatch):
         g = jax.tree.map(lambda x: x[0], g)
@@ -232,10 +332,11 @@ def make_dp_eval_step(
             model, cfg, state.params, state.batch_stats, g, False)
         # weight by real graphs so empty wrap-padding shards don't dilute
         ng_local = g.n_real_graphs
-        num_graphs = jax.lax.psum(ng_local, axis)
+        num_graphs = jax.lax.psum(ng_local, axes)
         denom = jnp.maximum(num_graphs, 1.0)
-        loss = jax.lax.psum(loss * ng_local, axis) / denom
-        per_head = [jax.lax.psum(p * ng_local, axis) / denom for p in per_head]
+        loss = jax.lax.psum(loss * ng_local, axes) / denom
+        per_head = [jax.lax.psum(p * ng_local, axes) / denom
+                    for p in per_head]
         # re-add the device axis so outputs gather across shards
         outputs = jax.tree.map(lambda x: x[None], outputs)
         return {
@@ -248,12 +349,12 @@ def make_dp_eval_step(
     sharded = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(axis)),
+        in_specs=(P(), P(axes)),
         out_specs={
             "loss": P(),
             "num_graphs": P(),
             "per_head": P(),
-            "outputs": P(axis),
+            "outputs": P(axes),
         },
         check_vma=False,
     )
@@ -313,10 +414,11 @@ class GlobalBatchLoader:
     process must iterate in lockstep (per-rank batch counts are equalized by
     the loaders' wrap-padding)."""
 
-    def __init__(self, loader, mesh: Mesh, axis: str = DATA_AXIS):
+    def __init__(self, loader, mesh: Mesh, axis=None):
         self.loader = loader
         self.mesh = mesh
-        self.axis = axis
+        # None -> all the mesh's axes (works for 1-axis and multi-slice)
+        self.axis = mesh_dp_axes(mesh) if axis is None else axis
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)

@@ -19,7 +19,7 @@ DeepSpeed).  Checkpoint consolidation (reference utils/model.py:61-62 calls
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +40,9 @@ def shard_opt_state(opt_state, mesh: Mesh, axis: str):
       - orig_dims_tree: original leading dim per leaf (None for scalars), for
         consolidation.
     """
-    n = mesh.devices.size
+    # shard count = size of the NAMED axis (on a multi-slice mesh the state
+    # is sharded along ici and replicated across dcn)
+    n = int(mesh.shape[axis])
 
     def pad_and_place(x):
         x = np.asarray(x)
@@ -62,9 +64,11 @@ def shard_opt_state(opt_state, mesh: Mesh, axis: str):
     return sharded, specs, orig_dims
 
 
-def shard_state_for_zero(state, mesh: Mesh, axis: str = "data"):
+def shard_state_for_zero(state, mesh: Mesh, axis: Optional[str] = None):
     """Replicate a TrainState EXCEPT its optimizer state, which is sharded
-    along ``axis``.  Returns (state, zero_specs, zero_dims) ready for
+    along ``axis`` (default: the mesh's innermost axis — "data" on a 1-axis
+    DP mesh, "ici" on a multi-slice mesh so the ZeRO all_gather stays off
+    DCN).  Returns (state, zero_specs, zero_dims) ready for
     ``make_dp_train_step(..., zero_specs=zero_specs)``.
 
     The order matters: the opt state must be pulled to host and sharded
@@ -73,6 +77,8 @@ def shard_state_for_zero(state, mesh: Mesh, axis: str = "data"):
     """
     from hydragnn_tpu.parallel.mesh import replicate_state
 
+    if axis is None:
+        axis = tuple(mesh.axis_names)[-1]
     opt_sharded, zero_specs, zero_dims = shard_opt_state(
         jax.device_get(state.opt_state), mesh, axis)
     state = replicate_state(state.replace(opt_state=()), mesh)

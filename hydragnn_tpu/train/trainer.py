@@ -457,20 +457,30 @@ def train_validate_test(
         )
 
         if mesh is None:
-            mesh = make_mesh()  # global: every process's devices
+            n_slices = int(os.environ.get("HYDRAGNN_NUM_SLICES", "0") or 0)
+            if n_slices > 1:
+                # multi-slice pod: 2-axis (dcn, ici) mesh; DP spans both
+                from hydragnn_tpu.parallel.mesh import make_multislice_mesh
+
+                mesh = make_multislice_mesh(num_slices=n_slices)
+            else:
+                mesh = make_mesh()  # global: every process's devices
+        from hydragnn_tpu.parallel.mesh import mesh_dp_axes
+
+        dp_axes = mesh_dp_axes(mesh)
         zero_specs = zero_dims = None
         if opt_spec.use_zero_redundancy:
-            # ZeRO-1: optimizer state lives sharded along the data axis
-            # (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
+            # ZeRO-1: optimizer state lives sharded along the innermost mesh
+            # axis (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
             from hydragnn_tpu.parallel.zero import shard_state_for_zero
 
-            state, zero_specs, zero_dims = shard_state_for_zero(
-                state, mesh, "data")
+            state, zero_specs, zero_dims = shard_state_for_zero(state, mesh)
         else:
             state = replicate_state(state, mesh)
         train_step = make_dp_train_step(
-            model, cfg, opt_spec, mesh, output_names, zero_specs=zero_specs)
-        eval_step = make_dp_eval_step(model, cfg, mesh)
+            model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
+            zero_specs=zero_specs)
+        eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes)
         train_loader = DeviceStackLoader(
             train_loader, n_local_devices, drop_last=True)
         val_loader = DeviceStackLoader(
