@@ -261,9 +261,9 @@ def create_dataloaders(
     if n_buckets is None:
         n_buckets = int(os.getenv("HYDRAGNN_NUM_BUCKETS", "0") or 0)
         if n_buckets < 1:
-            # "0"/"false" must DISABLE (repo convention: HYDRAGNN_VALTEST=0)
-            flag = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", "")
-            n_buckets = 4 if flag not in ("", "0", "false", "False") else 1
+            from hydragnn_tpu.utils.env import env_flag
+
+            n_buckets = 4 if env_flag("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE") else 1
     if world_size > 1:
         # multi-process: every rank must assemble the same global array
         # shape each step, but bucket choice depends on rank-local samples —

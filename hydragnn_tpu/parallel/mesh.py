@@ -197,8 +197,14 @@ def make_dp_train_step(
     axis=DATA_AXIS,
     zero_specs=None,
     zero_axis: Optional[str] = None,
+    steps: int = 1,
 ):
     """jit'd DP train step over stacked batches [D, ...].
+
+    ``steps`` > 1 scans that many consecutive stacked batches ([K, D, ...]
+    input) inside one executable, amortizing per-step host dispatch
+    (HYDRAGNN_STEPS_PER_DISPATCH; metrics come back graph-weighted over the
+    K steps — same epoch-accumulation semantics as K dispatches).
 
     state is replicated; the batch is split along the device axis; gradients,
     metrics and batch-norm statistics are pmean-ed across the axis (DDP
@@ -311,6 +317,16 @@ def make_dp_train_step(
         out_specs=(state_specs, P()),
         check_vma=False,
     )
+    if steps > 1:
+        from jax import lax
+
+        from hydragnn_tpu.train.trainer import merge_scanned_metrics
+
+        def multi(state, g):
+            state, ms = lax.scan(sharded, state, g, length=steps)
+            return state, merge_scanned_metrics(ms)
+
+        return jax.jit(multi, donate_argnums=0)
     return jax.jit(sharded, donate_argnums=0)
 
 

@@ -22,6 +22,8 @@ from __future__ import annotations
 import os
 import pickle
 import time
+
+from hydragnn_tpu.utils.env import env_flag, env_int
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -219,6 +221,65 @@ def make_train_step(
     return train_step
 
 
+def merge_scanned_metrics(ms):
+    """Graph-weighted merge of per-step metric stacks [K] from a scanned
+    multi-step train step — same epoch-accumulation semantics as K separate
+    dispatches (one definition shared by the local and mesh scan paths)."""
+    ng = ms["num_graphs"]
+    total = jnp.maximum(jnp.sum(ng), 1.0)
+    merged = {
+        "loss": jnp.sum(ms["loss"] * ng) / total,
+        "num_graphs": jnp.sum(ng),
+    }
+    for k, v in ms.items():
+        if k.startswith("task_"):
+            merged[k] = jnp.sum(v * ng) / total
+    return merged
+
+
+def _align_bucket_group(loader, factor: int) -> None:
+    """Raise the underlying GraphDataLoader's ``bucket_group`` to a multiple
+    of ``factor`` so batches later stacked together (DeviceStackLoader over
+    local devices and/or scan steps) share one bucket PadSpec — np.stack
+    over mismatched bucket shapes would raise mid-epoch."""
+    if factor <= 1:
+        return
+    obj = loader
+    while obj is not None and not hasattr(obj, "bucket_group"):
+        obj = getattr(obj, "loader", None)
+    if obj is not None:
+        bg = max(1, int(obj.bucket_group))
+        obj.bucket_group = factor * (-(-bg // factor))
+
+
+def make_scan_train_step(
+    model: Base,
+    cfg: ModelConfig,
+    opt_spec: OptimizerSpec,
+    output_names: Optional[Sequence[str]] = None,
+    steps: int = 1,
+):
+    """K sequential train steps inside one executable via ``lax.scan``.
+
+    The input batch carries a leading [K, ...] axis of consecutive
+    same-PadSpec batches (DeviceStackLoader).  Metrics come back
+    graph-weighted over the K steps, so epoch accumulation in
+    :func:`_run_epoch` sees the same semantics as K separate dispatches.
+    Numerically identical to K sequential steps — only the host dispatch
+    and argument-ingest latency are amortized (measured ~15 ms/dispatch on
+    a tunneled v5e runtime; see docs/PERF.md).
+    """
+    from jax import lax
+
+    base = make_train_step(model, cfg, opt_spec, output_names)
+
+    def scan_step(state: TrainState, g: GraphBatch):
+        state, ms = lax.scan(base, state, g, length=steps)
+        return state, merge_scanned_metrics(ms)
+
+    return scan_step
+
+
 def make_eval_step(
     model: Base, cfg: ModelConfig
 ) -> Callable[[TrainState, GraphBatch], Dict[str, Any]]:
@@ -355,7 +416,8 @@ def load_state(state: TrainState, log_name: str, path: str = "./logs/") -> Train
 # ---------------------------------------------------------------------------
 
 
-def _run_epoch(step_fn, state, loader, train: bool, profiler=None):
+def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
+               steps_per_item: int = 1):
     # Metrics accumulate as DEVICE scalars: no float() in the batch loop, so
     # steps dispatch back-to-back with no device->host sync (the reference
     # accumulates on device and reduces at epoch end,
@@ -363,11 +425,15 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None):
     total = None
     tasks = None
     n = None
-    # HYDRAGNN_MAX_NUM_BATCH caps batches per epoch (reference get_nbatch,
-    # train_validate_test.py:40-50 — used for weak-scaling measurement)
+    # HYDRAGNN_MAX_NUM_BATCH caps TRAIN STEPS per epoch (reference
+    # get_nbatch, train_validate_test.py:40-50 — used for weak-scaling
+    # measurement).  With scan chunking each loader item carries
+    # ``steps_per_item`` steps; dispatches stop before EXCEEDING the cap
+    # (floor(nbatch/K) dispatches), so a K>1 run never does more optimizer
+    # steps than the K=1 run it's compared against.
     nbatch = int(os.getenv("HYDRAGNN_MAX_NUM_BATCH", "0")) or None
     for ibatch, g in enumerate(loader):
-        if nbatch is not None and ibatch >= nbatch:
+        if nbatch is not None and (ibatch + 1) * steps_per_item > nbatch:
             break
         if train:
             state, metrics = step_fn(state, g)
@@ -477,24 +543,98 @@ def train_validate_test(
             state, zero_specs, zero_dims = shard_state_for_zero(state, mesh)
         else:
             state = replicate_state(state, mesh)
+        single_proc = mesh_process_count(mesh) == 1
+        # scan chunking: only single-process (multi-host batch assembly goes
+        # through GlobalBatchLoader, which feeds one step per dispatch)
+        steps_per_dispatch = (
+            env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1) if single_proc else 1)
+        steps_per_dispatch = max(1, steps_per_dispatch)
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
-            zero_specs=zero_specs)
+            zero_specs=zero_specs, steps=steps_per_dispatch)
         eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes)
+        _align_bucket_group(
+            train_loader, n_local_devices * steps_per_dispatch)
         train_loader = DeviceStackLoader(
             train_loader, n_local_devices, drop_last=True)
         val_loader = DeviceStackLoader(
             val_loader, n_local_devices, drop_last=False)
         test_loader = DeviceStackLoader(
             test_loader, n_local_devices, drop_last=False)
-        if mesh_process_count(mesh) > 1:
+        if steps_per_dispatch > 1:
+            # second stack: [K, D, ...] superbatches for the scanned step
+            train_loader = DeviceStackLoader(
+                train_loader, steps_per_dispatch, drop_last=True)
+        if not single_proc:
             train_loader = GlobalBatchLoader(train_loader, mesh)
             val_loader = GlobalBatchLoader(val_loader, mesh)
             test_loader = GlobalBatchLoader(test_loader, mesh)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # batch sharding: leading scan axis (if any) replicated, device
+            # axis split over the mesh
+            bspec = (P(None, dp_axes) if steps_per_dispatch > 1
+                     else P(dp_axes))
+            train_shard = NamedSharding(mesh, bspec)
+            eval_shard = NamedSharding(mesh, P(dp_axes))
+            if env_flag("HYDRAGNN_DEVICE_PREFETCH"):
+                # async H2D of upcoming stacked batches while the current
+                # step runs.  Opt-in: helps on locally-attached devices; on
+                # a tunneled/remote runtime the background transfer contends
+                # with dispatch and HURTS (docs/PERF.md).
+                from hydragnn_tpu.data.prefetch import DevicePrefetcher
+
+                train_loader = DevicePrefetcher(
+                    train_loader, sharding=train_shard)
+                val_loader = DevicePrefetcher(val_loader, sharding=eval_shard)
+                test_loader = DevicePrefetcher(
+                    test_loader, sharding=eval_shard)
+            if env_flag("HYDRAGNN_RESIDENT_DATASET"):
+                from hydragnn_tpu.data.prefetch import ResidentDeviceLoader
+
+                train_loader = ResidentDeviceLoader(
+                    train_loader, sharding=train_shard)
+                val_loader = ResidentDeviceLoader(
+                    val_loader, sharding=eval_shard)
+                test_loader = ResidentDeviceLoader(
+                    test_loader, sharding=eval_shard)
     else:
-        train_step = jax.jit(
-            make_train_step(model, cfg, opt_spec, output_names),
-            donate_argnums=0)
+        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1))
+        if steps_per_dispatch > 1:
+            # amortize per-step Python dispatch + arg-ingest latency by
+            # scanning K train steps inside one executable (the batch
+            # loader stacks K consecutive same-bucket batches)
+            from hydragnn_tpu.parallel.mesh import DeviceStackLoader
+
+            train_step = jax.jit(
+                make_scan_train_step(model, cfg, opt_spec, output_names,
+                                     steps_per_dispatch),
+                donate_argnums=0)
+            _align_bucket_group(train_loader, steps_per_dispatch)
+            train_loader = DeviceStackLoader(
+                train_loader, steps_per_dispatch, drop_last=True)
+        else:
+            train_step = jax.jit(
+                make_train_step(model, cfg, opt_spec, output_names),
+                donate_argnums=0)
+        if env_flag("HYDRAGNN_DEVICE_PREFETCH"):
+            # async H2D of upcoming (stacked) batches — AFTER stacking, so
+            # the staged device arrays are consumed directly by the step
+            # instead of round-tripping through np.stack
+            from hydragnn_tpu.data.prefetch import DevicePrefetcher
+
+            train_loader = DevicePrefetcher(train_loader)
+            val_loader = DevicePrefetcher(val_loader)
+            test_loader = DevicePrefetcher(test_loader)
+        if env_flag("HYDRAGNN_RESIDENT_DATASET"):
+            # stage each (stacked) batch to HBM once, replay thereafter —
+            # removes steady-state H2D transfer for datasets that fit
+            from hydragnn_tpu.data.prefetch import ResidentDeviceLoader
+
+            train_loader = ResidentDeviceLoader(train_loader)
+            val_loader = ResidentDeviceLoader(val_loader)
+            test_loader = ResidentDeviceLoader(test_loader)
         eval_step = jax.jit(make_eval_step(model, cfg))
 
     scheduler = ReduceLROnPlateau()
@@ -542,7 +682,8 @@ def train_validate_test(
         train_loader.set_epoch(epoch)
         tr.start("train")
         state, train_loss, train_tasks = _run_epoch(
-            train_step, state, train_loader, True, profiler=profiler)
+            train_step, state, train_loader, True, profiler=profiler,
+            steps_per_item=steps_per_dispatch)
         tr.stop("train")
         # HYDRAGNN_VALTEST=0 skips the val/test epochs (reference knob)
         if int(os.getenv("HYDRAGNN_VALTEST", "1")):

@@ -1,0 +1,15 @@
+"""Env-var knob parsing — one definition of the repo's truthiness rule."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_flag(name: str) -> bool:
+    """True unless the var is unset/empty/"0"/"false"/"False" (the repo
+    convention: HYDRAGNN_VALTEST=0 disables)."""
+    return os.environ.get(name, "") not in ("", "0", "false", "False")
+
+
+def env_int(name: str, default: int = 0) -> int:
+    return int(os.environ.get(name, str(default)) or default)
