@@ -311,6 +311,38 @@ def collate(
         labels.append(lab)
 
     extras: Dict[str, np.ndarray] = {}
+    # HYDRAGNN_AGGR_BACKEND=fused: attach the sender-sorted edge permutation
+    # the fused message-passing kernel's backward needs
+    # (ops/fused_mp.py) — only when the kernel's block-locality invariant
+    # holds (every graph fits one node block).  All other invariants
+    # (nondecreasing receivers, contiguous graphs, intra-graph edges) hold
+    # by construction of this function; the models fall back to the XLA
+    # path whenever the permutation is absent.
+    from hydragnn_tpu.ops.aggregate import aggr_backend
+
+    if aggr_backend() == "fused":
+        from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK
+
+        max_nodes = int(max((s.num_nodes for s in samples), default=0))
+        # receivers must ACTUALLY be nondecreasing — true for edges built by
+        # graph/neighborlist, but stored edge lists (gpack/pickle written by
+        # external pipelines) carry arbitrary order and would make the
+        # kernel's steered ranges silently wrong
+        recv_sorted = bool(np.all(np.diff(receivers[:tot_edges]) >= 0))
+        if max_nodes <= _NODE_BLOCK and recv_sorted:
+            extras["edge_perm_sender"] = np.argsort(
+                senders, kind="stable").astype(np.int32)
+            # the kernel requires its static bound to cover BOTH degree
+            # directions (the backward runs sender-sorted); ship the
+            # batch's true max degree so the op can NaN-poison when the
+            # declared bound (max_neighbours caps in-degree only) is
+            # exceeded on either side
+            deg = 0
+            if tot_edges:
+                deg = int(max(
+                    np.bincount(senders[:tot_edges]).max(),
+                    np.bincount(receivers[:tot_edges]).max()))
+            extras["edge_degree_bound"] = np.asarray([deg], np.int32)
     if samples[0].extras:
         for k in samples[0].extras:
             v0 = np.asarray(samples[0].extras[k])

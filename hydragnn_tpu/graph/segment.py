@@ -42,6 +42,38 @@ def segment_sum(data, segment_ids, num_segments, mask=None):
     return jax.ops.segment_sum(data, segment_ids, num_segments)
 
 
+def gather_mul_segment(x, w, g, max_degree=None):
+    """The message-passing core ``out[n] = sum_{e: recv[e]=n}
+    x[send[e]] * w[e]`` — gather, edge-multiply, segment-sum.
+
+    When HYDRAGNN_AGGR_BACKEND=fused and the batch carries the
+    collate-provided ``edge_perm_sender`` (graph/batch.py attaches it when
+    the block-locality invariant holds) this lowers to the single fused
+    Pallas pass (ops/fused_mp.py) that never materializes the gathered
+    messages in HBM; otherwise the standard gather + masked segment_sum.
+    ``max_degree`` (e.g. ModelConfig.max_neighbours) must bound BOTH in-
+    and out-degree for the fused path; overflow poisons the output with
+    NaN rather than dropping edges silently.
+    """
+    perm = g.extras.get("edge_perm_sender") if g.extras else None
+    if perm is not None and max_degree:
+        from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
+
+        w = w * _bcast(g.edge_mask, w)
+        out = gather_mul_segment_sum(
+            x, w, g.senders, g.receivers, perm, int(max_degree))
+        # collate ships the batch's TRUE max degree (both directions);
+        # radius_graph caps in-degree only, so an out-degree hub beyond the
+        # declared bound must poison rather than silently drop edges in
+        # the sender-sorted backward
+        bound = g.extras.get("edge_degree_bound")
+        if bound is not None:
+            out = jnp.where(bound[0] > int(max_degree), jnp.nan, out)
+        return out
+    return segment_sum(
+        x[g.senders] * w, g.receivers, x.shape[0], g.edge_mask)
+
+
 def segment_count(segment_ids, num_segments, mask=None, dtype=jnp.float32):
     ones = jnp.ones((segment_ids.shape[0],), dtype)
     if mask is not None:

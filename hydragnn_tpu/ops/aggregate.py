@@ -12,6 +12,11 @@ how ``graph/segment.py:segment_sum`` lowers on the device:
 - ``pallas``: hand-written Pallas kernel of the same one-hot contraction,
   blocked over edges so the one-hot tile is built on the fly in VMEM and
   never materialized in HBM (the jnp version materializes an [E, N] array).
+- ``fused``: the full gather->multiply->segment-sum message-passing core in
+  one sorted-receiver Pallas pass (ops/fused_mp.py, dispatched via
+  graph/segment.py:gather_mul_segment) — +3.6% end-to-end on the flagship
+  bench; plain ``segment_sum`` calls under this backend use the scatter
+  path.
 
 All backends are exact (no atomics — deterministic accumulation order) and
 differentiable; ``segment_sum``'s gradient is a gather, which the custom VJP
@@ -57,6 +62,20 @@ def aggr_backend() -> str:
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def block_ranges(segment_ids, n_blocks: int, bn: int, be: int,
+                 n_eblocks: int):
+    """Per-node-block [start, end) EDGE-BLOCK ranges for nondecreasing
+    ``segment_ids`` (shared by the sorted backend and ops/fused_mp.py):
+    block i's segments span rows [i*bn, (i+1)*bn), located by searchsorted,
+    then converted to edge-block indices (floor start, ceil end)."""
+    bounds = jnp.arange(n_blocks + 1, dtype=jnp.int32) * bn
+    v = jnp.searchsorted(segment_ids, bounds, side="left")
+    lo, hi = v[:-1], v[1:]
+    start = (lo // be).astype(jnp.int32)
+    end = jnp.minimum((-(-hi // be)).astype(jnp.int32), n_eblocks)
+    return start, end
 
 
 # ---------------------------------------------------------------------------
@@ -186,11 +205,7 @@ def _sorted_impl(data2d, segment_ids, num_segments: int,
     seg_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
         segment_ids.astype(jnp.int32))
 
-    bounds = jnp.arange(n_blocks + 1, dtype=jnp.int32) * bn
-    v = jnp.searchsorted(segment_ids, bounds, side="left")
-    lo, hi = v[:-1], v[1:]  # block i's edge range; hi_i == lo_{i+1}
-    start = (lo // be).astype(jnp.int32)
-    end = (-(-hi // be)).astype(jnp.int32)
+    start, end = block_ranges(segment_ids, n_blocks, bn, be, n_eblocks)
     # static bound on edge-blocks per node block: bn segments x
     # max_per_segment edges, +1 for a range not aligned to a block boundary
     k_max = min(n_eblocks, -(-bn * max_per_segment // be) + 1)

@@ -1,0 +1,201 @@
+"""Fused gather-multiply-segment-sum kernel (ops/fused_mp.py): exactness
+against the XLA path, gradients, the NaN overflow tripwire, and the
+model-level HYDRAGNN_AGGR_BACKEND=fused dispatch."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
+
+
+def _batch(n_graphs=24, max_nodes=16, seed=0, max_neigh=10):
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n_graphs):
+        n = int(rng.randint(3, max_nodes + 1))
+        pos = rng.rand(n, 3).astype(np.float32) * 2.5
+        x = rng.rand(n, 2).astype(np.float32)
+        ei = radius_graph(pos, 1.4, max_neigh)
+        samples.append(GraphSample(x=x, pos=pos, edge_index=ei,
+                                   graph_y=np.ones(1, np.float32), node_y=x))
+    pad = PadSpec.for_batch(n_graphs, max_nodes, max_nodes * max_neigh)
+    return collate(samples, pad, [HeadSpec("e", "graph", 1)])
+
+
+def _arrays(b, f=64, seed=1):
+    rng = np.random.RandomState(seed)
+    n, e = b.x.shape[0], b.senders.shape[0]
+    x = jnp.asarray(rng.rand(n, f), jnp.float32)
+    w = jnp.asarray(rng.rand(e, f), jnp.float32) * jnp.asarray(
+        b.edge_mask)[:, None]
+    perm = jnp.asarray(np.argsort(np.asarray(b.senders), kind="stable"),
+                       jnp.int32)
+    return x, w, perm
+
+
+def _ref(b, x, w):
+    return jax.ops.segment_sum(
+        x[jnp.asarray(b.senders)] * w, jnp.asarray(b.receivers),
+        num_segments=x.shape[0])
+
+
+def test_fused_forward_exact():
+    b = _batch()
+    x, w, perm = _arrays(b)
+    out = gather_mul_segment_sum(
+        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm, 10)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(b, x, w)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_gradients_exact():
+    b = _batch(seed=2)
+    x, w, perm = _arrays(b, seed=3)
+    s, r = jnp.asarray(b.senders), jnp.asarray(b.receivers)
+
+    gx1, gw1 = jax.grad(
+        lambda x_, w_: jnp.sum(
+            gather_mul_segment_sum(x_, w_, s, r, perm, 10) ** 2),
+        argnums=(0, 1))(x, w)
+    gx2, gw2 = jax.grad(
+        lambda x_, w_: jnp.sum(_ref(b, x_, w_) ** 2), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=1e-5, atol=1e-5)
+    m = np.asarray(b.edge_mask)[:, None]
+    np.testing.assert_allclose(np.asarray(gw1) * m, np.asarray(gw2) * m,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_overflow_poisons_with_nan():
+    """Real in-degree far beyond the declared bound (so a node block's edge
+    range exceeds the kernel's static step count and edges WOULD be
+    dropped) must poison the output with NaN, not return a partial sum."""
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(24):
+        n = 16
+        pos = rng.rand(n, 3).astype(np.float32)  # dense: everyone in range
+        x = rng.rand(n, 2).astype(np.float32)
+        ei = radius_graph(pos, 10.0, 15)
+        samples.append(GraphSample(x=x, pos=pos, edge_index=ei,
+                                   graph_y=np.ones(1, np.float32), node_y=x))
+    pad = PadSpec.for_batch(24, 16, 16 * 15)
+    b = collate(samples, pad, [HeadSpec("e", "graph", 1)])
+    x, w, perm = _arrays(b)
+    # declared bound 1 -> k_max covers ~2 edge blocks; real ranges span ~4
+    out = gather_mul_segment_sum(
+        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm, 1)
+    assert np.isnan(np.asarray(out)).any()
+    # with an honest bound the same batch is exact
+    ok = gather_mul_segment_sum(
+        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm, 15)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(_ref(b, x, w)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_collate_attaches_perm_under_fused_backend(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    b = _batch()
+    assert "edge_perm_sender" in b.extras
+    perm = np.asarray(b.extras["edge_perm_sender"])
+    s = np.asarray(b.senders)
+    assert (np.diff(s[perm]) >= 0).all()
+    # the shipped degree bound is the batch's true max (both directions)
+    r = np.asarray(b.receivers)[np.asarray(b.edge_mask) > 0]
+    sr = s[np.asarray(b.edge_mask) > 0]
+    want = max(np.bincount(sr).max(), np.bincount(r).max())
+    assert int(b.extras["edge_degree_bound"][0]) == want
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "scatter")
+    b2 = _batch()
+    assert "edge_perm_sender" not in (b2.extras or {})
+
+
+def test_collate_skips_perm_when_invariants_broken(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    rng = np.random.RandomState(0)
+
+    # graph larger than the kernel's node block -> no perm
+    n = 200
+    pos = rng.rand(n, 3).astype(np.float32) * 6.0
+    x = rng.rand(n, 2).astype(np.float32)
+    ei = radius_graph(pos, 1.4, 10)
+    big = GraphSample(x=x, pos=pos, edge_index=ei,
+                      graph_y=np.ones(1, np.float32), node_y=x)
+    pad = PadSpec.for_batch(1, n, n * 10)
+    b = collate([big], pad, [HeadSpec("e", "graph", 1)])
+    assert "edge_perm_sender" not in (b.extras or {})
+
+    # receiver-unsorted stored edge list (external pipeline) -> no perm
+    n2 = 8
+    pos2 = rng.rand(n2, 3).astype(np.float32)
+    x2 = rng.rand(n2, 2).astype(np.float32)
+    ei2 = np.asarray([[1, 0, 3], [5, 2, 0]], np.int32)  # recv not sorted
+    small = GraphSample(x=x2, pos=pos2, edge_index=ei2,
+                        graph_y=np.ones(1, np.float32), node_y=x2)
+    pad2 = PadSpec.for_batch(1, n2, 8)
+    b2 = collate([small], pad2, [HeadSpec("e", "graph", 1)])
+    assert "edge_perm_sender" not in (b2.extras or {})
+
+
+def test_degree_bound_poisons_via_helper(monkeypatch):
+    """gather_mul_segment must NaN-poison when the batch's true degree
+    (either direction) exceeds the model's declared max_degree."""
+    from hydragnn_tpu.graph import segment
+
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    b = _batch(max_neigh=10)
+    x, w, _ = _arrays(b)
+    true_bound = int(b.extras["edge_degree_bound"][0])
+    out_ok = segment.gather_mul_segment(x, w, b, max_degree=true_bound)
+    assert not np.isnan(np.asarray(out_ok)).any()
+    out_bad = segment.gather_mul_segment(x, w, b, max_degree=true_bound - 1)
+    assert np.isnan(np.asarray(out_bad)).any()
+
+
+def test_schnet_model_fused_matches_scatter(monkeypatch):
+    """Full SchNet forward + grads must be identical under the fused
+    backend (the kernel is exact, not approximate)."""
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+
+    cfg = ModelConfig(
+        model_type="SchNet", input_dim=1, hidden_dim=16, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 16, 1, (16,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        num_gaussians=8, num_filters=16, radius=1.4, max_neighbours=10)
+    model = create_model(cfg)
+
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    b_fused = _batch(seed=5)
+    assert "edge_perm_sender" in b_fused.extras
+    v = model.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)}, b_fused, train=False)
+
+    def loss_fused(params):
+        out = model.apply({"params": params, "batch_stats": {}},
+                          b_fused, train=False)
+        return jnp.sum(out[0] ** 2)
+
+    lf = float(loss_fused(v["params"]))
+    gf = jax.grad(loss_fused)(v["params"])
+
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "scatter")
+    b_plain = _batch(seed=5)
+
+    def loss_plain(params):
+        out = model.apply({"params": params, "batch_stats": {}},
+                          b_plain, train=False)
+        return jnp.sum(out[0] ** 2)
+
+    lp = float(loss_plain(v["params"]))
+    gp = jax.grad(loss_plain)(v["params"])
+
+    assert abs(lf - lp) < 1e-4 * max(1.0, abs(lp))
+    for a, c in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-5)
