@@ -55,23 +55,51 @@ def gather_mul_segment(x, w, g, max_degree=None):
     and out-degree for the fused path; overflow poisons the output with
     NaN rather than dropping edges silently.
     """
-    perm = g.extras.get("edge_perm_sender") if g.extras else None
-    if perm is not None and max_degree:
+    fused = _fused_dispatch(g, max_degree)
+    if fused is not None:
         from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
 
+        perm, poison = fused
         w = w * _bcast(g.edge_mask, w)
-        out = gather_mul_segment_sum(
-            x, w, g.senders, g.receivers, perm, int(max_degree))
-        # collate ships the batch's TRUE max degree (both directions);
-        # radius_graph caps in-degree only, so an out-degree hub beyond the
-        # declared bound must poison rather than silently drop edges in
-        # the sender-sorted backward
-        bound = g.extras.get("edge_degree_bound")
-        if bound is not None:
-            out = jnp.where(bound[0] > int(max_degree), jnp.nan, out)
-        return out
+        return poison(gather_mul_segment_sum(
+            x, w, g.senders, g.receivers, perm, int(max_degree)))
     return segment_sum(
         x[g.senders] * w, g.receivers, x.shape[0], g.edge_mask)
+
+
+def _fused_dispatch(g, max_degree):
+    """Shared fused-path gate + overflow-poison closure: returns
+    (sender_perm, poison_fn) when the batch carries the collate-attached
+    permutation and a bound was declared, else None.  The poison: collate
+    ships the batch's TRUE max degree (both directions); radius_graph caps
+    in-degree only, so a degree hub beyond the declared bound must NaN
+    rather than silently drop edges in the sorted kernels."""
+    perm = g.extras.get("edge_perm_sender") if g.extras else None
+    if perm is None or not max_degree:
+        return None
+    bound = g.extras.get("edge_degree_bound")
+
+    def poison(out):
+        if bound is not None:
+            return jnp.where(bound[0] > int(max_degree), jnp.nan, out)
+        return out
+
+    return perm, poison
+
+
+def gather_segment(x, g, max_degree=None):
+    """Plain neighbor sum ``out[n] = sum_{e: recv[e]=n} x[send[e]]`` over
+    real edges — fused-kernel path when available (same dispatch rules as
+    :func:`gather_mul_segment`), else gather + masked segment_sum."""
+    fused = _fused_dispatch(g, max_degree)
+    if fused is not None:
+        from hydragnn_tpu.ops.fused_mp import gather_segment_sum
+
+        perm, poison = fused
+        return poison(gather_segment_sum(
+            x, g.senders, g.receivers, perm, int(max_degree), g.edge_mask))
+    return segment_sum(
+        x[g.senders], g.receivers, x.shape[0], g.edge_mask)
 
 
 def segment_count(segment_ids, num_segments, mask=None, dtype=jnp.float32):

@@ -47,9 +47,15 @@ _NODE_BLOCK = 128   # rows of out per grid step (sender window = 3x this)
 _EDGE_BLOCK = 512   # edges per inner step
 
 
-def _fwd_kernel(start_ref, end_ref, send_ref, recv_ref, w_ref,
-                xm1_ref, x0_ref, xp1_ref, out_ref):
+def _fwd_kernel(has_w, start_ref, end_ref, send_ref, recv_ref, *rest):
     from jax.experimental import pallas as pl
+
+    if has_w:
+        w_ref, xm1_ref, x0_ref, xp1_ref, out_ref = rest
+    else:
+        # w omitted: messages are the gathered features themselves, scaled
+        # by the scalar edge mask (GIN/MFC-style sum aggregation)
+        mask_ref, xm1_ref, x0_ref, xp1_ref, out_ref = rest
 
     i = pl.program_id(0)
     k = pl.program_id(1)
@@ -75,7 +81,10 @@ def _fwd_kernel(start_ref, end_ref, send_ref, recv_ref, w_ref,
         msgs = jax.lax.dot_general(
             onehot_s, xcat, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [BE, F]
-        msgs = msgs * w_ref[:].astype(jnp.float32)
+        if has_w:
+            msgs = msgs * w_ref[:].astype(jnp.float32)
+        else:
+            msgs = msgs * mask_ref[:].astype(jnp.float32)
         rloc = recv_ref[:] - i * bn
         onehot_r = (rloc == jax.lax.broadcasted_iota(
             jnp.int32, (be, bn), 1)).astype(jnp.float32)
@@ -84,12 +93,14 @@ def _fwd_kernel(start_ref, end_ref, send_ref, recv_ref, w_ref,
             preferred_element_type=jnp.float32)          # [BN, F]
 
 
-def _fused_impl(x, w, senders, receivers, max_per_segment, interpret):
+def _fused_impl(x, w, senders, receivers, max_per_segment, interpret,
+                mask=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    has_w = w is not None
     n, f = x.shape
-    e = w.shape[0]
+    e = w.shape[0] if has_w else senders.shape[0]
     bn, be = _NODE_BLOCK, _EDGE_BLOCK
     n_pad = _round_up(n, bn)
     e_pad = _round_up(max(e, 1), be)
@@ -97,7 +108,12 @@ def _fused_impl(x, w, senders, receivers, max_per_segment, interpret):
     n_blocks, n_eblocks = n_pad // bn, e_pad // be
 
     x_p = jnp.zeros((n_pad, f_pad), x.dtype).at[:n, :f].set(x)
-    w_p = jnp.zeros((e_pad, f_pad), w.dtype).at[:e, :f].set(w)
+    if has_w:
+        w_p = jnp.zeros((e_pad, f_pad), w.dtype).at[:e, :f].set(w)
+    else:
+        m = (jnp.ones((e,), jnp.float32) if mask is None
+             else mask.astype(jnp.float32))
+        w_p = jnp.zeros((e_pad, 1), jnp.float32).at[:e, 0].set(m)
     # shape-padding edges: park outside every block/window so they can't
     # contribute even with nonzero data (their w rows are zero anyway)
     send_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
@@ -126,7 +142,7 @@ def _fused_impl(x, w, senders, receivers, max_per_segment, interpret):
         in_specs=[
             pl.BlockSpec((be, 1), eix),
             pl.BlockSpec((be, 1), eix),
-            pl.BlockSpec((be, f_pad), eix),
+            pl.BlockSpec((be, f_pad if has_w else 1), eix),
             pl.BlockSpec((bn, f_pad), xm1),
             pl.BlockSpec((bn, f_pad), x0),
             pl.BlockSpec((bn, f_pad), xp1),
@@ -134,7 +150,7 @@ def _fused_impl(x, w, senders, receivers, max_per_segment, interpret):
         out_specs=pl.BlockSpec((bn, f_pad), lambda i, k, s, e2: (i, 0)),
     )
     out = pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, has_w),
         out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -197,3 +213,36 @@ def _vjp_bwd(max_per_segment, res, g):
 
 
 gather_mul_segment_sum.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def gather_segment_sum(x, senders, receivers, sender_perm, max_per_segment,
+                       mask=None):
+    """``out[n] = sum_{e: recv[e]=n} mask[e] * x[send[e]]`` — the w-less
+    variant (GIN/MFC-style neighbor sum) with the same invariants as
+    :func:`gather_mul_segment_sum`; ``mask`` is the [E] edge mask (padding
+    edges contribute nothing).  Differentiable wrt ``x`` only."""
+    interpret = jax.default_backend() != "tpu"
+    return _fused_impl(x, None, senders, receivers, max_per_segment,
+                       interpret, mask=mask)
+
+
+def _gss_fwd(x, senders, receivers, sender_perm, max_per_segment, mask=None):
+    out = gather_segment_sum(x, senders, receivers, sender_perm,
+                             max_per_segment, mask)
+    return out, (senders, receivers, sender_perm, mask)
+
+
+def _gss_bwd(max_per_segment, res, g):
+    senders, receivers, sender_perm, mask = res
+    if sender_perm is None:
+        sender_perm = jnp.argsort(senders, stable=True)
+    interpret = jax.default_backend() != "tpu"
+    dx = _fused_impl(
+        g.astype(jnp.float32), None, receivers[sender_perm],
+        senders[sender_perm], max_per_segment, interpret,
+        mask=None if mask is None else mask[sender_perm])
+    return dx.astype(g.dtype), None, None, None, None
+
+
+gather_segment_sum.defvjp(_gss_fwd, _gss_bwd)

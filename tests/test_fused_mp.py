@@ -156,6 +156,70 @@ def test_degree_bound_poisons_via_helper(monkeypatch):
     assert np.isnan(np.asarray(out_bad)).any()
 
 
+def test_gather_segment_sum_wless_exact():
+    """The w-less variant (GIN/MFC neighbor sum) and its gradient."""
+    from hydragnn_tpu.ops.fused_mp import gather_segment_sum
+
+    b = _batch(seed=7)
+    x, _, perm = _arrays(b, seed=8)
+    s, r = jnp.asarray(b.senders), jnp.asarray(b.receivers)
+    mask = jnp.asarray(b.edge_mask)
+
+    out = gather_segment_sum(x, s, r, perm, 10, mask)
+    want = jax.ops.segment_sum(
+        x[s] * mask[:, None], r, num_segments=x.shape[0])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    g1 = jax.grad(lambda x_: jnp.sum(
+        gather_segment_sum(x_, s, r, perm, 10, mask) ** 2))(x)
+    g2 = jax.grad(lambda x_: jnp.sum(jax.ops.segment_sum(
+        x_[s] * mask[:, None], r, num_segments=x.shape[0]) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("model_type", ["GIN", "MFC"])
+def test_sum_aggr_models_fused_match_scatter(model_type, monkeypatch):
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+
+    # max_degree must bound OUT-degree too (radius_graph caps in-degree
+    # only); 16 > any per-node degree in these 16-node graphs
+    cfg = ModelConfig(
+        model_type=model_type, input_dim=1, hidden_dim=16, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 16, 1, (16,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        max_degree=16, max_neighbours=16)
+    model = create_model(cfg)
+
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    b_fused = _batch(seed=9)
+    assert "edge_perm_sender" in b_fused.extras
+    v = model.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)}, b_fused, train=False)
+
+    def loss(params, b):
+        out = model.apply({"params": params,
+                           "batch_stats": v.get("batch_stats", {})},
+                          b, train=False)
+        return jnp.sum(out[0] ** 2)
+
+    lf = float(loss(v["params"], b_fused))
+    gf = jax.grad(loss)(v["params"], b_fused)
+
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "scatter")
+    b_plain = _batch(seed=9)
+    lp = float(loss(v["params"], b_plain))
+    gp = jax.grad(loss)(v["params"], b_plain)
+
+    assert abs(lf - lp) < 1e-4 * max(1.0, abs(lp))
+    for a, c in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_schnet_model_fused_matches_scatter(monkeypatch):
     """Full SchNet forward + grads must be identical under the fused
     backend (the kernel is exact, not approximate)."""
