@@ -200,6 +200,137 @@ class Visualizer:
                 self.outdir, f"parity_vector_{varname}{suffix}.png"))
         plt.close(fig)
 
+    def create_parity_plot_and_error_histogram_scalar(
+        self,
+        varname: str,
+        true_values,
+        predicted_values,
+        iepoch: Optional[int] = None,
+        save_plot: bool = True,
+    ) -> None:
+        """Side-by-side parity scatter + error-PDF for one scalar head
+        (reference create_parity_plot_and_error_histogram_scalar,
+        visualizer.py:281-386)."""
+        plt = _plt()
+        t = np.asarray(true_values).reshape(-1)
+        p = np.asarray(predicted_values).reshape(-1)
+        fig, axs = plt.subplots(1, 2, figsize=(12, 6))
+        ax = axs[0]
+        ax.scatter(t, p, s=6, edgecolor="b", facecolor="none")
+        lo, hi = float(min(t.min(), p.min())), float(max(t.max(), p.max()))
+        ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+        ax.set_title(varname)
+        ax.set_xlabel("True")
+        ax.set_ylabel("Predicted")
+        ax = axs[1]
+        hist1d, edges = np.histogram(p - t, bins=40, density=True)
+        ax.plot(0.5 * (edges[:-1] + edges[1:]), hist1d, "ro")
+        ax.set_title(f"{varname}: error PDF")
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(os.path.join(
+                self.outdir, f"parity_errpdf_{varname}{suffix}.png"))
+        plt.close(fig)
+
+    def create_error_histogram_per_node(
+        self,
+        varname: str,
+        true_values,
+        predicted_values,
+        iepoch: Optional[int] = None,
+        save_plot: bool = True,
+    ) -> None:
+        """Per-node-position error PDFs for node-level outputs on
+        FIXED-SIZE graphs ([num_samples, num_nodes] layout; reference
+        create_error_histogram_per_node, visualizer.py:387-466).  Scalar
+        per-graph outputs (one column) are skipped like the reference."""
+        import math
+
+        plt = _plt()
+        t = np.asarray(true_values)
+        p = np.asarray(predicted_values)
+        if t.ndim == 1 or t.shape[1] == 1:
+            return
+        n_nodes = t.shape[1]
+        nrow = max(int(math.floor(math.sqrt(n_nodes))), 1)
+        ncol = int(math.ceil(n_nodes / nrow))
+        fig, axs = plt.subplots(
+            nrow, ncol, figsize=(ncol * 3.5, nrow * 3.2), squeeze=False)
+        flat = axs.flatten()
+        for inode in range(n_nodes):
+            err = p[:, inode] - t[:, inode]
+            hist1d, edges = np.histogram(err, bins=40, density=True)
+            ax = flat[inode]
+            ax.plot(0.5 * (edges[:-1] + edges[1:]), hist1d, "ro")
+            ax.set_title(f"node {inode}")
+        for ie in range(n_nodes, flat.size):
+            flat[ie].axis("off")
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(os.path.join(
+                self.outdir, f"errpdf_per_node_{varname}{suffix}.png"))
+        plt.close(fig)
+
+    def create_parity_plot_per_node_vector(
+        self,
+        varname: str,
+        true_values,
+        predicted_values,
+        iepoch: Optional[int] = None,
+        save_plot: bool = True,
+    ) -> None:
+        """Per-node parity grid for 3-vector node outputs on FIXED-SIZE
+        graphs ([num_samples, num_nodes*3] layout; reference
+        create_parity_plot_per_node_vector, visualizer.py:519-613):
+        one panel per node, the three vector components overplotted with
+        distinct markers."""
+        import math
+
+        plt = _plt()
+        t = np.asarray(true_values)
+        p = np.asarray(predicted_values)
+        t = t.reshape(t.shape[0], -1, 3)
+        p = p.reshape(p.shape[0], -1, 3)
+        n_nodes = t.shape[1]
+        markers = ["o", "s", "d"]
+        nrow = max(int(math.floor(math.sqrt(n_nodes))), 1)
+        ncol = int(math.ceil(n_nodes / nrow))
+        fig, axs = plt.subplots(
+            nrow, ncol, figsize=(ncol * 3, nrow * 3), squeeze=False)
+        flat = axs.flatten()
+        for inode in range(n_nodes):
+            ax = flat[inode]
+            for ic in range(3):
+                ax.scatter(t[:, inode, ic], p[:, inode, ic], s=6, c="b",
+                           marker=markers[ic])
+            lo = float(min(t[:, inode].min(), p[:, inode].min()))
+            hi = float(max(t[:, inode].max(), p[:, inode].max()))
+            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            ax.set_title(f"node {inode}")
+        for ie in range(n_nodes, flat.size):
+            flat[ie].axis("off")
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(os.path.join(
+                self.outdir, f"parity_per_node_{varname}{suffix}.png"))
+        plt.close(fig)
+
+    def create_plot_global(
+        self,
+        true_values: Sequence[np.ndarray],
+        predicted_values: Sequence[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+    ) -> None:
+        """Global analysis (scatter/condmean/error-PDF) for every head
+        (reference create_plot_global, visualizer.py:722-733)."""
+        for ih in range(len(true_values)):
+            name = output_names[ih] if output_names else f"head{ih}"
+            self.create_plot_global_analysis(
+                name, true_values[ih], predicted_values[ih], save_plot=True)
+
     # -- loss history (reference visualizer.py:629-690) --------------------
     def plot_history(self, history: Dict[str, List[float]]) -> None:
         plt = _plt()

@@ -82,12 +82,14 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
         v = Visualizer(log_name, num_heads=cfg.num_heads,
                        head_dims=cfg.output_dim, logs_dir=logs_dir)
         v.create_scatter_plots(true_values, predicted_values, names)
+        v.create_plot_global(true_values, predicted_values, names)
         for ih in range(cfg.num_heads):
-            v.create_plot_global_analysis(
-                names[ih], true_values[ih], predicted_values[ih])
             if int(cfg.output_dim[ih]) > 1:
                 v.create_parity_plot_vector(
                     names[ih], true_values[ih], predicted_values[ih],
                     int(cfg.output_dim[ih]))
+            else:
+                v.create_parity_plot_and_error_histogram_scalar(
+                    names[ih], true_values[ih], predicted_values[ih])
 
     return error, tasks_error, true_values, predicted_values

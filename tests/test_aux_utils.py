@@ -93,6 +93,36 @@ def test_visualizer_global_analysis(tmp_path):
     assert 0.02 < em.mean() < 0.3
 
 
+def test_visualizer_per_node_and_scalar_panels(tmp_path):
+    """Remaining reference plot types: scalar parity+error-PDF combo,
+    per-node error PDFs, per-node vector parity, and the all-heads global
+    driver (reference visualizer.py:281-466, 519-613, 722-733)."""
+    from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+    v = Visualizer("viztest3", num_heads=2, logs_dir=str(tmp_path))
+    rng = np.random.RandomState(2)
+    t_scalar = rng.rand(80, 1)
+    p_scalar = t_scalar + 0.1 * rng.randn(80, 1)
+    # fixed-size graphs: [num_samples, num_nodes] node scalars and
+    # [num_samples, num_nodes*3] node vectors
+    t_node = rng.rand(40, 6)
+    p_node = t_node + 0.05 * rng.randn(40, 6)
+    t_nvec = rng.rand(40, 6 * 3)
+    p_nvec = t_nvec + 0.05 * rng.randn(40, 6 * 3)
+
+    v.create_parity_plot_and_error_histogram_scalar("e", t_scalar, p_scalar)
+    v.create_error_histogram_per_node("q", t_node, p_node)
+    v.create_error_histogram_per_node("e", t_scalar, p_scalar)  # skipped
+    v.create_parity_plot_per_node_vector("f", t_nvec, p_nvec)
+    v.create_plot_global([t_scalar, t_node], [p_scalar, p_node], ["e", "q"])
+
+    out = set(os.listdir(os.path.join(str(tmp_path), "viztest3")))
+    assert {"parity_errpdf_e.png", "errpdf_per_node_q.png",
+            "parity_per_node_f.png", "global_analysis_e.png",
+            "global_analysis_q.png"} <= out
+    assert "errpdf_per_node_e.png" not in out
+
+
 def test_slurm_nodelist_parsing():
     from hydragnn_tpu.utils.slurm import parse_slurm_nodelist
 
