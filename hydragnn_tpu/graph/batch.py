@@ -332,17 +332,6 @@ def collate(
         if max_nodes <= _NODE_BLOCK and recv_sorted:
             extras["edge_perm_sender"] = np.argsort(
                 senders, kind="stable").astype(np.int32)
-            # the kernel requires its static bound to cover BOTH degree
-            # directions (the backward runs sender-sorted); ship the
-            # batch's true max degree so the op can NaN-poison when the
-            # declared bound (max_neighbours caps in-degree only) is
-            # exceeded on either side
-            deg = 0
-            if tot_edges:
-                deg = int(max(
-                    np.bincount(senders[:tot_edges]).max(),
-                    np.bincount(receivers[:tot_edges]).max()))
-            extras["edge_degree_bound"] = np.asarray([deg], np.int32)
     if samples[0].extras:
         for k in samples[0].extras:
             v0 = np.asarray(samples[0].extras[k])

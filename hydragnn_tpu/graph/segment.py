@@ -42,7 +42,7 @@ def segment_sum(data, segment_ids, num_segments, mask=None):
     return jax.ops.segment_sum(data, segment_ids, num_segments)
 
 
-def gather_mul_segment(x, w, g, max_degree=None):
+def gather_mul_segment(x, w, g):
     """The message-passing core ``out[n] = sum_{e: recv[e]=n}
     x[send[e]] * w[e]`` — gather, edge-multiply, segment-sum.
 
@@ -51,53 +51,27 @@ def gather_mul_segment(x, w, g, max_degree=None):
     the block-locality invariant holds) this lowers to the single fused
     Pallas pass (ops/fused_mp.py) that never materializes the gathered
     messages in HBM; otherwise the standard gather + masked segment_sum.
-    ``max_degree`` (e.g. ModelConfig.max_neighbours) must bound BOTH in-
-    and out-degree for the fused path; overflow poisons the output with
-    NaN rather than dropping edges silently.
     """
-    fused = _fused_dispatch(g, max_degree)
-    if fused is not None:
+    perm = g.extras.get("edge_perm_sender") if g.extras else None
+    if perm is not None:
         from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
 
-        perm, poison = fused
         w = w * _bcast(g.edge_mask, w)
-        return poison(gather_mul_segment_sum(
-            x, w, g.senders, g.receivers, perm, int(max_degree)))
+        return gather_mul_segment_sum(x, w, g.senders, g.receivers, perm)
     return segment_sum(
         x[g.senders] * w, g.receivers, x.shape[0], g.edge_mask)
 
 
-def _fused_dispatch(g, max_degree):
-    """Shared fused-path gate + overflow-poison closure: returns
-    (sender_perm, poison_fn) when the batch carries the collate-attached
-    permutation and a bound was declared, else None.  The poison: collate
-    ships the batch's TRUE max degree (both directions); radius_graph caps
-    in-degree only, so a degree hub beyond the declared bound must NaN
-    rather than silently drop edges in the sorted kernels."""
-    perm = g.extras.get("edge_perm_sender") if g.extras else None
-    if perm is None or not max_degree:
-        return None
-    bound = g.extras.get("edge_degree_bound")
-
-    def poison(out):
-        if bound is not None:
-            return jnp.where(bound[0] > int(max_degree), jnp.nan, out)
-        return out
-
-    return perm, poison
-
-
-def gather_segment(x, g, max_degree=None):
+def gather_segment(x, g):
     """Plain neighbor sum ``out[n] = sum_{e: recv[e]=n} x[send[e]]`` over
     real edges — fused-kernel path when available (same dispatch rules as
     :func:`gather_mul_segment`), else gather + masked segment_sum."""
-    fused = _fused_dispatch(g, max_degree)
-    if fused is not None:
+    perm = g.extras.get("edge_perm_sender") if g.extras else None
+    if perm is not None:
         from hydragnn_tpu.ops.fused_mp import gather_segment_sum
 
-        perm, poison = fused
-        return poison(gather_segment_sum(
-            x, g.senders, g.receivers, perm, int(max_degree), g.edge_mask))
+        return gather_segment_sum(
+            x, g.senders, g.receivers, perm, g.edge_mask)
     return segment_sum(
         x[g.senders], g.receivers, x.shape[0], g.edge_mask)
 

@@ -6,8 +6,6 @@ GINConv with a 2-layer MLP and a trainable eps initialized to 100.0
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 import flax.linen as nn
 
@@ -18,12 +16,11 @@ from hydragnn_tpu.models.base import Base
 class GINConv(nn.Module):
     out_dim: int
     eps_init: float = 100.0
-    max_degree: Optional[int] = None  # enables the fused aggregate path
 
     @nn.compact
     def __call__(self, x, pos, g, train):
         eps = self.param("eps", lambda key: jnp.asarray(self.eps_init, jnp.float32))
-        agg = segment.gather_segment(x, g, self.max_degree)
+        agg = segment.gather_segment(x, g)
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)
@@ -33,4 +30,4 @@ class GINConv(nn.Module):
 
 class GINStack(Base):
     def make_conv(self, name, in_dim, out_dim, last_layer):
-        return GINConv(out_dim, max_degree=self.cfg.max_neighbours, name=name)
+        return GINConv(out_dim, name=name)

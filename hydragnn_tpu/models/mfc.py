@@ -8,8 +8,6 @@ clamped to ``max_degree``.  The per-node weight selection is a gather over a
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 import flax.linen as nn
 
@@ -19,12 +17,7 @@ from hydragnn_tpu.models.base import Base
 
 class MFConv(nn.Module):
     out_dim: int
-    max_degree: int  # degree-table CLIP bound (weight-bank size)
-    # structural degree bound for the fused aggregate path — distinct from
-    # the clip: a degree above the clip still SUMS all neighbors (it only
-    # clips the weight-table row), so it must not trip the fused kernel's
-    # overflow poison
-    structural_max_degree: Optional[int] = None
+    max_degree: int  # degree-table clip bound (weight-bank size)
 
     @nn.compact
     def __call__(self, x, pos, g, train):
@@ -40,7 +33,7 @@ class MFConv(nn.Module):
 
         deg = segment.degree(g.receivers, n, g.edge_mask).astype(jnp.int32)
         deg = jnp.clip(deg, 0, self.max_degree)
-        agg = segment.gather_segment(x, g, self.structural_max_degree)
+        agg = segment.gather_segment(x, g)
 
         out = jnp.einsum("ni,nio->no", x, jnp.take(w_root, deg, axis=0))
         out = out + jnp.einsum("ni,nio->no", agg, jnp.take(w_neigh, deg, axis=0))
@@ -51,6 +44,4 @@ class MFConv(nn.Module):
 class MFCStack(Base):
     def make_conv(self, name, in_dim, out_dim, last_layer):
         assert self.cfg.max_degree is not None, "MFC requires max_neighbours."
-        return MFConv(out_dim, max_degree=self.cfg.max_degree,
-                      structural_max_degree=self.cfg.max_neighbours,
-                      name=name)
+        return MFConv(out_dim, max_degree=self.cfg.max_degree, name=name)

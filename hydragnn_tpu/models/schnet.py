@@ -15,8 +15,6 @@ No BatchNorm feature layers (reference uses Identity; SCFStack.py:63).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
@@ -40,7 +38,6 @@ class SCFConv(nn.Module):
     cutoff: float
     equivariant: bool
     use_edge_attr: bool
-    max_degree: Optional[int] = None  # enables the fused aggregate path
 
     @nn.compact
     def __call__(self, x, pos, g, train):
@@ -94,9 +91,9 @@ class SCFConv(nn.Module):
             pos = pos + segment.segment_mean(trans, src, n, g.edge_mask)
 
         # lowers to the fused gather-multiply-aggregate Pallas kernel under
-        # HYDRAGNN_AGGR_BACKEND=fused (ops/fused_mp.py; measured 1.6x on
-        # the fwd+bwd chain at QM9 shapes on a v5e)
-        agg = segment.gather_mul_segment(h, filt, g, self.max_degree)
+        # HYDRAGNN_AGGR_BACKEND=fused (ops/fused_mp.py; measured numbers in
+        # docs/PERF.md)
+        agg = segment.gather_mul_segment(h, filt, g)
         out = nn.Dense(self.out_dim,
                        kernel_init=nn.initializers.xavier_uniform(),
                        name="lin2")(agg)
@@ -117,6 +114,5 @@ class SCFStack(Base):
             cutoff=c.radius,
             equivariant=c.equivariance and not last_layer,
             use_edge_attr=c.use_edge_attr,
-            max_degree=c.max_neighbours,
             name=name,
         )

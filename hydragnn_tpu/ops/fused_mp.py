@@ -26,6 +26,12 @@ Padding edges (parked on node N-1 by collate with edge_mask 0) contribute
 nothing: the caller's pre-masked ``w`` zeroes them, and out-of-window
 one-hot rows are all-zero anyway.
 
+The grid is a DENSE CSR-style schedule: scalar-prefetched step tables map
+each grid step to one populated (node-block, edge-block) pair, so no step
+is a wasted DMA and — unlike a rectangular (block, k_max) grid bounded by a
+declared max degree — ANY degree distribution is processed exactly (total
+steps are unconditionally <= edge blocks + 2 * node blocks).
+
 Backward: dL/dw = x[senders] * g[receivers] (two XLA gathers — the
 receivers gather is sorted and cheap); dL/dx reuses THIS kernel on the
 sender-sorted edge ordering (host-precomputed permutation: sorting edges by
@@ -47,7 +53,8 @@ _NODE_BLOCK = 128   # rows of out per grid step (sender window = 3x this)
 _EDGE_BLOCK = 512   # edges per inner step
 
 
-def _fwd_kernel(has_w, start_ref, end_ref, send_ref, recv_ref, *rest):
+def _fwd_kernel(has_w, si_ref, se_ref, av_ref, fi_ref, send_ref, recv_ref,
+                *rest):
     from jax.experimental import pallas as pl
 
     if has_w:
@@ -57,14 +64,14 @@ def _fwd_kernel(has_w, start_ref, end_ref, send_ref, recv_ref, *rest):
         # by the scalar edge mask (GIN/MFC-style sum aggregation)
         mask_ref, xm1_ref, x0_ref, xp1_ref, out_ref = rest
 
-    i = pl.program_id(0)
-    k = pl.program_id(1)
+    s = pl.program_id(0)
+    i = si_ref[s]
 
-    @pl.when(k == 0)
+    @pl.when(fi_ref[s] == 1)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    @pl.when(start_ref[i] + k < end_ref[i])
+    @pl.when(av_ref[s] == 1)
     def _acc():
         bn = out_ref.shape[0]
         be = send_ref.shape[0]
@@ -93,8 +100,7 @@ def _fwd_kernel(has_w, start_ref, end_ref, send_ref, recv_ref, *rest):
             preferred_element_type=jnp.float32)          # [BN, F]
 
 
-def _fused_impl(x, w, senders, receivers, max_per_segment, interpret,
-                mask=None):
+def _fused_impl(x, w, senders, receivers, interpret, mask=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -122,23 +128,51 @@ def _fused_impl(x, w, senders, receivers, max_per_segment, interpret,
         receivers.astype(jnp.int32))
 
     start, end = block_ranges(recv_p[:, 0], n_blocks, bn, be, n_eblocks)
-    k_max = min(n_eblocks, -(-bn * int(max_per_segment) // be) + 1)
 
-    def eix(i, k, s_ref, e_ref):
-        return (jnp.minimum(s_ref[i] + k, n_eblocks - 1), 0)
+    # DENSE schedule: one grid step per (node-block, populated edge-block)
+    # pair, flattened CSR-style through scalar-prefetched step tables —
+    # instead of a rectangular (n_blocks, k_max) grid whose bound-degree
+    # worst case makes most steps no-op DMAs.  Empty blocks get exactly one
+    # step (their out must still be zeroed).  Total steps are UNCONDITIONALLY
+    # bounded: ranges tile the edge blocks with at most one shared boundary
+    # block per adjacent pair, so sum(max(range_i, 1)) <= n_eblocks +
+    # 2*n_blocks regardless of degree distribution — no degree contract, no
+    # dropped edges, no overflow case at all.
+    counts = end - start
+    steps = jnp.maximum(counts, 1)
+    offsets = jnp.cumsum(steps)
+    total = offsets[-1]
+    s_max = n_eblocks + 2 * n_blocks
+    s_idx = jnp.arange(s_max, dtype=jnp.int32)
+    step_i = jnp.minimum(
+        jnp.searchsorted(offsets, s_idx, side="right"),
+        n_blocks - 1).astype(jnp.int32)
+    block_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), offsets[:-1].astype(jnp.int32)])
+    k = s_idx - block_off[step_i]
+    step_eb = jnp.clip(start[step_i] + k, 0, n_eblocks - 1).astype(jnp.int32)
+    # accumulate only on real (block, edge-block) pairs; the forced step of
+    # an empty block and the trailing padding steps (which clamp onto the
+    # last block and re-read its final edge block — a cached DMA) are no-ops
+    acc_valid = ((k < counts[step_i]) & (s_idx < total)).astype(jnp.int32)
+    prev_i = jnp.concatenate([jnp.full(1, -1, jnp.int32), step_i[:-1]])
+    is_first = (step_i != prev_i).astype(jnp.int32)
 
-    def xm1(i, k, s_ref, e_ref):
-        return (jnp.maximum(i - 1, 0), 0)
+    def eix(s, si, se, av, fi):
+        return (se[s], 0)
 
-    def x0(i, k, s_ref, e_ref):
-        return (i, 0)
+    def xm1(s, si, se, av, fi):
+        return (jnp.maximum(si[s] - 1, 0), 0)
 
-    def xp1(i, k, s_ref, e_ref):
-        return (jnp.minimum(i + 1, n_blocks - 1), 0)
+    def x0(s, si, se, av, fi):
+        return (si[s], 0)
+
+    def xp1(s, si, se, av, fi):
+        return (jnp.minimum(si[s] + 1, n_blocks - 1), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(n_blocks, k_max),
+        num_scalar_prefetch=4,
+        grid=(s_max,),
         in_specs=[
             pl.BlockSpec((be, 1), eix),
             pl.BlockSpec((be, 1), eix),
@@ -147,55 +181,43 @@ def _fused_impl(x, w, senders, receivers, max_per_segment, interpret,
             pl.BlockSpec((bn, f_pad), x0),
             pl.BlockSpec((bn, f_pad), xp1),
         ],
-        out_specs=pl.BlockSpec((bn, f_pad), lambda i, k, s, e2: (i, 0)),
+        out_specs=pl.BlockSpec(
+            (bn, f_pad), lambda s, si, se, av, fi: (si[s], 0)),
     )
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, has_w),
         out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(start, end, send_p, recv_p, w_p, x_p, x_p, x_p)
-    # Tripwire: a node receiving more than max_per_segment edges makes its
-    # edge range exceed k_max steps and contributions would be DROPPED.
-    # Poison the output with NaN instead of training silently wrong.  The
-    # caller's padding run (edges parked on node n-1; zero w rows by
-    # contract) is exempt — its dropped contributions are zeros.
-    pad_run = jnp.searchsorted(recv_p[:, 0], jnp.int32(n - 1), side="left")
-    bounds = jnp.arange(n_blocks + 1, dtype=jnp.int32) * bn
-    v = jnp.searchsorted(recv_p[:, 0], bounds, side="left")
-    hi_real = jnp.minimum(v[1:], pad_run)
-    end_real = (-(-hi_real // be)).astype(jnp.int32)
-    overflow = jnp.any((end_real - start) > k_max)
-    out = jnp.where(overflow, jnp.nan, out)
+    )(step_i, step_eb, acc_valid, is_first, send_p, recv_p, w_p,
+      x_p, x_p, x_p)
     return out[:n, :f].astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
-                           max_per_segment):
+@jax.custom_vjp
+def gather_mul_segment_sum(x, w, senders, receivers, sender_perm):
     """``out[n, f] = sum_{e: recv[e]=n} x[send[e], f] * w[e, f]``.
 
     REQUIRES (collate invariants — see module docstring): nondecreasing
     ``receivers``; intra-graph edges, graphs contiguous, every graph within
-    ``_NODE_BLOCK`` nodes; at most ``max_per_segment`` REAL edges per
-    receiver AND per sender (in- and out-degree both bounded — the backward
-    runs the kernel on the sender-sorted ordering); ``w`` pre-masked (zero
-    rows on padding edges).  ``sender_perm`` is the host-precomputed stable
-    argsort of ``senders`` (collate emits it once per batch) used by the
-    backward; pass None for a forward-only call.  Exact (f32 accumulation,
-    deterministic order); differentiable wrt x and w.
+    ``_NODE_BLOCK`` nodes; ``w`` pre-masked (zero rows on padding edges).
+    No degree bound: the dense schedule processes every populated
+    (node-block, edge-block) pair exactly once.  ``sender_perm`` is the
+    host-precomputed stable argsort of ``senders`` (collate emits it once
+    per batch) used by the backward; pass None for a forward-only call.
+    Exact (f32 accumulation, deterministic order); differentiable wrt x
+    and w.
     """
     interpret = jax.default_backend() != "tpu"
-    return _fused_impl(x, w, senders, receivers, max_per_segment, interpret)
+    return _fused_impl(x, w, senders, receivers, interpret)
 
 
-def _vjp_fwd(x, w, senders, receivers, sender_perm, max_per_segment):
-    out = gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
-                                 max_per_segment)
+def _vjp_fwd(x, w, senders, receivers, sender_perm):
+    out = gather_mul_segment_sum(x, w, senders, receivers, sender_perm)
     return out, (x, w, senders, receivers, sender_perm)
 
 
-def _vjp_bwd(max_per_segment, res, g):
+def _vjp_bwd(res, g):
     x, w, senders, receivers, sender_perm = res
     # dL/dw[e] = x[send[e]] * g[recv[e]] — plain gathers (recv gather is
     # over sorted indices)
@@ -207,7 +229,7 @@ def _vjp_bwd(max_per_segment, res, g):
         sender_perm = jnp.argsort(senders, stable=True)
     dx = _fused_impl(
         g.astype(jnp.float32), w[sender_perm].astype(jnp.float32),
-        receivers[sender_perm], senders[sender_perm], max_per_segment,
+        receivers[sender_perm], senders[sender_perm],
         jax.default_backend() != "tpu")
     return dx.astype(x.dtype), dw, None, None, None
 
@@ -215,32 +237,29 @@ def _vjp_bwd(max_per_segment, res, g):
 gather_mul_segment_sum.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def gather_segment_sum(x, senders, receivers, sender_perm, max_per_segment,
-                       mask=None):
+@jax.custom_vjp
+def gather_segment_sum(x, senders, receivers, sender_perm, mask=None):
     """``out[n] = sum_{e: recv[e]=n} mask[e] * x[send[e]]`` — the w-less
     variant (GIN/MFC-style neighbor sum) with the same invariants as
     :func:`gather_mul_segment_sum`; ``mask`` is the [E] edge mask (padding
     edges contribute nothing).  Differentiable wrt ``x`` only."""
     interpret = jax.default_backend() != "tpu"
-    return _fused_impl(x, None, senders, receivers, max_per_segment,
-                       interpret, mask=mask)
+    return _fused_impl(x, None, senders, receivers, interpret, mask=mask)
 
 
-def _gss_fwd(x, senders, receivers, sender_perm, max_per_segment, mask=None):
-    out = gather_segment_sum(x, senders, receivers, sender_perm,
-                             max_per_segment, mask)
+def _gss_fwd(x, senders, receivers, sender_perm, mask=None):
+    out = gather_segment_sum(x, senders, receivers, sender_perm, mask)
     return out, (senders, receivers, sender_perm, mask)
 
 
-def _gss_bwd(max_per_segment, res, g):
+def _gss_bwd(res, g):
     senders, receivers, sender_perm, mask = res
     if sender_perm is None:
         sender_perm = jnp.argsort(senders, stable=True)
     interpret = jax.default_backend() != "tpu"
     dx = _fused_impl(
         g.astype(jnp.float32), None, receivers[sender_perm],
-        senders[sender_perm], max_per_segment, interpret,
+        senders[sender_perm], interpret,
         mask=None if mask is None else mask[sender_perm])
     return dx.astype(g.dtype), None, None, None, None
 

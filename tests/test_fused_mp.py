@@ -1,6 +1,7 @@
 """Fused gather-multiply-segment-sum kernel (ops/fused_mp.py): exactness
-against the XLA path, gradients, the NaN overflow tripwire, and the
-model-level HYDRAGNN_AGGR_BACKEND=fused dispatch."""
+against the XLA path, gradients, extreme degree distributions (the dense
+schedule has no degree bound), and the model-level
+HYDRAGNN_AGGR_BACKEND=fused dispatch."""
 
 import numpy as np
 import jax
@@ -47,7 +48,7 @@ def test_fused_forward_exact():
     b = _batch()
     x, w, perm = _arrays(b)
     out = gather_mul_segment_sum(
-        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm, 10)
+        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm)
     np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(b, x, w)),
                                rtol=1e-5, atol=1e-5)
 
@@ -59,7 +60,7 @@ def test_fused_gradients_exact():
 
     gx1, gw1 = jax.grad(
         lambda x_, w_: jnp.sum(
-            gather_mul_segment_sum(x_, w_, s, r, perm, 10) ** 2),
+            gather_mul_segment_sum(x_, w_, s, r, perm) ** 2),
         argnums=(0, 1))(x, w)
     gx2, gw2 = jax.grad(
         lambda x_, w_: jnp.sum(_ref(b, x_, w_) ** 2), argnums=(0, 1))(x, w)
@@ -70,10 +71,9 @@ def test_fused_gradients_exact():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_overflow_poisons_with_nan():
-    """Real in-degree far beyond the declared bound (so a node block's edge
-    range exceeds the kernel's static step count and edges WOULD be
-    dropped) must poison the output with NaN, not return a partial sum."""
+def test_extreme_degrees_exact():
+    """The dense schedule has no degree bound: dense all-to-all graphs
+    (degree 15 in a 16-node graph) are processed exactly, fwd and bwd."""
     rng = np.random.RandomState(0)
     samples = []
     for _ in range(24):
@@ -86,14 +86,14 @@ def test_overflow_poisons_with_nan():
     pad = PadSpec.for_batch(24, 16, 16 * 15)
     b = collate(samples, pad, [HeadSpec("e", "graph", 1)])
     x, w, perm = _arrays(b)
-    # declared bound 1 -> k_max covers ~2 edge blocks; real ranges span ~4
-    out = gather_mul_segment_sum(
-        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm, 1)
-    assert np.isnan(np.asarray(out)).any()
-    # with an honest bound the same batch is exact
-    ok = gather_mul_segment_sum(
-        x, w, jnp.asarray(b.senders), jnp.asarray(b.receivers), perm, 15)
-    np.testing.assert_allclose(np.asarray(ok), np.asarray(_ref(b, x, w)),
+    s, r = jnp.asarray(b.senders), jnp.asarray(b.receivers)
+    out = gather_mul_segment_sum(x, w, s, r, perm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(b, x, w)),
+                               rtol=1e-5, atol=1e-5)
+    gx1 = jax.grad(lambda x_: jnp.sum(
+        gather_mul_segment_sum(x_, w, s, r, perm) ** 2))(x)
+    gx2 = jax.grad(lambda x_: jnp.sum(_ref(b, x_, w) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
                                rtol=1e-5, atol=1e-5)
 
 
@@ -104,11 +104,6 @@ def test_collate_attaches_perm_under_fused_backend(monkeypatch):
     perm = np.asarray(b.extras["edge_perm_sender"])
     s = np.asarray(b.senders)
     assert (np.diff(s[perm]) >= 0).all()
-    # the shipped degree bound is the batch's true max (both directions)
-    r = np.asarray(b.receivers)[np.asarray(b.edge_mask) > 0]
-    sr = s[np.asarray(b.edge_mask) > 0]
-    want = max(np.bincount(sr).max(), np.bincount(r).max())
-    assert int(b.extras["edge_degree_bound"][0]) == want
     monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "scatter")
     b2 = _batch()
     assert "edge_perm_sender" not in (b2.extras or {})
@@ -141,21 +136,6 @@ def test_collate_skips_perm_when_invariants_broken(monkeypatch):
     assert "edge_perm_sender" not in (b2.extras or {})
 
 
-def test_degree_bound_poisons_via_helper(monkeypatch):
-    """gather_mul_segment must NaN-poison when the batch's true degree
-    (either direction) exceeds the model's declared max_degree."""
-    from hydragnn_tpu.graph import segment
-
-    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
-    b = _batch(max_neigh=10)
-    x, w, _ = _arrays(b)
-    true_bound = int(b.extras["edge_degree_bound"][0])
-    out_ok = segment.gather_mul_segment(x, w, b, max_degree=true_bound)
-    assert not np.isnan(np.asarray(out_ok)).any()
-    out_bad = segment.gather_mul_segment(x, w, b, max_degree=true_bound - 1)
-    assert np.isnan(np.asarray(out_bad)).any()
-
-
 def test_gather_segment_sum_wless_exact():
     """The w-less variant (GIN/MFC neighbor sum) and its gradient."""
     from hydragnn_tpu.ops.fused_mp import gather_segment_sum
@@ -165,14 +145,14 @@ def test_gather_segment_sum_wless_exact():
     s, r = jnp.asarray(b.senders), jnp.asarray(b.receivers)
     mask = jnp.asarray(b.edge_mask)
 
-    out = gather_segment_sum(x, s, r, perm, 10, mask)
+    out = gather_segment_sum(x, s, r, perm, mask)
     want = jax.ops.segment_sum(
         x[s] * mask[:, None], r, num_segments=x.shape[0])
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
 
     g1 = jax.grad(lambda x_: jnp.sum(
-        gather_segment_sum(x_, s, r, perm, 10, mask) ** 2))(x)
+        gather_segment_sum(x_, s, r, perm, mask) ** 2))(x)
     g2 = jax.grad(lambda x_: jnp.sum(jax.ops.segment_sum(
         x_[s] * mask[:, None], r, num_segments=x.shape[0]) ** 2))(x)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
@@ -184,8 +164,6 @@ def test_sum_aggr_models_fused_match_scatter(model_type, monkeypatch):
     from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
     from hydragnn_tpu.models.create import create_model
 
-    # max_degree must bound OUT-degree too (radius_graph caps in-degree
-    # only); 16 > any per-node degree in these 16-node graphs
     cfg = ModelConfig(
         model_type=model_type, input_dim=1, hidden_dim=16, output_dim=(1,),
         output_type=("graph",), graph_head=GraphHeadCfg(1, 16, 1, (16,)),
