@@ -76,6 +76,16 @@ def gather_segment(x, g):
         x[g.senders], g.receivers, x.shape[0], g.edge_mask)
 
 
+def gather_segment_mean(x, g):
+    """Masked neighbor mean ``out[n] = mean_{e: recv[e]=n} x[send[e]]``
+    (zero where a node has no real edges uses the max(count,1) convention
+    of :func:`segment_mean`) — the sum lowers to the fused kernel when
+    available."""
+    total = gather_segment(x, g)
+    deg = degree(g.receivers, x.shape[0], g.edge_mask)
+    return total / jnp.maximum(deg, 1.0)[:, None]
+
+
 def segment_count(segment_ids, num_segments, mask=None, dtype=jnp.float32):
     ones = jnp.ones((segment_ids.shape[0],), dtype)
     if mask is not None:

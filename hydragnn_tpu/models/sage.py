@@ -18,9 +18,9 @@ class SAGEConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, g, train):
-        neigh = segment.segment_mean(
-            x[g.senders], g.receivers, x.shape[0], g.edge_mask
-        )
+        # masked neighbor mean; lowers to the fused Pallas kernel under
+        # HYDRAGNN_AGGR_BACKEND=fused
+        neigh = segment.gather_segment_mean(x, g)
         out = nn.Dense(self.out_dim, name="lin_self")(x) + nn.Dense(
             self.out_dim, use_bias=False, name="lin_neigh"
         )(neigh)

@@ -13,10 +13,10 @@ how ``graph/segment.py:segment_sum`` lowers on the device:
   blocked over edges so the one-hot tile is built on the fly in VMEM and
   never materialized in HBM (the jnp version materializes an [E, N] array).
 - ``fused``: the full gather->multiply->segment-sum message-passing core in
-  one sorted-receiver Pallas pass (ops/fused_mp.py, dispatched via
-  graph/segment.py:gather_mul_segment) — +3.6% end-to-end on the flagship
-  bench; plain ``segment_sum`` calls under this backend use the scatter
-  path.
+  one sorted-receiver dense-schedule Pallas pass (ops/fused_mp.py,
+  dispatched via graph/segment.py:gather_mul_segment) — +26% end-to-end on
+  the flagship bench (docs/PERF.md); plain ``segment_sum`` calls under
+  this backend use the scatter path.
 
 All backends are exact (no atomics — deterministic accumulation order) and
 differentiable; ``segment_sum``'s gradient is a gather, which the custom VJP

@@ -159,7 +159,7 @@ def test_gather_segment_sum_wless_exact():
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("model_type", ["GIN", "MFC"])
+@pytest.mark.parametrize("model_type", ["GIN", "MFC", "SAGE"])
 def test_sum_aggr_models_fused_match_scatter(model_type, monkeypatch):
     from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
     from hydragnn_tpu.models.create import create_model
