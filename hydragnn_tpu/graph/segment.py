@@ -93,10 +93,16 @@ def segment_count(segment_ids, num_segments, mask=None, dtype=jnp.float32):
     return jax.ops.segment_sum(ones, segment_ids, num_segments)
 
 
+def _mean_divide(total, count):
+    """The one definition of the empty-segment convention: mean uses
+    max(count, 1) so empty segments read zero, not NaN."""
+    return total / _bcast(jnp.maximum(count, 1.0), total)
+
+
 def segment_mean(data, segment_ids, num_segments, mask=None):
     total = segment_sum(data, segment_ids, num_segments, mask)
     count = segment_count(segment_ids, num_segments, mask)
-    return total / _bcast(jnp.maximum(count, 1.0), total)
+    return _mean_divide(total, count)
 
 
 def segment_max(data, segment_ids, num_segments, mask=None):
@@ -145,9 +151,35 @@ def degree(receivers, num_nodes, mask=None):
     return segment_count(receivers, num_nodes, mask)
 
 
-def masked_mean_pool(x, node_gid, num_graphs, node_mask):
+def scatter_segment(data, g):
+    """Receiver-side MASKED segment sum of already-edge-valued ``data``
+    (CGCNN's gated messages, PNA aggregates): lowers to the dense-schedule
+    sorted scatter kernel when the batch carries collate's
+    verified-invariants marker (``edge_perm_sender``), else the masked
+    segment_sum.  Always edge-masked — padding edges park on a real node
+    slot, so an unmasked dense scatter would corrupt it."""
+    if g.extras and "edge_perm_sender" in g.extras:
+        from hydragnn_tpu.ops.fused_mp import segment_sum_dense
+
+        data = data * _bcast(g.edge_mask, data)
+        return segment_sum_dense(data, g.receivers, g.num_nodes)
+    return segment_sum(data, g.receivers, g.num_nodes, g.edge_mask)
+
+
+def masked_mean_pool(x, node_gid, num_graphs, node_mask, sorted_hint=False):
     """Per-graph mean over *real* nodes — parity with PyG global_mean_pool
-    (reference hydragnn/models/Base.py:296) under padding."""
+    (reference hydragnn/models/Base.py:296) under padding.  ``sorted_hint``
+    (set by Base.forward when the batch carries collate's
+    verified-invariants marker) routes the sum through the dense-schedule
+    sorted scatter kernel — collate's node_gid is nondecreasing by
+    construction."""
+    if sorted_hint:
+        from hydragnn_tpu.ops.fused_mp import segment_sum_dense
+
+        total = segment_sum_dense(
+            x * _bcast(node_mask, x), node_gid, num_graphs)
+        count = segment_count(node_gid, num_graphs, node_mask)
+        return _mean_divide(total, count)
     return segment_mean(x, node_gid, num_graphs, node_mask)
 
 

@@ -304,7 +304,9 @@ class Base(nn.Module):
             x = act(x)
 
         # --- decoder: masked mean pool + heads ---
-        x_graph = segment.masked_mean_pool(x, g.node_gid, num_graphs, g.node_mask)
+        x_graph = segment.masked_mean_pool(
+            x, g.node_gid, num_graphs, g.node_mask,
+            sorted_hint=bool(g.extras and "edge_perm_sender" in g.extras))
 
         graph_shared = None
         if c.graph_head is not None:

@@ -24,7 +24,6 @@ class CGConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, g, train):
-        n = x.shape[0]
         src, dst = g.senders, g.receivers
         parts = [x[dst], x[src]]
         if self.edge_dim and g.edge_attr is not None:
@@ -32,7 +31,9 @@ class CGConv(nn.Module):
         z = jnp.concatenate(parts, axis=-1)
         gate = jax.nn.sigmoid(nn.Dense(self.dim, name="lin_f")(z))
         core = jax.nn.softplus(nn.Dense(self.dim, name="lin_s")(z))
-        agg = segment.segment_sum(gate * core, dst, n, g.edge_mask)
+        # dense-schedule sorted scatter when the batch carries the collate
+        # marker (HYDRAGNN_AGGR_BACKEND=fused), else masked segment_sum
+        agg = segment.scatter_segment(gate * core, g)
         return x + agg, pos
 
 

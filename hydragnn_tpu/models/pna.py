@@ -39,15 +39,22 @@ class PNAConv(nn.Module):
             z = jnp.concatenate([h_dst, h_src], axis=-1)
         msg = nn.Dense(f, name="pre_nn")(z)  # pre_layers=1
 
+        # mean and std share ONE masked sum pair riding the dense-schedule
+        # sorted scatter when available (same numerics as
+        # segment_mean/segment_std: max(deg,1) divide, eps 1e-5);
+        # min/max keep the masked scatter paths
+        deg = jnp.maximum(segment.degree(dst, n, g.edge_mask), 1.0)[:, None]
+        mean = segment.scatter_segment(msg, g) / deg
+        sq_mean = segment.scatter_segment(msg * msg, g) / deg
+        std = jnp.sqrt(jnp.maximum(sq_mean - mean * mean, 0.0) + 1e-5)
         aggs = [
-            segment.segment_mean(msg, dst, n, g.edge_mask),
+            mean,
             segment.segment_min(msg, dst, n, g.edge_mask),
             segment.segment_max(msg, dst, n, g.edge_mask),
-            segment.segment_std(msg, dst, n, g.edge_mask),
+            std,
         ]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4F]
 
-        deg = jnp.maximum(segment.degree(dst, n, g.edge_mask), 1.0)[:, None]
         log_deg = jnp.log(deg + 1.0)
         scaled = jnp.concatenate(
             [

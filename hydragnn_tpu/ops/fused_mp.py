@@ -53,6 +53,41 @@ _NODE_BLOCK = 128   # rows of out per grid step (sender window = 3x this)
 _EDGE_BLOCK = 512   # edges per inner step
 
 
+def _dense_schedule(sorted_ids, n_blocks, bn, be, n_eblocks):
+    """DENSE grid schedule: one step per (node-block, populated edge-block)
+    pair, flattened CSR-style into scalar-prefetched step tables — instead
+    of a rectangular (n_blocks, k_max) grid whose bound-degree worst case
+    makes most steps no-op DMAs.  Empty blocks get exactly one step (their
+    out must still be zeroed).  Total steps are UNCONDITIONALLY bounded:
+    ranges tile the edge blocks with at most one shared boundary block per
+    adjacent pair, so sum(max(range_i, 1)) <= n_eblocks + 2*n_blocks
+    regardless of degree distribution — no degree contract, no dropped
+    edges, no overflow case at all.
+
+    Returns (step_i, step_eb, acc_valid, is_first, s_max)."""
+    start, end = block_ranges(sorted_ids, n_blocks, bn, be, n_eblocks)
+    counts = end - start
+    steps = jnp.maximum(counts, 1)
+    offsets = jnp.cumsum(steps)
+    total = offsets[-1]
+    s_max = n_eblocks + 2 * n_blocks
+    s_idx = jnp.arange(s_max, dtype=jnp.int32)
+    step_i = jnp.minimum(
+        jnp.searchsorted(offsets, s_idx, side="right"),
+        n_blocks - 1).astype(jnp.int32)
+    block_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), offsets[:-1].astype(jnp.int32)])
+    k = s_idx - block_off[step_i]
+    step_eb = jnp.clip(start[step_i] + k, 0, n_eblocks - 1).astype(jnp.int32)
+    # accumulate only on real (block, edge-block) pairs; the forced step of
+    # an empty block and the trailing padding steps (which clamp onto the
+    # last block and re-read its final edge block — a cached DMA) are no-ops
+    acc_valid = ((k < counts[step_i]) & (s_idx < total)).astype(jnp.int32)
+    prev_i = jnp.concatenate([jnp.full(1, -1, jnp.int32), step_i[:-1]])
+    is_first = (step_i != prev_i).astype(jnp.int32)
+    return step_i, step_eb, acc_valid, is_first, s_max
+
+
 def _fwd_kernel(has_w, si_ref, se_ref, av_ref, fi_ref, send_ref, recv_ref,
                 *rest):
     from jax.experimental import pallas as pl
@@ -127,36 +162,8 @@ def _fused_impl(x, w, senders, receivers, interpret, mask=None):
     recv_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
         receivers.astype(jnp.int32))
 
-    start, end = block_ranges(recv_p[:, 0], n_blocks, bn, be, n_eblocks)
-
-    # DENSE schedule: one grid step per (node-block, populated edge-block)
-    # pair, flattened CSR-style through scalar-prefetched step tables —
-    # instead of a rectangular (n_blocks, k_max) grid whose bound-degree
-    # worst case makes most steps no-op DMAs.  Empty blocks get exactly one
-    # step (their out must still be zeroed).  Total steps are UNCONDITIONALLY
-    # bounded: ranges tile the edge blocks with at most one shared boundary
-    # block per adjacent pair, so sum(max(range_i, 1)) <= n_eblocks +
-    # 2*n_blocks regardless of degree distribution — no degree contract, no
-    # dropped edges, no overflow case at all.
-    counts = end - start
-    steps = jnp.maximum(counts, 1)
-    offsets = jnp.cumsum(steps)
-    total = offsets[-1]
-    s_max = n_eblocks + 2 * n_blocks
-    s_idx = jnp.arange(s_max, dtype=jnp.int32)
-    step_i = jnp.minimum(
-        jnp.searchsorted(offsets, s_idx, side="right"),
-        n_blocks - 1).astype(jnp.int32)
-    block_off = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), offsets[:-1].astype(jnp.int32)])
-    k = s_idx - block_off[step_i]
-    step_eb = jnp.clip(start[step_i] + k, 0, n_eblocks - 1).astype(jnp.int32)
-    # accumulate only on real (block, edge-block) pairs; the forced step of
-    # an empty block and the trailing padding steps (which clamp onto the
-    # last block and re-read its final edge block — a cached DMA) are no-ops
-    acc_valid = ((k < counts[step_i]) & (s_idx < total)).astype(jnp.int32)
-    prev_i = jnp.concatenate([jnp.full(1, -1, jnp.int32), step_i[:-1]])
-    is_first = (step_i != prev_i).astype(jnp.int32)
+    step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
+        recv_p[:, 0], n_blocks, bn, be, n_eblocks)
 
     def eix(s, si, se, av, fi):
         return (se[s], 0)
@@ -265,3 +272,104 @@ def _gss_bwd(res, g):
 
 
 gather_segment_sum.defvjp(_gss_fwd, _gss_bwd)
+
+
+# ---------------------------------------------------------------------------
+# scatter-only variant: sorted segment sum on the dense schedule (no gather)
+# — replaces XLA's sort-based scatter for already-edge-valued data (CGCNN's
+# gated messages, PNA aggregates, masked pooling over node_gid)
+# ---------------------------------------------------------------------------
+
+def _scatter_kernel(si_ref, se_ref, av_ref, fi_ref, ids_ref, data_ref,
+                    out_ref):
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    i = si_ref[s]
+
+    @pl.when(fi_ref[s] == 1)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(av_ref[s] == 1)
+    def _acc():
+        bn = out_ref.shape[0]
+        be = ids_ref.shape[0]
+        loc = ids_ref[:] - i * bn
+        onehot = (loc == jax.lax.broadcasted_iota(
+            jnp.int32, (be, bn), 1)).astype(jnp.float32)
+        out_ref[:] += jax.lax.dot_general(
+            onehot, data_ref[:].astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _scatter_impl(data2d, sorted_ids, num_segments, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e, f = data2d.shape
+    bn, be = _NODE_BLOCK, _EDGE_BLOCK
+    n_pad = _round_up(num_segments, bn)
+    e_pad = _round_up(max(e, 1), be)
+    f_pad = _round_up(max(f, 1), 128)
+    n_blocks, n_eblocks = n_pad // bn, e_pad // be
+
+    data_p = jnp.zeros((e_pad, f_pad), data2d.dtype).at[:e, :f].set(data2d)
+    ids_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
+        sorted_ids.astype(jnp.int32))
+
+    step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
+        ids_p[:, 0], n_blocks, bn, be, n_eblocks)
+
+    def eix(s, si, se, av, fi):
+        return (se[s], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(s_max,),
+        in_specs=[
+            pl.BlockSpec((be, 1), eix),
+            pl.BlockSpec((be, f_pad), eix),
+        ],
+        out_specs=pl.BlockSpec(
+            (bn, f_pad), lambda s, si, se, av, fi: (si[s], 0)),
+    )
+    out = pl.pallas_call(
+        _scatter_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(step_i, step_eb, acc_valid, is_first, ids_p, data_p)
+    return out[:num_segments, :f].astype(data2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def segment_sum_dense(data, sorted_ids, num_segments):
+    """Exact segment sum REQUIRING nondecreasing ``sorted_ids`` (collate's
+    receivers / node_gid invariant) — one dense-schedule Pallas pass
+    instead of XLA's sort-based scatter.  Any id distribution is processed
+    exactly (no degree bound); out-of-range ids contribute nothing.
+    Differentiable wrt ``data``."""
+    shape = data.shape
+    interpret = jax.default_backend() != "tpu"
+    out = _scatter_impl(
+        data.reshape(shape[0], -1), sorted_ids, num_segments, interpret)
+    return out.reshape((num_segments,) + shape[1:])
+
+
+def _ssd_fwd(data, sorted_ids, num_segments):
+    return segment_sum_dense(data, sorted_ids, num_segments), (
+        sorted_ids, data.shape)
+
+
+def _ssd_bwd(num_segments, res, g):
+    sorted_ids, shape = res
+    g2 = g.reshape(num_segments, -1)
+    valid = (sorted_ids >= 0) & (sorted_ids < num_segments)
+    safe = jnp.clip(sorted_ids, 0, num_segments - 1)
+    d = jnp.where(valid[:, None], g2[safe], 0.0)
+    return d.reshape(shape), None
+
+
+segment_sum_dense.defvjp(_ssd_fwd, _ssd_bwd)

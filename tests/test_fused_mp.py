@@ -159,16 +159,51 @@ def test_gather_segment_sum_wless_exact():
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("model_type", ["GIN", "MFC", "SAGE"])
+def test_segment_sum_dense_exact():
+    """Scatter-only dense-schedule kernel vs jax.ops.segment_sum, fwd+bwd,
+    over both sorted id streams the models use (receivers, node_gid)."""
+    from hydragnn_tpu.ops.fused_mp import segment_sum_dense
+
+    b = _batch(seed=11)
+    rng = np.random.RandomState(12)
+    e = b.senders.shape[0]
+    data = jnp.asarray(rng.rand(e, 48), jnp.float32) * jnp.asarray(
+        b.edge_mask)[:, None]
+    r = jnp.asarray(b.receivers)
+    n = b.x.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(segment_sum_dense(data, r, n)),
+        np.asarray(jax.ops.segment_sum(data, r, num_segments=n)),
+        rtol=1e-5, atol=1e-5)
+    g1 = jax.grad(lambda d: jnp.sum(segment_sum_dense(d, r, n) ** 2))(data)
+    g2 = jax.grad(lambda d: jnp.sum(
+        jax.ops.segment_sum(d, r, num_segments=n) ** 2))(data)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-5, atol=1e-5)
+
+    nd = jnp.asarray(rng.rand(n, 32), jnp.float32)
+    gid = jnp.asarray(b.node_gid)
+    ng = b.graph_mask.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(segment_sum_dense(nd, gid, ng)),
+        np.asarray(jax.ops.segment_sum(nd, gid, num_segments=ng)),
+        rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("model_type", ["GIN", "MFC", "SAGE", "CGCNN", "PNA"])
 def test_sum_aggr_models_fused_match_scatter(model_type, monkeypatch):
     from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
     from hydragnn_tpu.models.create import create_model
 
     cfg = ModelConfig(
-        model_type=model_type, input_dim=1, hidden_dim=16, output_dim=(1,),
+        model_type=model_type, input_dim=1,
+        # CGCNN's conv is dim-preserving: hidden_dim forced = input_dim
+        hidden_dim=1 if model_type == "CGCNN" else 16,
+        output_dim=(1,),
         output_type=("graph",), graph_head=GraphHeadCfg(1, 16, 1, (16,)),
         node_head=None, task_weights=(1.0,), num_conv_layers=2,
-        max_degree=16, max_neighbours=16)
+        max_degree=16, max_neighbours=16,
+        pna_avg_deg_log=1.1, pna_avg_deg_lin=3.0)
     model = create_model(cfg)
 
     monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
