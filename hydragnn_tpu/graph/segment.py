@@ -151,6 +151,22 @@ def degree(receivers, num_nodes, mask=None):
     return segment_count(receivers, num_nodes, mask)
 
 
+def sorted_segment_sum(data, segment_ids, num_segments, mask=None,
+                       sorted_hint=False):
+    """Masked segment sum that rides the dense-schedule sorted scatter
+    kernel when the caller vouches (``sorted_hint``) that ``segment_ids``
+    are nondecreasing; else the standard masked segment_sum.  Masking
+    happens BEFORE the dense scatter — padding rows park on real slots, so
+    an unmasked dense scatter would corrupt them."""
+    if sorted_hint:
+        from hydragnn_tpu.ops.fused_mp import segment_sum_dense
+
+        if mask is not None:
+            data = data * _bcast(mask, data)
+        return segment_sum_dense(data, segment_ids, num_segments)
+    return segment_sum(data, segment_ids, num_segments, mask)
+
+
 def scatter_segment(data, g):
     """Receiver-side MASKED segment sum of already-edge-valued ``data``
     (CGCNN's gated messages, PNA aggregates): lowers to the dense-schedule

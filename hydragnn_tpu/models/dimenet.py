@@ -267,6 +267,7 @@ class InteractionPPBlock(nn.Module):
     basis_emb_size: int
     num_before_skip: int
     num_after_skip: int
+    sorted_hint: bool = False  # idx_ji is nondecreasing (builder order)
 
     @nn.compact
     def __call__(self, x_edge, rbf, sbf, idx_kj, idx_ji, triplet_mask):
@@ -282,7 +283,10 @@ class InteractionPPBlock(nn.Module):
         sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
         sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
         msg = x_kj[idx_kj] * sbf_emb * triplet_mask[:, None]
-        x_kj = segment.segment_sum(msg, idx_ji, e)
+        # build_triplets emits idx_ji in nondecreasing order (outer loop
+        # over edge ids) — the dense-schedule sorted scatter applies
+        x_kj = segment.sorted_segment_sum(
+            msg, idx_ji, e, sorted_hint=self.sorted_hint)
         x_kj = _silu(nn.Dense(self.hidden, use_bias=False, name="lin_up")(x_kj))
 
         h = x_ji + x_kj
@@ -300,11 +304,14 @@ class OutputPPBlock(nn.Module):
     out_dim: int
     num_layers: int = 1
 
+    sorted_hint: bool = False  # receivers are nondecreasing (collate)
+
     @nn.compact
     def __call__(self, x_edge, rbf, receivers, num_nodes, edge_mask):
         g = nn.Dense(self.hidden, use_bias=False, name="lin_rbf")(rbf)
         x = g * x_edge
-        x = segment.segment_sum(x, receivers, num_nodes, edge_mask)
+        x = segment.sorted_segment_sum(
+            x, receivers, num_nodes, edge_mask, sorted_hint=self.sorted_hint)
         x = nn.Dense(self.out_emb_size, use_bias=False, name="lin_up")(x)
         for i in range(self.num_layers):
             x = _silu(nn.Dense(self.out_emb_size, name=f"lin_{i}")(x))
@@ -370,16 +377,19 @@ class DimeNetConv(nn.Module):
                 jnp.concatenate([h[dst], h[src], rbf_e], axis=-1)
             )
         )
+        sorted_hint = bool(g.extras and "edge_perm_sender" in g.extras)
         x_edge = InteractionPPBlock(
             hidden,
             self.int_emb_size,
             self.basis_emb_size,
             self.num_before_skip,
             self.num_after_skip,
+            sorted_hint=sorted_hint,
             name="interaction",
         )(x_edge, rbf, sbf, idx_kj, idx_ji, tmask)
         out = OutputPPBlock(
-            hidden, self.out_emb_size, self.out_dim, num_layers=1, name="output"
+            hidden, self.out_emb_size, self.out_dim, num_layers=1,
+            sorted_hint=sorted_hint, name="output"
         )(x_edge, rbf, dst, n, g.edge_mask)
         return out, pos
 
