@@ -48,7 +48,8 @@ def _child(platform: str) -> None:
     TPU backend — the parent enforces the timeout."""
     # flagship config tuning: the fused message-passing kernel
     # (ops/fused_mp.py) is exact (tests/test_fused_mp.py) and measured
-    # +3.6% end-to-end at these shapes; honor an explicit override
+    # +26% end-to-end at these shapes (61.0k -> 76.6k graphs/s with the
+    # dense-schedule kernel; see docs/PERF.md); honor an explicit override
     os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
 
     import jax

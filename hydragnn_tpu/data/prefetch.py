@@ -24,7 +24,12 @@ def _make_stage(sharding=None):
     path coalesces the batch pytree's leaves (~20x faster than per-leaf
     device_put on remote/tunneled runtimes).  Batches already staged with
     the target placement pass through untouched, so composing the two
-    wrappers doesn't double-dispatch."""
+    wrappers doesn't double-dispatch.
+
+    With ``sharding=None`` any batch whose leaves are already ``jax.Array``
+    passes through regardless of placement: None-sharding staging is for
+    single-device pipelines (how the trainer uses it), where the default
+    device is the only possible placement."""
     import jax
 
     if sharding is not None:
@@ -165,6 +170,10 @@ class ResidentDeviceLoader:
             self.loader.set_epoch(epoch)
 
     def __len__(self) -> int:
+        # During a partially-staged epoch (possible only under a capped
+        # consumer, e.g. HYDRAGNN_MAX_NUM_BATCH) this is an approximation:
+        # the epoch yields remaining-unstaged + previously-staged items.
+        # Capped consumers cap by count, so the approximation is harmless.
         return len(self._cache) if self._complete else len(self.loader)
 
     def __iter__(self) -> Iterator:

