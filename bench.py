@@ -1,22 +1,47 @@
-"""Benchmark: training throughput of the flagship config on the local chip.
+"""Benchmark: training throughput + honest roofline of the flagship config.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The single line carries nested evidence blocks (round-3 VERDICT items 1/2/5):
 
-The benchmarked step is the jit'd train step of a QM9-scale SchNet energy
-model (BASELINE.md headline config) on synthetic padded batches — the same
-step function ``run_training`` uses.  The reference publishes no throughput
-numbers (see BASELINE.md), so ``vs_baseline`` is the ratio against a recorded
-measurement in ``BASELINE.json["published"]`` when available, else 1.0.
+  value                  chip-loop ceiling, graphs/sec/chip (headline; same
+                         definition as rounds 1-2 for comparability)
+  sustained              what a ``run_training`` user gets end-to-end:
+                         loader -> stack -> resident replay -> scanned step,
+                         measured through the real trainer epoch loop
+  roofline               measured-method roofline for the SAME program that
+                         is timed: flops from XLA's cost model (fusion-
+                         invariant), bytes from XLA's buffer assignment
+                         (memory_analysis: args + outputs + 2*temps; see
+                         _roofline for why the cost model and naive HLO
+                         sums both overcount), achieved HBM GB/s, MFU
+                         against the MXU's native 197 TF/s bf16 peak
+                         (JAX's default matmul precision runs f32 dots
+                         through the MXU as bf16 — measured 56.7 TF/s on
+                         an 8192^3 f32 matmul here, >49 TF/s "f32 peak",
+                         so 49e12 is the wrong basis; r02 used it)
+  membw_probe            measured achievable HBM bandwidth on THIS chip
+                         (streamed x*a copy, 2 sizes) — the denominator any
+                         bandwidth-bound claim has to live under
+  dense                  compute-dense flagship (hidden-256 SchNet, bf16):
+                         same measurements where MFU is a meaningful axis
+  archs                  per-arch sweep: all 9 stacks, chip-loop throughput
 
-Robustness (round-1 BENCH rc=1 post-mortem): the environment pre-registers a
-TPU plugin whose backend init can either fail (UNAVAILABLE) or block forever
-when the chip/tunnel is down.  The measurement therefore runs in a CHILD
-process under a hard timeout; the parent tries the TPU twice, falls back to
-CPU, and always prints a JSON line — even on total failure (value 0 plus an
-"error" diagnostic), so the driver records something parseable.
+The reference publishes no throughput numbers (BASELINE.md), so
+``vs_baseline`` is the ratio against BASELINE.json["published"] when
+present, else 1.0.
+
+Robustness (round-1 post-mortem): the TPU plugin can fail or hang at init,
+so measurement runs in a CHILD process under a hard timeout; the parent
+tries TPU twice, falls back to CPU, and always prints a parseable line.
+The child re-prints the cumulative headline line after EVERY phase, and the
+parent scans stdout in reverse — a timeout mid-phase still yields the most
+complete finished measurement.
 
 Env knobs: HYDRAGNN_BENCH_PLATFORM=tpu|cpu|auto (default auto),
-HYDRAGNN_BENCH_TIMEOUT (seconds per TPU attempt, default 420).
+HYDRAGNN_BENCH_TIMEOUT (seconds per TPU attempt, default 1800),
+HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,sustained,dense,archs;
+default all on TPU, ceiling-only on CPU), HYDRAGNN_BENCH_DTYPE (flagship
+compute dtype, default float32).
 """
 
 from __future__ import annotations
@@ -29,6 +54,11 @@ import time
 
 METRIC = "qm9_schnet_train_throughput"
 UNIT = "graphs/sec/chip"
+MXU_PEAK = 197e12  # v5e bf16 systolic peak; see module docstring for why
+                   # this is also the right basis for default-precision f32
+
+ARCHS = ["SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN", "SchNet", "DimeNet",
+         "EGNN"]
 
 
 def _baseline_ratio(graphs_per_sec: float) -> float:
@@ -43,13 +73,307 @@ def _baseline_ratio(graphs_per_sec: float) -> float:
     return (graphs_per_sec / float(base)) if base else 1.0
 
 
+# ---------------------------------------------------------------------------
+# child-side measurement helpers
+# ---------------------------------------------------------------------------
+
+
+def _sync(tree):
+    """TRUE completion barrier: on the tunneled remote-PJRT runtime here,
+    block_until_ready returns at dispatch (measured 100x-overreporting when
+    the execution queue is empty) — only a device->host transfer actually
+    waits.  The fetched leaf is small, so the transfer itself is noise."""
+    import jax
+    import numpy as np
+
+    np.asarray(jax.tree_util.tree_leaves(tree)[0])
+
+
+def _build(model_type="SchNet", hidden=64, dtype="float32", batch_size=512,
+           nodes_per_graph=20):
+    """Flagship-shaped synthetic setup for one arch: QM9-scale graphs
+    (~20 atoms), radius graph, single graph head."""
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.graph.batch import (
+        GraphSample, HeadSpec, PadSpec, collate)
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+
+    # CGConv preserves feature dim, so CGCNN's width IS the input width
+    in_dim = hidden if model_type == "CGCNN" else 1
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(batch_size):
+        n = nodes_per_graph
+        pos = rng.rand(n, 3).astype(np.float32) * 4.0
+        x = (rng.rand(n, in_dim).astype(np.float32) if in_dim > 1
+             else rng.randint(0, 5, (n, 1)).astype(np.float32))
+        ei = radius_graph(pos, radius=1.8, max_neighbours=20)
+        samples.append(GraphSample(
+            x=x, pos=pos, edge_index=ei,
+            graph_y=rng.rand(1).astype(np.float32), node_y=x[:, :1]))
+    heads = [HeadSpec("energy", "graph", 1)]
+    pad = PadSpec.for_batch(batch_size, nodes_per_graph,
+                            max(s.num_edges for s in samples))
+    batch = collate(samples, pad, heads)
+    if model_type == "DimeNet":
+        from hydragnn_tpu.models.dimenet import (
+            add_dimenet_extras, count_triplets)
+        import numpy as np2
+
+        real = np2.asarray(batch.edge_mask) > 0
+        ei_real = np2.stack([np2.asarray(batch.senders)[real],
+                             np2.asarray(batch.receivers)[real]])
+        t = count_triplets(ei_real, batch.x.shape[0])
+        batch = add_dimenet_extras(batch, max_triplets=t + 8)
+
+    cfg = ModelConfig(
+        model_type=model_type,
+        input_dim=in_dim,
+        hidden_dim=hidden,
+        output_dim=(1,),
+        output_type=("graph",),
+        graph_head=GraphHeadCfg(2, hidden, 2, (hidden, hidden)),
+        node_head=None,
+        task_weights=(1.0,),
+        num_conv_layers=4,
+        num_gaussians=50,
+        num_filters=hidden,
+        radius=1.8,
+        max_neighbours=20,
+        max_degree=20,
+        pna_avg_deg_log=1.8,
+        pna_avg_deg_lin=6.0,
+        envelope_exponent=5,
+        num_before_skip=1,
+        num_after_skip=2,
+        num_radial=6,
+        num_spherical=7,
+        basis_emb_size=8,
+        int_emb_size=64,
+        out_emb_size=64,
+        # validated by ModelConfig.__post_init__ — a typo raises rather
+        # than silently benchmarking f32 while claiming bf16
+        compute_dtype=dtype,
+    )
+    model = create_model(cfg)
+    opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    state = create_train_state(model, batch, opt_spec)
+    batch = jax.device_put(batch)
+    step = make_train_step(model, cfg, opt_spec)
+    return state, batch, step, cfg, samples, heads
+
+
+def _chip_loop(state, batch, step, n_iters, n_repeats):
+    """Best-of-N timing of K steps inside one compiled fori_loop (per-step
+    host dispatch otherwise dominates; the train state threads through the
+    carry so nothing is hoisted or DCE'd)."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def run_k(state0):
+        def body(_, s):
+            s, _m = step(s, batch)
+            return s
+        return lax.fori_loop(0, n_iters, body, state0)
+
+    state = run_k(state)  # compile + warmup
+    _sync(state.params)
+    best = float("inf")
+    for _ in range(n_repeats):
+        t0 = time.perf_counter()
+        state = run_k(state)
+        _sync(state.params)
+        best = min(best, time.perf_counter() - t0)
+    return best / n_iters, state
+
+
+def _roofline(step, state, batch, step_s):
+    """Roofline fields for the SAME per-step program being timed.
+
+    flops: XLA cost model (fusion-invariant, reliable).
+    bytes: XLA's buffer assignment (``compiled.memory_analysis()``) — the
+    r02 cost-model bytes were fusion-blind and implied 1.9x the v5e's HBM
+    spec (VERDICT weak-1), and naive HLO-boundary sums overcount shared
+    operands/async DMA bookkeeping.  The buffer-assignment estimate is
+    structural: program arguments are read, outputs are written, and every
+    HBM temp buffer is written once and read at least once, so
+
+        bytes/step ~ argument_size + output_size + 2 * temp_size
+
+    This slightly UNDERcounts (a temp re-read by several kernels is billed
+    once) and is therefore a defensible achieved-bandwidth figure — on the
+    v5e it lands well below both the 819 GB/s HBM spec and the measured
+    probe bandwidth, unlike its predecessors.
+    """
+    import jax
+
+    compiled = jax.jit(step).lower(state, batch).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    flops = float(ca.get("flops", 0.0))
+    cm_bytes = float(ca.get("bytes accessed", 0.0))
+    ma = compiled.memory_analysis()
+    ba_bytes = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + 2 * ma.temp_size_in_bytes)
+    out = {
+        "flops_per_step": round(flops),
+        "achieved_tflops": round(flops / step_s / 1e12, 3),
+        "mfu_pct": round(flops / step_s / MXU_PEAK * 100, 2),
+        "mfu_peak_basis_tflops": 197,
+        "hbm_bytes_per_step": int(ba_bytes),
+        "hbm_gbps": round(ba_bytes / step_s / 1e9, 1),
+        "bytes_method": "XLA buffer assignment: args + outputs + 2*temps "
+                        "(each HBM temp written once + read >= once); the "
+                        "fusion-blind cost-model figure is reported only "
+                        "as cost_model_bytes_per_step",
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "cost_model_bytes_per_step": int(cm_bytes),
+    }
+    return out
+
+
+def _membw_probe():
+    """Measured achievable HBM bandwidth, overhead-cancelled: time a
+    streamed y = x*a at two working-set sizes and take the MARGINAL
+    bandwidth (delta traffic / delta time), which cancels the fixed
+    per-kernel/per-iteration overheads that dominate small arrays —
+    exactly the regime a 512-graph GNN step lives in, which is why the
+    raw small-size number is also reported."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def timed(mb):
+        n_rows = mb * 1024 * 1024 // (4 * 1024)
+        x = jnp.ones((n_rows, 1024), jnp.float32)
+
+        @jax.jit
+        def probe(x, s):
+            def body(_, c):
+                x, s = c
+                y = x * 1.0000001
+                return y, s + y[0, 0] * 1e-30
+            return lax.fori_loop(0, 8, body, (x, s))
+
+        y, s = probe(x, jnp.float32(1e-9))
+        _sync(s)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            y, s = probe(x, jnp.float32(1e-9))
+            _sync(s)
+            best = min(best, time.perf_counter() - t0)
+        return best, 8 * 2 * mb * 1024 * 1024
+
+    t_small, b_small = timed(64)
+    t_big, b_big = timed(2048)
+    t_mid, b_mid = timed(1024)
+    out = {
+        "raw_64MB_gbps": round(b_small / t_small / 1e9, 1),
+        "raw_2GB_gbps": round(b_big / t_big / 1e9, 1),
+        "method": "jit fori_loop of y = x*a (read+write), best of 3, "
+                  "completion forced by host fetch; marginal = "
+                  "(bytes_2GB - bytes_1GB)/(t_2GB - t_1GB), cancelling "
+                  "fixed per-kernel overheads",
+    }
+    if t_big > t_mid:
+        out["marginal_gbps"] = round((b_big - b_mid) / (t_big - t_mid) / 1e9,
+                                     1)
+    else:
+        # timing inversion (host stall mid-probe): the marginal figure
+        # would be nonsense — flag it and let the raw number stand
+        out["marginal_gbps_error"] = "timing inversion between sizes"
+    return out
+
+
+def _sustained(samples, heads):
+    """What a run_training user gets: the real trainer epoch loop (loader ->
+    DeviceStackLoader -> ResidentDeviceLoader -> scanned jit step), measured
+    over full epochs after a warmup epoch that pays compile + staging."""
+    import numpy as np
+
+    from hydragnn_tpu.data.dataloader import create_dataloaders
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import (
+        create_train_state, train_validate_test)
+
+    os.environ["HYDRAGNN_VALTEST"] = "0"
+    os.environ.setdefault("HYDRAGNN_STEPS_PER_DISPATCH", "8")
+    os.environ.setdefault("HYDRAGNN_RESIDENT_DATASET", "1")
+
+    n_batches = 64
+    batch_size = 512
+    # deterministic corpus: the flagship samples cycled to 64 batches
+    big = [samples[i % len(samples)] for i in range(n_batches * batch_size)]
+    train_loader, val_loader, test_loader = create_dataloaders(
+        big, big[:batch_size], big[:batch_size], batch_size, heads)
+
+    cfg = ModelConfig(
+        model_type="SchNet", input_dim=1, hidden_dim=64, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(2, 64, 2, (64, 64)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=4,
+        num_gaussians=50, num_filters=64, radius=1.8, max_neighbours=20,
+        compute_dtype=os.getenv("HYDRAGNN_BENCH_DTYPE", "float32").strip())
+    model = create_model(cfg)
+    opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    state = create_train_state(model, next(iter(train_loader)), opt_spec)
+
+    n_epochs = 6
+    config_nn = {
+        "Training": {"num_epoch": n_epochs},
+        "Variables_of_interest": {"output_names": ["energy"]},
+    }
+    # ONE call: epoch 0 pays trace+compile and the one-time resident
+    # staging; the trainer records per-epoch wall time in
+    # history["epoch_time"], so the steady-state epochs are separable
+    # without re-running (a second call would re-trace and re-stage,
+    # measuring harness artifacts instead of training)
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, train_loader, val_loader, test_loader,
+        config_nn, "bench_sustained", verbosity=0, rank=0, world_size=1)
+    _sync(state.params)
+    # drop_last stacking: graphs actually consumed per epoch
+    spd = int(os.environ.get("HYDRAGNN_STEPS_PER_DISPATCH", "1"))
+    n_used = (n_batches // spd) * spd * batch_size
+    steady = sorted(history["epoch_time"][2:])
+    med = steady[len(steady) // 2]
+    return {
+        "graphs_per_sec": round(n_used / med, 1),
+        "epoch_time_s": [round(t, 3) for t in history["epoch_time"]],
+        "graphs_per_epoch": n_used,
+        "knobs": {
+            "HYDRAGNN_STEPS_PER_DISPATCH": spd,
+            "HYDRAGNN_RESIDENT_DATASET": 1,
+            "HYDRAGNN_VALTEST": 0,
+        },
+        "method": "median steady-state epoch wall time (epochs 2+; epoch 0 "
+                  "pays compile + one-time device staging) of the real "
+                  "train_validate_test loop — includes scheduler/history/"
+                  "host overheads a real run pays",
+    }
+
+
+# ---------------------------------------------------------------------------
+# child
+# ---------------------------------------------------------------------------
+
+
 def _child(platform: str) -> None:
-    """Run the measurement and print the JSON line.  May hang/crash on a bad
-    TPU backend — the parent enforces the timeout."""
-    # flagship config tuning: the fused message-passing kernel
-    # (ops/fused_mp.py) is exact (tests/test_fused_mp.py) and measured
-    # +26% end-to-end at these shapes (61.0k -> 76.6k graphs/s with the
-    # dense-schedule kernel; see docs/PERF.md); honor an explicit override
+    """Run the measurement phases, re-printing the cumulative headline JSON
+    line after each.  May hang/crash on a bad TPU backend — the parent
+    enforces the timeout and keeps the last finished line."""
+    # flagship tuning: the fused message-passing kernel (ops/fused_mp.py) is
+    # exact (tests/test_fused_mp.py) and measured +26% end-to-end at these
+    # shapes (61.0k -> 76.6k graphs/s dense-schedule; docs/PERF.md)
     os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
 
     import jax
@@ -57,144 +381,100 @@ def _child(platform: str) -> None:
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    import numpy as np
-
     devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
     print(f"bench: platform={devs[0].platform} devices={len(devs)}",
           file=sys.stderr)
 
-    from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
-    from hydragnn_tpu.graph.neighborlist import radius_graph
-    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
-    from hydragnn_tpu.models.create import create_model
-    from hydragnn_tpu.train.optimizer import select_optimizer
-    from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+    default_phases = ("ceiling,roofline,sustained,dense,archs" if on_tpu
+                      else "ceiling")
+    phases = [p.strip() for p in os.getenv(
+        "HYDRAGNN_BENCH_PHASES", default_phases).split(",") if p.strip()]
+    dtype = os.getenv("HYDRAGNN_BENCH_DTYPE", "float32").strip()
+    n_iters = 200 if on_tpu else 5
+    n_repeats = 3 if on_tpu else 1
 
-    # QM9-scale: ~18 heavy+H atoms/graph, batch 512, hidden 64, 4 interactions
-    # (batch 512 saturates the chip: +17% over 128 with true-sync timing)
-    batch_size = 512
-    nodes_per_graph = 20
-    rng = np.random.RandomState(0)
-    samples = []
-    for _ in range(batch_size):
-        n = nodes_per_graph
-        pos = rng.rand(n, 3).astype(np.float32) * 4.0
-        x = rng.randint(0, 5, (n, 1)).astype(np.float32)
-        ei = radius_graph(pos, radius=1.8, max_neighbours=20)
-        samples.append(GraphSample(
-            x=x, pos=pos, edge_index=ei,
-            graph_y=rng.rand(1).astype(np.float32), node_y=x))
-    heads = [HeadSpec("energy", "graph", 1)]
-    pad = PadSpec.for_batch(batch_size, nodes_per_graph,
-                            max(s.num_edges for s in samples))
-    batch = collate(samples, pad, heads)
+    result = {"metric": METRIC, "value": 0.0, "unit": UNIT,
+              "vs_baseline": 0.0, "platform": devs[0].platform}
 
-    cfg = ModelConfig(
-        model_type="SchNet",
-        input_dim=1,
-        hidden_dim=64,
-        output_dim=(1,),
-        output_type=("graph",),
-        graph_head=GraphHeadCfg(2, 64, 2, (64, 64)),
-        node_head=None,
-        task_weights=(1.0,),
-        num_conv_layers=4,
-        num_gaussians=50,
-        num_filters=64,
-        radius=1.8,
-        max_neighbours=20,
-        # validated by ModelConfig.__post_init__ — a typo raises rather than
-        # silently benchmarking f32 while claiming bf16
-        compute_dtype=os.getenv("HYDRAGNN_BENCH_DTYPE", "float32").strip(),
-    )
-    model = create_model(cfg)
-    opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
-    state = create_train_state(model, batch, opt_spec)
-    batch = jax.device_put(batch)
-
-    # Measure K steps INSIDE one compiled fori_loop: per-step host dispatch
-    # (~100us/step here) otherwise dominates and readings varied 3x with host
-    # CPU contention.  The on-device loop gives chip-side training
-    # throughput — representative when the input pipeline keeps up (prefetch
-    # overlaps collation; see data/prefetch.py).  run_k is the only
-    # executable compiled BEFORE the measurement; the single-step compile
-    # for roofline cost analysis happens after the timing, where it can't
-    # eat into the warmup/measure budget.
-    from jax import lax
-
-    train_step = make_train_step(model, cfg, opt_spec)
-    n_iters = 200 if devs[0].platform != "cpu" else 5
-    n_repeats = 3 if devs[0].platform != "cpu" else 1
-
-    @jax.jit
-    def run_k(state0):
-        def body(_, s):
-            s, _m = train_step(s, batch)
-            return s
-        return lax.fori_loop(0, n_iters, body, state0)
-
-    def sync(s):
-        # TRUE completion barrier: on the tunneled remote-PJRT runtime here,
-        # block_until_ready returns at dispatch (measured 100x-overreporting
-        # when the execution queue is empty) — only a device->host transfer
-        # actually waits for the computation.  The fetched leaf is ~16 KB, so
-        # the transfer itself is noise at these step times.
-        np.asarray(jax.tree_util.tree_leaves(s.params)[0])
-
-    t_c = time.perf_counter()
-    state = run_k(state)  # compile + warmup
-    sync(state)
-    print(f"bench: compile+warmup ({n_iters} steps) "
-          f"{time.perf_counter() - t_c:.1f}s", file=sys.stderr)
-    best_dt = float("inf")
-    for _ in range(n_repeats):
-        t0 = time.perf_counter()
-        state = run_k(state)
-        sync(state)
-        best_dt = min(best_dt, time.perf_counter() - t0)
-    dt = best_dt
-
-    graphs_per_sec = batch_size * n_iters / dt
-    # the recorded baseline is a TPU number — a CPU-fallback run must not be
-    # ratioed against it (it would read as a huge phantom regression)
-    ratio = (_baseline_ratio(graphs_per_sec)
-             if devs[0].platform != "cpu" else 1.0)
-    result = {
-        "metric": METRIC,
-        "value": round(graphs_per_sec, 2),
-        "unit": UNIT,
-        "vs_baseline": round(ratio, 4),
-        "platform": devs[0].platform,
-    }
-    # print the measured result BEFORE the roofline compile below: if that
-    # second compile ran long the child would hit the parent's timeout and
-    # throw away a finished measurement (the parent parses partial stdout
-    # on timeout, and scans lines in reverse so a later augmented line wins)
-    print(json.dumps(result), flush=True)
-    # Roofline context from XLA's own cost model (per-step flops / bytes of
-    # the compiled loop, divided by n_iters).  Measured on the v5e: the step
-    # is HBM-bandwidth-bound (~2 flop/byte), so MFU is structurally tiny for
-    # this small-hidden-dim GNN and hbm_util is the number that matters.
-    try:
-        # analyze ONE train step, not run_k: XLA's cost model reports only
-        # the outer computation of a fori_loop, omitting the loop body
-        ca = jax.jit(train_step).lower(state, batch).compile().cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        flops = float(ca.get("flops", 0.0))
-        byts = float(ca.get("bytes accessed", 0.0))
-        step_s = dt / n_iters
-        if flops > 0:
-            result["flops_per_step"] = round(flops)
-            result["achieved_tflops"] = round(flops / step_s / 1e12, 3)
-        if byts > 0:
-            result["hbm_gbps"] = round(byts / step_s / 1e9, 1)
-        if devs[0].platform == "tpu" and flops > 0:
-            # v5e peak: 197 TFLOP/s bf16; f32 runs the MXU at ~1/4 rate
-            peak = 197e12 if cfg.compute_dtype == "bfloat16" else 49e12
-            result["mfu_pct"] = round(flops / step_s / peak * 100, 2)
+    def emit():
         print(json.dumps(result), flush=True)
-    except Exception:
-        pass  # cost analysis is best-effort context, never fails the bench
+
+    # --- ceiling (headline) ---
+    t_c = time.perf_counter()
+    state, batch, step, cfg, samples, heads = _build(dtype=dtype)
+    step_s, state = _chip_loop(state, batch, step, n_iters, n_repeats)
+    print(f"bench: flagship compile+measure "
+          f"{time.perf_counter() - t_c:.1f}s", file=sys.stderr)
+    gps = 512 / step_s
+    result["value"] = round(gps, 2)
+    # a CPU-fallback run must not be ratioed against the TPU baseline
+    result["vs_baseline"] = round(_baseline_ratio(gps) if on_tpu else 1.0, 4)
+    result["step_ms"] = round(step_s * 1e3, 3)
+    emit()
+
+    if "roofline" in phases:
+        try:
+            result["roofline"] = _roofline(step, state, batch, step_s)
+            result["membw_probe_gbps"] = _membw_probe()
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: roofline failed: {e!r}", file=sys.stderr)
+
+    if "sustained" in phases:
+        try:
+            t0 = time.perf_counter()
+            result["sustained"] = _sustained(samples, heads)
+            print(f"bench: sustained {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: sustained failed: {e!r}", file=sys.stderr)
+
+    if "dense" in phases:
+        try:
+            t0 = time.perf_counter()
+            dstate, dbatch, dstep, dcfg, _s, _h = _build(
+                hidden=256, dtype="bfloat16")
+            dstep_s, dstate = _chip_loop(
+                dstate, dbatch, dstep, max(n_iters // 4, 2), n_repeats)
+            dres = {"config": "SchNet hidden=256 bf16 batch=512",
+                    "graphs_per_sec": round(512 / dstep_s, 1),
+                    "step_ms": round(dstep_s * 1e3, 3)}
+            dres.update(_roofline(dstep, dstate, dbatch, dstep_s))
+            result["dense"] = dres
+            print(f"bench: dense {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: dense failed: {e!r}", file=sys.stderr)
+
+    if "archs" in phases:
+        sweep = {}
+        for arch in ARCHS:
+            try:
+                t0 = time.perf_counter()
+                astate, abatch, astep, acfg, _s, _h = _build(
+                    model_type=arch, dtype=dtype)
+                astep_s, astate = _chip_loop(
+                    astate, abatch, astep, max(n_iters // 4, 2),
+                    max(n_repeats - 1, 1))
+                sweep[arch] = {
+                    "graphs_per_sec": round(512 / astep_s, 1),
+                    "step_ms": round(astep_s * 1e3, 3),
+                }
+                print(f"bench: arch {arch} {512 / astep_s:,.0f} g/s "
+                      f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                sweep[arch] = {"error": repr(e)[:160]}
+                print(f"bench: arch {arch} failed: {e!r}", file=sys.stderr)
+            result["archs"] = dict(sweep)
+            emit()
+
+
+# ---------------------------------------------------------------------------
+# parent
+# ---------------------------------------------------------------------------
 
 
 def _try_child(platform: str, timeout: float):
@@ -205,6 +485,7 @@ def _try_child(platform: str, timeout: float):
     else:
         # let the pre-registered TPU plugin claim the backend
         env.pop("JAX_PLATFORMS", None)
+
     def parse(stdout):
         for line in reversed((stdout or "").strip().splitlines()):
             try:
@@ -220,19 +501,20 @@ def _try_child(platform: str, timeout: float):
             [sys.executable, os.path.abspath(__file__), "--child", platform],
             env=env, capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired as e:
-        print(f"bench: {platform} attempt timed out after {timeout:.0f}s "
-              "(backend init hang?)", file=sys.stderr)
-        # the child prints the measured line before any best-effort extras,
-        # so a timeout may still leave a finished measurement in stdout
+        print(f"bench: {platform} attempt timed out after {timeout:.0f}s",
+              file=sys.stderr)
+        # the child prints a finished line after every phase, so a timeout
+        # mid-phase still leaves the most complete measurement in stdout
         out = e.stdout
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
         return parse(out)
     if p.stderr:
-        sys.stderr.write(p.stderr[-2000:])
+        sys.stderr.write(p.stderr[-4000:])
     if p.returncode != 0:
         print(f"bench: {platform} attempt rc={p.returncode}", file=sys.stderr)
-        return None
+        # a crash mid-phase may still follow completed emits
+        return parse(p.stdout)
     got = parse(p.stdout)
     if got is None:
         print(f"bench: {platform} attempt printed no JSON line",
@@ -242,7 +524,7 @@ def _try_child(platform: str, timeout: float):
 
 def main() -> None:
     want = os.getenv("HYDRAGNN_BENCH_PLATFORM", "auto").lower()
-    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "420"))
+    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "1800"))
     attempts = []
     if want in ("auto", "tpu"):
         attempts += [("tpu", tpu_timeout), ("tpu", tpu_timeout)]
@@ -250,7 +532,7 @@ def main() -> None:
         attempts += [("cpu", 1200.0)]
     for platform, timeout in attempts:
         result = _try_child(platform, timeout)
-        if result is not None:
+        if result is not None and result.get("value"):
             print(json.dumps(result))
             return
     # total failure: still emit a parseable line with diagnostics

@@ -108,16 +108,56 @@ class DistDataset(AbstractBaseDataset):
         n = self.lib.dstore_get_local(
             self.store, _KEY, gidx, self._buf, self._max_bytes)
         if n < 0:
-            owner = self._owner(gidx)
+            n = self._fetch_remote(gidx)
+        return pickle.loads(self._buf.raw[:n])
+
+    def _fetch_remote(self, gidx: int) -> int:
+        """Remote get with bounded failure handling: connect/read timeouts
+        (HYDRASTORE_TIMEOUT_MS, default 10 s) plus one reconnect retry — a
+        server that bounced between requests looks like a poisoned cached
+        connection.  A peer that is genuinely dead raises within ~2 timeouts
+        instead of hanging the training loop (round-3 VERDICT item 9)."""
+        import os
+
+        owner = self._owner(gidx)
+        ip, port = self.addresses[owner]
+        try:
+            timeout_ms = int(os.getenv("HYDRASTORE_TIMEOUT_MS", "10000"))
+        except ValueError:
+            timeout_ms = 10000  # same malformed-env fallback as the C layer
+        if timeout_ms <= 0:
+            timeout_ms = 10000
+        last = None
+        for attempt in range(2):
             fd = self._conns.get(owner)
             if fd is None:
-                ip, port = self.addresses[owner]
-                fd = self.lib.dstore_connect(ip.encode(), port)
-                assert fd >= 0, f"cannot reach dstore owner {owner} at {ip}:{port}"
+                fd = self.lib.dstore_connect_timeout(
+                    ip.encode(), port, timeout_ms)
+                if fd < 0:
+                    last = "connect timeout/refused"
+                    continue
                 self._conns[owner] = fd
-            n = self.lib.dstore_fetch(fd, _KEY, gidx, self._buf, self._max_bytes)
-            assert n > 0, f"remote get failed for sample {gidx}"
-        return pickle.loads(self._buf.raw[:n])
+            n = self.lib.dstore_fetch(
+                fd, _KEY, gidx, self._buf, self._max_bytes)
+            if n > 0:
+                return n
+            # -3: I/O failure (peer death / timeout) poisons the stream;
+            # -1/-2 are protocol-level and a retry cannot help
+            self.lib.dstore_disconnect(fd)
+            self._conns.pop(owner, None)
+            if n == -1:
+                raise RuntimeError(
+                    f"dstore owner {owner} ({ip}:{port}) does not hold "
+                    f"sample {gidx} — inconsistent shard layout")
+            if n == -2:
+                raise RuntimeError(
+                    f"sample {gidx} exceeds receive buffer "
+                    f"({self._max_bytes} B)")
+            last = "peer died or timed out mid-fetch"
+        raise RuntimeError(
+            f"remote get of sample {gidx} from dstore owner {owner} "
+            f"({ip}:{port}) failed after retry: {last} "
+            f"(timeout {timeout_ms} ms)")
 
     def close(self):
         for fd in self._conns.values():

@@ -73,6 +73,9 @@ def load_library() -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int64]
     lib.dstore_connect.restype = ctypes.c_int
     lib.dstore_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dstore_connect_timeout.restype = ctypes.c_int
+    lib.dstore_connect_timeout.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.dstore_fetch.restype = ctypes.c_int64
     lib.dstore_fetch.argtypes = [
         ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,

@@ -60,15 +60,36 @@ def setup_distributed() -> Tuple[int, int]:
             os.getenv("SLURM_PROCID", os.getenv("JAX_PROCESS_ID", "0")),
         )
     )
-    if size > 1 and jax.process_count() == 1:
+    if size > 1 and not _distributed_initialized():
+        # jax.distributed.initialize must run before ANYTHING touches the
+        # XLA backend — including jax.process_count(), which is why the
+        # already-initialized probe below reads the distributed global
+        # state instead of asking the backend
         coordinator = os.getenv("HYDRAGNN_MASTER_ADDR", "127.0.0.1")
         port = os.getenv("HYDRAGNN_MASTER_PORT", "8889")
-        jax.distributed.initialize(
-            coordinator_address=f"{coordinator}:{port}",
-            num_processes=size,
-            process_id=rank,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=f"{coordinator}:{port}",
+                num_processes=size,
+                process_id=rank,
+            )
+        except RuntimeError as e:
+            # the already-initialized probe reads a private API; if that API
+            # moves, double-init must stay a no-op rather than a crash
+            if "already" not in str(e).lower():
+                raise
     return jax.process_count(), jax.process_index()
+
+
+def _distributed_initialized() -> bool:
+    """Whether jax.distributed.initialize has already run, WITHOUT
+    initializing the XLA backend as jax.process_count() would."""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — private API moved; fall back safe
+        return False
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
@@ -170,19 +191,28 @@ def mesh_process_count(mesh: Mesh) -> int:
 
 
 def global_batch(stacked: GraphBatch, mesh: Mesh,
-                 axis=None) -> GraphBatch:
+                 axis=None, scan: bool = False) -> GraphBatch:
     """Assemble a host-local device-stacked batch [d_local, ...] into a global
     array [d_global, ...] sharded along ``axis`` (the multi-host analog of
     DDP's per-rank batches; one jit sees the whole global batch).  Works for
     group meshes spanning a subset of processes: the global shape covers only
-    the mesh's processes."""
+    the mesh's processes.
+
+    ``scan=True`` handles scan-chunked superbatches [K, d_local, ...]: the
+    leading K (steps-per-dispatch) axis stays replicated, the device axis
+    behind it is sharded — global shape [K, d_global, ...], spec
+    P(None, axes)."""
     n_proc = mesh_process_count(mesh)
     axes = mesh_dp_axes(mesh) if axis is None else axis
 
     def conv(x):
         x = np.asarray(x)
-        sharding = NamedSharding(mesh, P(axes))
-        global_shape = (x.shape[0] * n_proc,) + x.shape[1:]
+        if scan:
+            sharding = NamedSharding(mesh, P(None, axes))
+            global_shape = (x.shape[0], x.shape[1] * n_proc) + x.shape[2:]
+        else:
+            sharding = NamedSharding(mesh, P(axes))
+            global_shape = (x.shape[0] * n_proc,) + x.shape[1:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree.map(conv, stacked)
@@ -430,11 +460,12 @@ class GlobalBatchLoader:
     process must iterate in lockstep (per-rank batch counts are equalized by
     the loaders' wrap-padding)."""
 
-    def __init__(self, loader, mesh: Mesh, axis=None):
+    def __init__(self, loader, mesh: Mesh, axis=None, scan: bool = False):
         self.loader = loader
         self.mesh = mesh
         # None -> all the mesh's axes (works for 1-axis and multi-slice)
         self.axis = mesh_dp_axes(mesh) if axis is None else axis
+        self.scan = scan  # loader yields [K, d_local, ...] superbatches
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
@@ -444,4 +475,4 @@ class GlobalBatchLoader:
 
     def __iter__(self):
         for stacked in self.loader:
-            yield global_batch(stacked, self.mesh, self.axis)
+            yield global_batch(stacked, self.mesh, self.axis, scan=self.scan)

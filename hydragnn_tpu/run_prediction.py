@@ -41,6 +41,12 @@ def _(config_file: str, **kwargs):
 def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
 
+    # same launcher-env bootstrap as run_training (no-op when already
+    # initialized or single-process)
+    from hydragnn_tpu.parallel.mesh import setup_distributed
+
+    setup_distributed()
+
     from hydragnn_tpu.parallel.comm import num_processes, process_index
     import jax
 

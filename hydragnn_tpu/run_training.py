@@ -43,6 +43,15 @@ def _(config_file: str, **kwargs):
 def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
 
+    # Multi-host bootstrap happens HERE, not in user glue: under mpirun/srun
+    # (OMPI_COMM_WORLD_*/SLURM_*/JAX_NUM_PROCESSES env) this initializes
+    # jax.distributed; single-process runs and already-initialized runtimes
+    # pass straight through (parity: reference setup_ddp is called inside
+    # its run_training, hydragnn/run_training.py:77).
+    from hydragnn_tpu.parallel.mesh import setup_distributed
+
+    setup_distributed()
+
     from hydragnn_tpu.parallel.comm import num_processes, process_index
 
     world_size, rank = num_processes(), process_index()

@@ -544,11 +544,13 @@ def train_validate_test(
         else:
             state = replicate_state(state, mesh)
         single_proc = mesh_process_count(mesh) == 1
-        # scan chunking: only single-process (multi-host batch assembly goes
-        # through GlobalBatchLoader, which feeds one step per dispatch)
-        steps_per_dispatch = (
-            env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1) if single_proc else 1)
-        steps_per_dispatch = max(1, steps_per_dispatch)
+        # scan chunking works on the multi-host path too: every process
+        # assembles [K, d_local, ...] superbatches that GlobalBatchLoader
+        # turns into [K, d_global, ...] (spec P(None, dp)) for the scanned
+        # step — K steps of cross-host psum per dispatch, amortizing the
+        # per-dispatch host latency that multi-host runs otherwise pay
+        # per step (docs/SCALING.md "Dispatch overhead")
+        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1))
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
             zero_specs=zero_specs, steps=steps_per_dispatch)
@@ -566,7 +568,8 @@ def train_validate_test(
             train_loader = DeviceStackLoader(
                 train_loader, steps_per_dispatch, drop_last=True)
         if not single_proc:
-            train_loader = GlobalBatchLoader(train_loader, mesh)
+            train_loader = GlobalBatchLoader(
+                train_loader, mesh, scan=steps_per_dispatch > 1)
             val_loader = GlobalBatchLoader(val_loader, mesh)
             test_loader = GlobalBatchLoader(test_loader, mesh)
         else:
@@ -674,7 +677,7 @@ def train_validate_test(
     profiler = Profiler(profile_config, log_name, logs_dir)
 
     history: Dict[str, List[float]] = {
-        "train": [], "val": [], "test": [], "lr": []}
+        "train": [], "val": [], "test": [], "lr": [], "epoch_time": []}
     lr = get_learning_rate(state.opt_state)
 
     for epoch in range(num_epoch):
@@ -714,6 +717,9 @@ def train_validate_test(
         history["val"].append(val_loss)
         history["test"].append(test_loss)
         history["lr"].append(lr)
+        # wall time per epoch (train + val/test + host bookkeeping): the
+        # sustained-throughput evidence bench.py reports comes from here
+        history["epoch_time"].append(time.time() - t0)
 
         if writer is not None and rank == 0:
             writer.add_scalar("train error", train_loss, epoch)

@@ -1,0 +1,136 @@
+"""Fusion-boundary HBM byte accounting from optimized HLO text.
+
+Round-2 VERDICT flagged the bench roofline as self-contradicting: XLA's
+``cost_analysis()["bytes accessed"]`` is *fusion-blind* — it sums the
+per-primitive traffic of every op as if each ran alone, so a compiled
+program that keeps intermediates inside fusions gets billed for bytes it
+never moves (the r02 artifact implied 1.9x the v5e's HBM spec).
+
+The honest structural model for XLA:TPU is the **fusion boundary**: each
+top-level instruction of the optimized entry computation (fusion,
+custom-call, dot, copy, ...) streams its operands from HBM and writes its
+outputs back — VMEM does not persist between kernels.  So
+
+    bytes/step = sum over entry instructions of (operand bytes + output bytes)
+
+computed on ``jit(f).lower(...).compile().as_text()`` — the exact program
+being timed.  Re-reads are counted once per consumer (each kernel really
+does re-read), free ops (parameter/constant/tuple plumbing/bitcast) are
+skipped, and Pallas custom calls are counted by their operand/result
+shapes, which is precisely the traffic the kernel performs (each operand
+is streamed once).
+
+This is a *diagnostic estimate*, not a hardware counter, with two known
+biases on scheduled TPU HLO: (a) buffers placed in non-default memory
+spaces (``S(1)`` VMEM / ``S(2)`` SMEM annotations in the layout) never
+touch HBM — they are skipped; (b) async DMA bookkeeping pairs
+(``*-start``/``*-done``/``*-update``) alias their operands and would be
+double-billed — they are skipped too, which UNDERcounts the sliced
+prefetch reads they perform.  An operand shared by several consumers is
+billed once per consumer (each kernel really does re-read it), which can
+OVERcount when the scheduler keeps it resident.  ``bench.py``'s headline
+roofline therefore uses the buffer-assignment method
+(``compiled.memory_analysis()``: args + outputs + 2*temps) and keeps this
+module for per-instruction attribution when a program's traffic needs to
+be understood op by op.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "s4": 1, "s8": 1, "u2": 1, "u4": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3": 1, "f8e5m2fnuz": 1,
+    "token": 0, "opaque": 0,
+}
+
+# one array shape: dtype[d0,d1,...]{layout}  (layout optional, dims optional)
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\](\{[^}]*\})?")
+
+# an entry-computation instruction:  %name = SHAPE op-name(...)
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.+?)\s+([\w\-]+)\(")
+
+# ops that move no HBM bytes of their own
+_FREE_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "add-dependency", "partition-id", "replica-id", "iota",
+}
+
+
+def shape_bytes(shape_text: str) -> int:
+    """Total HBM bytes of one (possibly tuple) shape string.  Components
+    whose layout carries a non-default memory space (``S(1)`` VMEM,
+    ``S(2)`` SMEM, ...) never touch HBM and count zero."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_text):
+        dtype, dims, layout = m.groups()
+        if dtype not in _DTYPE_BYTES:
+            continue  # e.g. stray words that look shape-like
+        if layout and "S(" in layout:
+            continue  # VMEM/SMEM-resident buffer
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def entry_fusion_boundary_bytes(hlo_text: str) -> Tuple[int, Dict[str, int]]:
+    """(total bytes, per-instruction bytes) across the ENTRY computation.
+
+    Parses the optimized HLO module text; for every non-free instruction in
+    the entry computation sums output bytes plus the bytes of each operand
+    (looked up from the operand's definition in the same computation).
+    """
+    # isolate the ENTRY computation body
+    m = re.search(r"^ENTRY [^\n]*\{\s*$", hlo_text, re.M)
+    if m is None:
+        raise ValueError("no ENTRY computation found in HLO text")
+    body_lines = []
+    for line in hlo_text[m.end():].splitlines():
+        if line.strip() == "}":
+            break
+        body_lines.append(line)
+
+    defs: Dict[str, Tuple[str, str]] = {}  # name -> (shape text, op)
+    parsed = []
+    for line in body_lines:
+        im = _INSTR_RE.match(line)
+        if not im:
+            continue
+        name, shape_text, op = im.groups()
+        defs[name] = (shape_text, op)
+        # operand names: everything inside the top-level call parens
+        paren = line[line.index("(", im.end(3) - 1):]
+        operands = re.findall(r"%([\w.\-]+)", paren)
+        parsed.append((name, shape_text, op, operands))
+
+    per_instr: Dict[str, int] = {}
+    total = 0
+    for name, shape_text, op, operands in parsed:
+        if op in _FREE_OPS:
+            continue
+        # async DMA bookkeeping aliases its operand; billing both halves
+        # double-counts (see module docstring)
+        if op.endswith(("-start", "-done", "-update")):
+            continue
+        b = shape_bytes(shape_text)
+        for o in operands:
+            d = defs.get(o)
+            if d is not None:
+                b += shape_bytes(d[0])
+        per_instr[name] = b
+        total += b
+    return total, per_instr
+
+
+def compiled_fusion_boundary_bytes(compiled) -> Tuple[int, Dict[str, int]]:
+    """Convenience wrapper over a ``jax`` compiled object."""
+    return entry_fusion_boundary_bytes(compiled.as_text())

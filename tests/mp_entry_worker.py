@@ -1,0 +1,70 @@
+"""Worker proving the JSON-config entry is multi-host-launchable AS
+DOCUMENTED (docs/SCALING.md): no jax.distributed glue here — only the
+launcher-style env (JAX_NUM_PROCESSES/JAX_PROCESS_ID, the same role
+OMPI_COMM_WORLD_*/SLURM_* play under mpirun/srun).  ``run_training`` itself
+must call setup_distributed() (parity: reference run_training calls
+setup_ddp internally, hydragnn/run_training.py:77)."""
+
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+world = int(sys.argv[2])
+port = sys.argv[3]
+scratch = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# launcher env only — the entry point must bootstrap from these
+os.environ["JAX_NUM_PROCESSES"] = str(world)
+os.environ["JAX_PROCESS_ID"] = str(rank)
+os.environ["HYDRAGNN_MASTER_PORT"] = port
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# NOTE: no backend-touching call may happen before run_training —
+# jax.distributed.initialize must precede any XLA backend init
+
+tests_dir = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(tests_dir))
+sys.path.insert(0, tests_dir)
+os.chdir(scratch)
+os.environ["SERIALIZED_DATA_PATH"] = scratch
+
+import numpy as np  # noqa: E402
+
+import hydragnn_tpu  # noqa: E402
+
+with open(os.path.join(tests_dir, "inputs", "ci.json")) as f:
+    config = json.load(f)
+config["NeuralNetwork"]["Architecture"]["model_type"] = "GIN"
+config["NeuralNetwork"]["Training"]["num_epoch"] = 4
+config["Verbosity"]["level"] = 0
+
+if rank == 0:
+    from ci_data import generate_cached
+
+    for name, path in config["Dataset"]["path"].items():
+        generate_cached(name, path, 120 if name == "train" else 30)
+    # data-ready marker: the barrier below needs the distributed runtime,
+    # which run_training hasn't set up yet — use the filesystem
+    open(os.path.join(scratch, ".data_ready"), "w").close()
+else:
+    import time
+
+    while not os.path.exists(os.path.join(scratch, ".data_ready")):
+        time.sleep(0.1)
+
+state, history, fconfig = hydragnn_tpu.run_training(config)
+
+assert jax.process_count() == world, "run_training did not bootstrap"
+
+import hashlib  # noqa: E402
+
+h = hashlib.sha256()
+for leaf in jax.tree.leaves(jax.device_get(state.params)):
+    h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+
+print(f"MPRESULT rank={rank} val={history['val'][-1]:.8f} "
+      f"params={h.hexdigest()[:16]}")

@@ -111,3 +111,101 @@ def test_dstore_tcp_remote_get():
     assert lib.dstore_fetch(fd, b"k", 99, buf, len(buf)) == -1
     lib.dstore_disconnect(fd)
     lib.dstore_destroy(store)
+
+
+def test_dstore_connect_timeout_unreachable():
+    """Connecting to a non-listening port fails fast, not forever."""
+    import time
+
+    lib = load_library()
+    # grab a port nobody listens on
+    import socket as pysock
+
+    s = pysock.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    t0 = time.perf_counter()
+    fd = lib.dstore_connect_timeout(b"127.0.0.1", dead_port, 1000)
+    dt = time.perf_counter() - t0
+    assert fd < 0
+    assert dt < 5.0  # refused or timed out well within bounds
+
+
+def test_dstore_kill_a_peer(tmp_path):
+    """A server killed mid-conversation surfaces as a bounded error on the
+    client, not a hang or short-read garbage (round-3 VERDICT item 9)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    server_src = tmp_path / "server.py"
+    server_src.write_text(
+        "import ctypes, pickle, sys, time\n"
+        "import numpy as np\n"
+        "from hydragnn_tpu.native import load_library\n"
+        "lib = load_library()\n"
+        "store = lib.dstore_create(0)\n"
+        "blob = pickle.dumps(np.arange(32))\n"
+        "sizes = np.asarray([len(blob)], np.int64)\n"
+        "lib.dstore_add(store, b'k', blob,\n"
+        "    sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), 1, 0)\n"
+        "print(lib.dstore_port(store), flush=True)\n"
+        "time.sleep(600)\n")
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(server_src)], stdout=subprocess.PIPE, text=True,
+        env=env, cwd=repo)
+    try:
+        port = int(proc.stdout.readline())
+        lib = load_library()
+        fd = lib.dstore_connect_timeout(b"127.0.0.1", port, 2000)
+        assert fd >= 0
+        buf = ctypes.create_string_buffer(1 << 12)
+        n = lib.dstore_fetch(fd, b"k", 0, buf, len(buf))
+        assert n > 0  # healthy fetch first
+
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        t0 = time.perf_counter()
+        n = lib.dstore_fetch(fd, b"k", 0, buf, len(buf))
+        dt = time.perf_counter() - t0
+        assert n == -3, f"expected I/O failure code, got {n}"
+        assert dt < 10.0
+        lib.dstore_disconnect(fd)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_distdataset_dead_owner_raises(monkeypatch):
+    """The Python wrapper turns a dead owner into a RuntimeError naming the
+    peer, after one reconnect attempt — no silent hang, no assert."""
+    import socket as pysock
+
+    from hydragnn_tpu.data.distdataset import DistDataset
+
+    monkeypatch.setenv("HYDRASTORE_TIMEOUT_MS", "800")
+    ds = DistDataset(_samples(4), label="deadpeer")
+    try:
+        # forge a second, dead owner holding global indices 4..7
+        s = pysock.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        ds.counts = [4, 4]
+        ds.total = 8
+        ds.addresses = list(ds.addresses) + [("127.0.0.1", dead_port)]
+
+        with pytest.raises(RuntimeError, match="dstore owner 1"):
+            ds.get(6)
+    finally:
+        ds.close()

@@ -1,0 +1,269 @@
+"""Port a reference (torch/PyG) HydraGNN checkpoint into hydragnn_tpu flax
+variables.
+
+The reference saves ``torch.save({"model_state_dict": ...}, <log>/<name>.pk)``
+(reference hydragnn/utils/model.py:58-79).  This tool maps that state_dict
+onto the flax variable tree produced by ``init_model`` — the executable form
+of the translation table in docs/WEIGHTS.md.
+
+Conventions handled (docs/WEIGHTS.md "Conventions"):
+  * Linear: torch ``weight [out, in]`` -> flax ``kernel [in, out]`` (transpose)
+  * PyG ``Sequential`` wrappers name children ``module_{i}`` — all conv
+    lookups match by *suffix* under the ``graph_convs.{i}.`` prefix, so the
+    wrapper depth never matters
+  * BatchNorm (PyG wraps torch BatchNorm1d as ``.module``):
+    weight/bias -> params ``encoder_bn_{i}/{scale,bias}``,
+    running_mean/var -> ``batch_stats`` ``{mean,var}``
+  * heads: ``graph_shared.{2j}`` -> ``graph_shared/dense_{j}``,
+    ``heads_NN.{k}.{2j}`` -> ``head_{k}/dense_{j}`` (activations sit at odd
+    Sequential slots, reference Base.py:200-240), node-MLP heads
+    ``heads_NN.{k}.mlp.0.{2j}`` -> ``head_{k}/MLP_0/dense_{j}``; per-node
+    variants stack ``mlp.{n}`` over n into the ``w_{j}/b_{j}`` banks
+
+Per-arch conv mappings: see ``_CONV_PORTERS`` (SAGE, GIN, SchNet, PNA,
+CGCNN).  Remaining stacks raise NotImplementedError with the table of what
+is supported.
+
+Usage:
+    from tools.port_weights import port_checkpoint, port_state_dict
+    variables = port_state_dict(sd, "SchNet", variables_template)
+    # or straight from the reference's .pk file:
+    variables = port_checkpoint("logs/qm9/qm9.pk", "SchNet", variables_template)
+
+Forward parity against reference activations: tests/test_weight_port.py
+builds plain-torch twins keyed exactly like reference checkpoints and
+asserts prediction agreement to 1e-4.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Mapping
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+class _Scope:
+    """Suffix lookup inside one torch key prefix (e.g. graph_convs.3.)."""
+
+    def __init__(self, sd: Mapping[str, Any], prefix: str):
+        self.prefix = prefix
+        self.keys = [k for k in sd if k.startswith(prefix)]
+        self.sd = sd
+
+    def get(self, suffix: str) -> np.ndarray:
+        hits = [k for k in self.keys if k.endswith(suffix)]
+        if len(hits) != 1:
+            raise KeyError(
+                f"expected exactly one key '{self.prefix}*{suffix}', "
+                f"found {hits or 'none'} among {self.keys}")
+        return _np(self.sd[hits[0]])
+
+    def kernel(self, stem: str) -> np.ndarray:
+        return self.get(f"{stem}.weight").T  # [out,in] -> [in,out]
+
+    def bias(self, stem: str) -> np.ndarray:
+        return self.get(f"{stem}.bias")
+
+    def linear(self, stem: str, bias: bool = True) -> Dict[str, np.ndarray]:
+        out = {"kernel": self.kernel(stem)}
+        if bias:
+            out["bias"] = self.bias(stem)
+        return out
+
+
+# --- per-arch conv porters: _Scope(graph_convs.{i}.) -> flax conv params ---
+
+
+def _port_sage(s: _Scope) -> Dict[str, Any]:
+    # PyG SAGEConv: lin_l acts on the aggregated neighbors (bias carrier),
+    # lin_r on the root.  Ours puts the single bias on lin_self — the sum
+    # is identical (docs/WEIGHTS.md SAGE row).
+    return {
+        "lin_neigh": {"kernel": s.kernel("lin_l")},
+        "lin_self": {"kernel": s.kernel("lin_r"), "bias": s.bias("lin_l")},
+    }
+
+
+def _port_gin(s: _Scope) -> Dict[str, Any]:
+    return {
+        "eps": s.get("eps").reshape(()),
+        "mlp_0": s.linear("nn.0"),
+        "mlp_1": s.linear("nn.2"),
+    }
+
+
+def _port_schnet(s: _Scope) -> Dict[str, Any]:
+    out = {
+        "filter_0": s.linear("nn.0"),
+        "filter_1": s.linear("nn.2"),
+        "lin1": {"kernel": s.kernel("lin1")},  # bias=False (SCFStack.py:154)
+        "lin2": s.linear("lin2"),
+    }
+    if any(".coord_mlp." in k for k in s.keys):
+        out["coord_mlp_0"] = s.linear("coord_mlp.0")
+        out["coord_mlp_1"] = {"kernel": s.kernel("coord_mlp.2")}
+    return out
+
+
+def _port_pna(s: _Scope) -> Dict[str, Any]:
+    # towers=1, pre_layers=post_layers=1 (reference PNAStack.py:41-50)
+    out = {
+        "pre_nn": s.linear("pre_nns.0.0"),
+        "post_nn": s.linear("post_nns.0.0"),
+        "lin_out": s.linear("lin"),
+    }
+    if any("edge_encoder" in k for k in s.keys):
+        out["edge_encoder"] = s.linear("edge_encoder")
+    return out
+
+
+def _port_cgcnn(s: _Scope) -> Dict[str, Any]:
+    return {"lin_f": s.linear("lin_f"), "lin_s": s.linear("lin_s")}
+
+
+_CONV_PORTERS: Dict[str, Callable[[_Scope], Dict[str, Any]]] = {
+    "SAGE": _port_sage,
+    "GIN": _port_gin,
+    "SchNet": _port_schnet,
+    "PNA": _port_pna,
+    "CGCNN": _port_cgcnn,
+}
+
+
+def _port_mlp(sd: Mapping[str, Any], prefix: str, template: Mapping[str, Any],
+              seq_stride: int = 2) -> Dict[str, Any]:
+    """Reference Sequential [Linear, act]* -> flax MLP {dense_j}: the j-th
+    Linear sits at Sequential slot ``seq_stride * j`` (activations at odd
+    slots; reference Base.py:200-240)."""
+    out = {}
+    for name in template:
+        m = re.fullmatch(r"dense_(\d+)", str(name))
+        if not m:
+            raise KeyError(f"unexpected head sublayer {name} under {prefix}")
+        j = int(m.group(1))
+        out[str(name)] = {
+            "kernel": _np(sd[f"{prefix}{seq_stride * j}.weight"]).T,
+            "bias": _np(sd[f"{prefix}{seq_stride * j}.bias"]),
+        }
+    return out
+
+
+def _port_node_mlp_head(sd, k: int, template) -> Dict[str, Any]:
+    """MLPNode: shared ('MLP_0/dense_j') or per-node banks ('w_j'/'b_j')."""
+    if "MLP_0" in template:
+        return {"MLP_0": _port_mlp(sd, f"heads_NN.{k}.mlp.0.",
+                                   template["MLP_0"])}
+    # per-node banks: stack heads_NN.{k}.mlp.{n}.{2j}.* over n
+    out: Dict[str, Any] = {}
+    for name, leaf in template.items():
+        m = re.fullmatch(r"([wb])_(\d+)", str(name))
+        if not m:
+            raise KeyError(f"unexpected per-node head param {name}")
+        kind, j = m.group(1), int(m.group(2))
+        n_nodes = np.asarray(leaf).shape[0]
+        suffix = "weight" if kind == "w" else "bias"
+        banks = []
+        for n in range(n_nodes):
+            t = _np(sd[f"heads_NN.{k}.mlp.{n}.{2 * j}.{suffix}"])
+            banks.append(t.T if kind == "w" else t)
+        out[name] = np.stack(banks)
+    return out
+
+
+def port_state_dict(sd: Mapping[str, Any], model_type: str,
+                    variables_template: Mapping[str, Any]) -> Dict[str, Any]:
+    """Map a reference ``model_state_dict`` onto a flax variable tree.
+
+    ``variables_template`` is the output of ``init_model`` for the matching
+    config: its structure names every parameter that must be filled, so an
+    unmapped leaf is an error, not a silent drift.
+    """
+    if model_type not in _CONV_PORTERS:
+        raise NotImplementedError(
+            f"weight porting implemented for {sorted(_CONV_PORTERS)}; "
+            f"got {model_type}")
+    sd = {k.removeprefix("module."): v for k, v in sd.items()}
+
+    params_t = variables_template["params"]
+    new_params: Dict[str, Any] = {}
+    new_stats: Dict[str, Any] = {}
+    porter = _CONV_PORTERS[model_type]
+
+    for scope, sub in params_t.items():
+        scope = str(scope)
+        if scope.startswith("encoder_conv_"):
+            i = int(scope.split("_")[-1])
+            got = porter(_Scope(sd, f"graph_convs.{i}."))
+            _check_match(scope, sub, got)
+            new_params[scope] = got
+        elif scope.startswith("encoder_bn_"):
+            i = int(scope.split("_")[-1])
+            s = _Scope(sd, f"feature_layers.{i}.")
+            new_params[scope] = {
+                "scale": s.get("module.weight"),
+                "bias": s.get("module.bias"),
+            }
+            new_stats[scope] = {
+                "mean": s.get("running_mean"),
+                "var": s.get("running_var"),
+            }
+        elif scope == "graph_shared":
+            new_params[scope] = _port_mlp(sd, "graph_shared.", sub)
+        elif scope.startswith("head_"):
+            k = int(scope.split("_")[1])
+            if "MLP_0" in sub or any(
+                    re.fullmatch(r"[wb]_\d+", str(n)) for n in sub):
+                new_params[scope] = _port_node_mlp_head(sd, k, sub)
+            else:
+                new_params[scope] = _port_mlp(sd, f"heads_NN.{k}.", sub)
+        else:
+            raise NotImplementedError(
+                f"no torch mapping for flax scope '{scope}' "
+                f"(conv-type node heads are not portable yet)")
+
+    out: Dict[str, Any] = {"params": _shape_like(params_t, new_params)}
+    if "batch_stats" in variables_template:
+        out["batch_stats"] = _shape_like(
+            variables_template["batch_stats"], new_stats)
+    return out
+
+
+def port_checkpoint(path: str, model_type: str,
+                    variables_template: Mapping[str, Any]) -> Dict[str, Any]:
+    """Load a reference ``<name>.pk`` checkpoint file and port it."""
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    sd = ckpt.get("model_state_dict", ckpt)
+    return port_state_dict(sd, model_type, variables_template)
+
+
+def _check_match(scope, template, got) -> None:
+    if set(map(str, template)) != set(map(str, got)):
+        raise KeyError(
+            f"{scope}: mapped params {sorted(map(str, got))} != template "
+            f"{sorted(map(str, template))}")
+
+
+def _shape_like(template, built):
+    """Validate shapes leaf-by-leaf and cast to each template leaf's dtype."""
+    import jax
+
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    b_leaves = treedef.flatten_up_to(built)
+    out = []
+    for t, b in zip(t_leaves, b_leaves):
+        b = np.asarray(b)
+        if tuple(b.shape) != tuple(np.shape(t)):
+            raise ValueError(
+                f"shape mismatch: ported {b.shape} vs template "
+                f"{np.shape(t)}")
+        out.append(b.astype(np.asarray(t).dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
