@@ -350,10 +350,12 @@ def _sustained(samples, heads):
         "graphs_per_sec": round(n_used / med, 1),
         "epoch_time_s": [round(t, 3) for t in history["epoch_time"]],
         "graphs_per_epoch": n_used,
-        "knobs": {
+        "knobs": {  # ACTUAL env at measurement time (user env wins over
+                    # the setdefaults above) — honest provenance
             "HYDRAGNN_STEPS_PER_DISPATCH": spd,
-            "HYDRAGNN_RESIDENT_DATASET": 1,
-            "HYDRAGNN_VALTEST": 0,
+            "HYDRAGNN_RESIDENT_DATASET":
+                os.environ.get("HYDRAGNN_RESIDENT_DATASET"),
+            "HYDRAGNN_VALTEST": os.environ.get("HYDRAGNN_VALTEST"),
         },
         "method": "median steady-state epoch wall time (epochs 2+; epoch 0 "
                   "pays compile + one-time device staging) of the real "

@@ -75,7 +75,12 @@ def synthesize_molecules(n_mol: int, seed: int = 0, radius: float = 2.0):
 
 
 def load_qm9_xyz(dirpath: str, radius: float = 2.0):
-    """Parse extracted QM9 .xyz files (free energy = property 14 of line 2)."""
+    """Parse extracted QM9 (gdb9) .xyz files.
+
+    Line 2 layout: ``gdb <id> A B C mu alpha homo lumo gap r2 zpve U0 U H G
+    Cv`` — free energy G is token 15, matching the reference's target
+    (PyG y[:, 10]; reference examples/qm9/qm9.py:15-22).  Coordinates may
+    carry Fortran-style ``*^`` exponents."""
     samples = []
     for fname in sorted(os.listdir(dirpath)):
         if not fname.endswith(".xyz"):
@@ -84,7 +89,7 @@ def load_qm9_xyz(dirpath: str, radius: float = 2.0):
             lines = f.read().splitlines()
         n = int(lines[0])
         props = lines[1].split()
-        free_energy = float(props[14])
+        free_energy = float(props[15])
         from hydragnn_tpu.data.raw import ATOMIC_NUMBERS
 
         zs, pos = [], []

@@ -117,22 +117,15 @@ class DistDataset(AbstractBaseDataset):
         server that bounced between requests looks like a poisoned cached
         connection.  A peer that is genuinely dead raises within ~2 timeouts
         instead of hanging the training loop (round-3 VERDICT item 9)."""
-        import os
-
         owner = self._owner(gidx)
         ip, port = self.addresses[owner]
-        try:
-            timeout_ms = int(os.getenv("HYDRASTORE_TIMEOUT_MS", "10000"))
-        except ValueError:
-            timeout_ms = 10000  # same malformed-env fallback as the C layer
-        if timeout_ms <= 0:
-            timeout_ms = 10000
         last = None
         for attempt in range(2):
             fd = self._conns.get(owner)
             if fd is None:
-                fd = self.lib.dstore_connect_timeout(
-                    ip.encode(), port, timeout_ms)
+                # dstore_connect resolves HYDRASTORE_TIMEOUT_MS in the C
+                # layer — ONE definition of the env var's parsing/clamping
+                fd = self.lib.dstore_connect(ip.encode(), port)
                 if fd < 0:
                     last = "connect timeout/refused"
                     continue
@@ -157,7 +150,7 @@ class DistDataset(AbstractBaseDataset):
         raise RuntimeError(
             f"remote get of sample {gidx} from dstore owner {owner} "
             f"({ip}:{port}) failed after retry: {last} "
-            f"(timeout {timeout_ms} ms)")
+            "(HYDRASTORE_TIMEOUT_MS bounds each attempt; default 10000)")
 
     def close(self):
         for fd in self._conns.values():

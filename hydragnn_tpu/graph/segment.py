@@ -208,3 +208,44 @@ def _bcast(mask, data):
     if mask.ndim == data.ndim:
         return mask.astype(data.dtype)
     return mask.reshape(mask.shape + (1,) * (data.ndim - mask.ndim)).astype(data.dtype)
+
+def gather_receiver_sorted(x, g):
+    """``x[receivers]`` whose BACKWARD is the dense-schedule sorted scatter
+    (receivers are nondecreasing by collate invariant) instead of XLA's
+    scatter-add — marker-gated, plain gather otherwise."""
+    if g.extras and "edge_perm_sender" in g.extras:
+        return _gather_dense_bwd(x, g.receivers, None)
+    return x[g.receivers]
+
+
+def gather_sender(x, g):
+    """``x[senders]`` whose BACKWARD rides the dense scatter through
+    collate's sender-sorted permutation — marker-gated."""
+    perm = g.extras.get("edge_perm_sender") if g.extras else None
+    if perm is not None:
+        return _gather_dense_bwd(x, g.senders, perm)
+    return x[g.senders]
+
+
+@jax.custom_vjp
+def _gather_dense_bwd(x, idx, perm):
+    return x[idx]
+
+
+def _gdb_fwd(x, idx, perm):
+    return x[idx], (idx, perm, x.shape)
+
+
+def _gdb_bwd(res, grad):
+    idx, perm, shape = res
+    from hydragnn_tpu.ops.fused_mp import segment_sum_dense
+
+    g2 = grad.reshape(grad.shape[0], -1)
+    if perm is not None:
+        out = segment_sum_dense(g2[perm], idx[perm], shape[0])
+    else:
+        out = segment_sum_dense(g2, idx, shape[0])
+    return out.reshape(shape), None, None
+
+
+_gather_dense_bwd.defvjp(_gdb_fwd, _gdb_bwd)

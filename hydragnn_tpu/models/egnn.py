@@ -59,6 +59,10 @@ class EGCL(nn.Module):
             )(c)
             c = jnp.tanh(c)  # tanh=True in reference E_GCL
             trans = jnp.clip(diff * c, -100.0, 100.0)
+            # sender-side aggregation: the XLA masked segment ops beat
+            # the sender-permuted dense kernel here (measured 43.9k vs
+            # 37.5k graphs/s on the v5e sweep config — the [E] perm
+            # gather outweighs the scatter win at EGNN's message width)
             pos = pos + segment.segment_mean(trans, src, n, g.edge_mask)
 
         agg = segment.segment_sum(m, src, n, g.edge_mask)

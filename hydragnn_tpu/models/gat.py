@@ -37,25 +37,40 @@ class GATv2Conv(nn.Module):
         h, f = self.heads, self.out_dim
         src, dst = g.senders, g.receivers
 
-        xl = nn.Dense(h * f, name="lin_l")(x).reshape(n, h, f)  # source transform
-        xr = nn.Dense(h * f, name="lin_r")(x).reshape(n, h, f)  # target transform
+        # keep node features FLAT [N, h*f]: every gather/scatter below runs
+        # on 2D operands (3D scatters lowered catastrophically on TPU —
+        # the r03 arch sweep measured 134 ms/step before this layout)
+        xl = nn.Dense(h * f, name="lin_l")(x)  # source transform
+        xr = nn.Dense(h * f, name="lin_r")(x)  # target transform
         att = self.param("att", nn.initializers.lecun_normal(), (1, h, f))
 
         def logits(s, t):
             z = nn.leaky_relu(s + t, self.negative_slope)
-            return jnp.sum(z * att, axis=-1)  # [., h]
+            return jnp.sum(z.reshape(-1, h, f) * att, axis=-1)  # [., h]
 
-        e_edge = logits(xl[src], xr[dst])  # [E, h]
+        # gathers whose backward rides the dense sorted scatter instead of
+        # XLA's scatter-add (marker-gated; plain gather otherwise)
+        e_edge = logits(segment.gather_sender(xl, g),
+                        segment.gather_receiver_sorted(xr, g))  # [E, h]
         e_self = logits(xl, xr)  # [N, h] self-loop logit per node
 
-        # softmax over {incident edges} U {self loop}, masked on padded edges
+        # softmax over {incident edges} U {self loop}, masked on padded
+        # edges.  The max subtraction is for numerical stability only —
+        # softmax is shift-invariant, so stop_gradient kills its (sort-
+        # heavy) backward without changing any derivative.
         neg = -1e9
         e_edge = jnp.where(g.edge_mask[:, None] > 0, e_edge, neg)
-        seg_max = jax.ops.segment_max(e_edge, dst, n)
-        seg_max = jnp.maximum(jnp.where(seg_max <= neg * 0.5, e_self, seg_max), e_self)
+        # plain XLA segment_max measured FASTER than both a dense-schedule
+        # Pallas max kernel (in-kernel row loop too serial: 6.5k g/s) and a
+        # segmented associative-scan max (compile blowup) — 9.3k g/s on the
+        # v5e sweep config; see docs/PERF.md "measured and rejected"
+        seg_max = segment.segment_max(e_edge, dst, n)
+        deg = segment.degree(dst, n, g.edge_mask)
+        seg_max = jnp.where(deg[:, None] > 0, seg_max, e_self)
+        seg_max = jax.lax.stop_gradient(jnp.maximum(seg_max, e_self))
         exp_edge = jnp.exp(e_edge - seg_max[dst]) * g.edge_mask[:, None]
         exp_self = jnp.exp(e_self - seg_max)
-        denom = jax.ops.segment_sum(exp_edge, dst, n) + exp_self
+        denom = segment.scatter_segment(exp_edge, g) + exp_self
         alpha_edge = exp_edge / jnp.maximum(denom, 1e-16)[dst]
         alpha_self = exp_self / jnp.maximum(denom, 1e-16)
 
@@ -74,8 +89,13 @@ class GATv2Conv(nn.Module):
                 / keep
             )
 
-        out = jax.ops.segment_sum(alpha_edge[:, :, None] * xl[src], dst, n)
-        out = out + alpha_self[:, :, None] * xl  # [N, h, f]
+        # out[n] = sum_e alpha[e] * xl[src[e]] — the gather-multiply-
+        # segment-sum core; per-head alpha broadcast across the head's f
+        # features keeps it one flat [E, h*f] weight (rides the fused
+        # Pallas kernel when the batch carries the collate marker)
+        w_alpha = jnp.repeat(alpha_edge, f, axis=1)  # [E, h*f]
+        out = segment.gather_mul_segment(xl, w_alpha, g)
+        out = out.reshape(n, h, f) + alpha_self[:, :, None] * xl.reshape(n, h, f)
 
         if self.concat:
             out = out.reshape(n, h * f)
