@@ -307,7 +307,9 @@ def _sustained(samples, heads):
         create_train_state, train_validate_test)
 
     os.environ["HYDRAGNN_VALTEST"] = "0"
-    os.environ.setdefault("HYDRAGNN_STEPS_PER_DISPATCH", "8")
+    # scan-32: at ~21 ms/dispatch tunnel latency (docs/PERF.md), 8 steps per
+    # dispatch left a 31% gap to the chip ceiling; 32 amortizes it 4x
+    os.environ.setdefault("HYDRAGNN_STEPS_PER_DISPATCH", "32")
     os.environ.setdefault("HYDRAGNN_RESIDENT_DATASET", "1")
 
     n_batches = 64
@@ -354,8 +356,9 @@ def _sustained(samples, heads):
                     # the setdefaults above) — honest provenance
             "HYDRAGNN_STEPS_PER_DISPATCH": spd,
             "HYDRAGNN_RESIDENT_DATASET":
-                os.environ.get("HYDRAGNN_RESIDENT_DATASET"),
-            "HYDRAGNN_VALTEST": os.environ.get("HYDRAGNN_VALTEST"),
+                int(os.environ.get("HYDRAGNN_RESIDENT_DATASET", "0") or 0),
+            "HYDRAGNN_VALTEST":
+                int(os.environ.get("HYDRAGNN_VALTEST", "1") or 0),
         },
         "method": "median steady-state epoch wall time (epochs 2+; epoch 0 "
                   "pays compile + one-time device staging) of the real "

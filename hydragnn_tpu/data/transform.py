@@ -121,3 +121,40 @@ def local_max_edge_length(
         if ei.shape[1]:
             m = max(m, float(edge_lengths(np.asarray(rec.pos), ei).max()))
     return m
+
+
+def check_data_samples_equivalence(s1: GraphSample, s2: GraphSample,
+                                  tol: float = 1e-6) -> bool:
+    """Whether two GraphSamples describe the same graph up to edge ORDER
+    (parity: reference check_data_samples_equivalence,
+    hydragnn/preprocess/utils.py:83-99 — used to assert that
+    rotation-normalized copies keep an equivalent edge set).
+
+    Shape-equality on x/pos/labels plus an order-independent edge-set
+    match; when both samples carry ``edge_attr``, matched edges must agree
+    within ``tol``.  Vectorized (lexicographic sort of the edge lists)
+    instead of the reference's O(E^2) scan.
+    """
+    if (np.shape(s1.x) != np.shape(s2.x)
+            or np.shape(s1.pos) != np.shape(s2.pos)
+            or np.shape(s1.graph_y) != np.shape(s2.graph_y)
+            or np.shape(s1.node_y) != np.shape(s2.node_y)):
+        return False
+    e1, e2 = np.asarray(s1.edge_index), np.asarray(s2.edge_index)
+    if e1.shape != e2.shape:
+        return False
+    o1 = np.lexsort((e1[1], e1[0]))
+    o2 = np.lexsort((e2[1], e2[0]))
+    if not np.array_equal(e1[:, o1], e2[:, o2]):
+        return False
+    a1, a2 = getattr(s1, "edge_attr", None), getattr(s2, "edge_attr", None)
+    if (a1 is None) != (a2 is None):
+        return False  # schema mismatch: only one sample carries edge_attr
+    if a1 is not None and a2 is not None:
+        a1 = np.asarray(a1)[o1]
+        a2 = np.asarray(a2)[o2]
+        if a1.shape != a2.shape:
+            return False
+        if not (np.linalg.norm(a1 - a2, axis=-1) < tol).all():
+            return False
+    return True

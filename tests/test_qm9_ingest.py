@@ -8,14 +8,19 @@ be downloaded in this environment — this fixture is two molecules written
 by hand IN the gdb9 layout (water-like and methane-like geometries), which
 validates the wiring, not chemistry."""
 
+import importlib.util
 import os
-import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples",
-    "qm9"))
+# load the example driver under a unique module name — a sys.path insert
+# would claim the generic name 'train' for the whole pytest session
+_spec = importlib.util.spec_from_file_location(
+    "qm9_example_train",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "examples", "qm9", "train.py"))
+_qm9_train = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_qm9_train)
 
 # two hand-written files in the exact gdb9 layout
 _WATER = """3
@@ -42,7 +47,7 @@ InChI=1S/CH4/h1H4\tInChI=1S/CH4/h1H4
 
 
 def test_load_qm9_xyz_gdb9_layout(tmp_path):
-    from train import load_qm9_xyz
+    load_qm9_xyz = _qm9_train.load_qm9_xyz
 
     (tmp_path / "dsgdb9nsd_000001.xyz").write_text(_WATER)
     (tmp_path / "dsgdb9nsd_000002.xyz").write_text(_METHANE)

@@ -68,3 +68,33 @@ def test_rotation_is_orthogonal():
     d0 = np.linalg.norm(pos - pos.mean(0) - (pos[:1] - pos.mean(0)), axis=1)
     d1 = np.linalg.norm(rot - rot[:1], axis=1)
     np.testing.assert_allclose(d0, d1, atol=1e-4)
+
+
+def test_check_data_samples_equivalence():
+    """Library-level sample equivalence (reference preprocess/utils.py:83-99
+    counterpart): permuted edge lists with matching attrs are equivalent;
+    attr drift beyond tol or a different edge set is not."""
+    from hydragnn_tpu.graph.batch import GraphSample
+    from hydragnn_tpu.data.transform import check_data_samples_equivalence
+
+    rng = np.random.RandomState(0)
+    pos = rng.rand(7, 3).astype(np.float32)
+    x = rng.rand(7, 2).astype(np.float32)
+    ei = radius_graph(pos, 1.2, 10)
+    attr = edge_lengths(pos, ei)
+    mk = lambda e, a: GraphSample(
+        x=x, pos=pos, edge_index=e, graph_y=np.ones(1, np.float32),
+        node_y=x, edge_attr=a)
+
+    perm = rng.permutation(ei.shape[1])
+    assert check_data_samples_equivalence(mk(ei, attr),
+                                          mk(ei[:, perm], attr[perm]))
+    # attr mismatch beyond tol
+    bad = attr.copy()
+    bad[0] += 1e-3
+    assert not check_data_samples_equivalence(mk(ei, attr),
+                                              mk(ei[:, perm], bad[perm]))
+    # different edge set
+    ei2 = ei.copy()
+    ei2[1, 0] = (ei2[1, 0] + 1) % 7
+    assert not check_data_samples_equivalence(mk(ei, attr), mk(ei2, attr))
