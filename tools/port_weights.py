@@ -20,9 +20,9 @@ Conventions handled (docs/WEIGHTS.md "Conventions"):
     ``heads_NN.{k}.mlp.0.{2j}`` -> ``head_{k}/MLP_0/dense_{j}``; per-node
     variants stack ``mlp.{n}`` over n into the ``w_{j}/b_{j}`` banks
 
-Per-arch conv mappings: see ``_CONV_PORTERS`` (SAGE, GIN, SchNet, PNA,
-CGCNN).  Remaining stacks raise NotImplementedError with the table of what
-is supported.
+Per-arch conv mappings: see ``_CONV_PORTERS`` — ALL 9 stacks are
+supported (SAGE, GIN, GAT, MFC, PNA, CGCNN, SchNet, DimeNet, EGNN).
+Every porter takes ``(scope, sd, template)``; most need only the scope.
 
 Usage:
     from tools.port_weights import port_checkpoint, port_state_dict
@@ -81,7 +81,7 @@ class _Scope:
 # --- per-arch conv porters: _Scope(graph_convs.{i}.) -> flax conv params ---
 
 
-def _port_sage(s: _Scope) -> Dict[str, Any]:
+def _port_sage(s: _Scope, sd, template) -> Dict[str, Any]:
     # PyG SAGEConv: lin_l acts on the aggregated neighbors (bias carrier),
     # lin_r on the root.  Ours puts the single bias on lin_self — the sum
     # is identical (docs/WEIGHTS.md SAGE row).
@@ -91,7 +91,7 @@ def _port_sage(s: _Scope) -> Dict[str, Any]:
     }
 
 
-def _port_gin(s: _Scope) -> Dict[str, Any]:
+def _port_gin(s: _Scope, sd, template) -> Dict[str, Any]:
     return {
         "eps": s.get("eps").reshape(()),
         "mlp_0": s.linear("nn.0"),
@@ -99,7 +99,7 @@ def _port_gin(s: _Scope) -> Dict[str, Any]:
     }
 
 
-def _port_schnet(s: _Scope) -> Dict[str, Any]:
+def _port_schnet(s: _Scope, sd, template) -> Dict[str, Any]:
     out = {
         "filter_0": s.linear("nn.0"),
         "filter_1": s.linear("nn.2"),
@@ -112,7 +112,7 @@ def _port_schnet(s: _Scope) -> Dict[str, Any]:
     return out
 
 
-def _port_pna(s: _Scope) -> Dict[str, Any]:
+def _port_pna(s: _Scope, sd, template) -> Dict[str, Any]:
     # towers=1, pre_layers=post_layers=1 (reference PNAStack.py:41-50)
     out = {
         "pre_nn": s.linear("pre_nns.0.0"),
@@ -124,16 +124,126 @@ def _port_pna(s: _Scope) -> Dict[str, Any]:
     return out
 
 
-def _port_cgcnn(s: _Scope) -> Dict[str, Any]:
+def _port_cgcnn(s: _Scope, sd, template) -> Dict[str, Any]:
     return {"lin_f": s.linear("lin_f"), "lin_s": s.linear("lin_s")}
 
 
-_CONV_PORTERS: Dict[str, Callable[[_Scope], Dict[str, Any]]] = {
+def _port_gat(s: _Scope, sd, template) -> Dict[str, Any]:
+    # PyG GATv2Conv: lin_l transforms the source, lin_r the target —
+    # identical roles here; att [1, heads, out]; bias at the conv level
+    # the conv-level bias shares its suffix with lin_l/lin_r biases —
+    # anchor it to att's nesting level (same GATv2Conv module)
+    att_key = [k for k in s.keys if k.endswith("att")]
+    if len(att_key) != 1:
+        raise KeyError(f"expected one att under {s.prefix}, got {att_key}")
+    return {
+        "lin_l": s.linear("lin_l"),
+        "lin_r": s.linear("lin_r"),
+        "att": _np(s.sd[att_key[0]]),
+        "bias": _np(s.sd[att_key[0][:-3] + "bias"]),
+    }
+
+
+def _port_egnn(s: _Scope, sd, template) -> Dict[str, Any]:
+    # reference E_GCL (EGCLStack.py:144-173): edge_mlp/node_mlp Sequentials
+    # with Linears at slots 0 and 2; coord_mlp's final layer is bias-free
+    if any(".att_mlp." in k for k in s.keys):
+        raise NotImplementedError(
+            "E_GCL attention variant is not ported (reference EGCLStack "
+            "builds att_mlp only when attention=True; ours has no "
+            "counterpart) — porting would silently drop it")
+    out = {
+        "edge_mlp_0": s.linear("edge_mlp.0"),
+        "edge_mlp_1": s.linear("edge_mlp.2"),
+        "node_mlp_0": s.linear("node_mlp.0"),
+        "node_mlp_1": s.linear("node_mlp.2"),
+    }
+    if any(".coord_mlp." in k for k in s.keys):
+        out["coord_mlp_0"] = s.linear("coord_mlp.0")
+        out["coord_mlp_1"] = {"kernel": s.kernel("coord_mlp.2")}
+    return out
+
+
+def _port_mfc(s: _Scope, sd, template) -> Dict[str, Any]:
+    # PyG MFConv keeps per-degree Linear banks: lins_l[d] acts on the
+    # aggregated neighbors (bias carrier), lins_r[d] on the root
+    # (bias-free) — stacked here into [max_degree+1, in, out] banks
+    degs = sorted({
+        int(k.split("lins_l.")[1].split(".")[0])
+        for k in s.keys if "lins_l." in k})
+    w_neigh, w_root, bias = [], [], []
+    for d in degs:
+        w_neigh.append(s.kernel(f"lins_l.{d}"))
+        bias.append(s.bias(f"lins_l.{d}"))
+        w_root.append(s.kernel(f"lins_r.{d}"))
+    return {
+        "w_neigh": np.stack(w_neigh),
+        "w_root": np.stack(w_root),
+        "bias": np.stack(bias),
+    }
+
+
+def _port_dimenet(s: _Scope, sd, template) -> Dict[str, Any]:
+    """DimeNet++ conv (reference DIMEStack.get_conv PyGSeq: module_0 = the
+    input Linear, module_1 = HydraEmbeddingBlock, module_2 =
+    InteractionPPBlock, module_3 = OutputPPBlock).  The reference shares
+    ONE BesselBasisLayer across all convs (stack-level ``rbf.freq``);
+    broadcasting it into each conv's per-layer basis reproduces the
+    reference forward exactly."""
+    m1 = _Scope(sd, s.prefix + "module_1.")
+    m2 = _Scope(sd, s.prefix + "module_2.")
+    m3 = _Scope(sd, s.prefix + "module_3.")
+    out: Dict[str, Any] = {
+        "lin_in": s.linear("module_0"),
+        "rbf": {"freq": _np(sd["rbf.freq"])},
+        "emb_lin_rbf": m1.linear("lin_rbf"),
+        "emb_lin": m1.linear("lin"),
+    }
+    inter: Dict[str, Any] = {
+        "lin_ji": m2.linear("lin_ji"),
+        "lin_kj": m2.linear("lin_kj"),
+        "lin_rbf1": {"kernel": m2.kernel("lin_rbf1")},
+        "lin_rbf2": {"kernel": m2.kernel("lin_rbf2")},
+        "lin_sbf1": {"kernel": m2.kernel("lin_sbf1")},
+        "lin_sbf2": {"kernel": m2.kernel("lin_sbf2")},
+        "lin_down": {"kernel": m2.kernel("lin_down")},
+        "lin_up": {"kernel": m2.kernel("lin_up")},
+        "lin": m2.linear("lin"),
+    }
+    for name in template["interaction"]:
+        name = str(name)
+        if name.startswith(("before_skip_", "after_skip_")):
+            k = int(name.split("_")[-1])
+            side = ("layers_before_skip" if name.startswith("before")
+                    else "layers_after_skip")
+            inter[name] = {
+                "lin1": m2.linear(f"{side}.{k}.lin1"),
+                "lin2": m2.linear(f"{side}.{k}.lin2"),
+            }
+    out["interaction"] = inter
+    dec: Dict[str, Any] = {
+        "lin_rbf": {"kernel": m3.kernel("lin_rbf")},
+        "lin_up": {"kernel": m3.kernel("lin_up")},
+        "lin_out": {"kernel": m3.kernel("lin")},
+    }
+    for name in template["output"]:
+        name = str(name)
+        if name.startswith("lin_") and name.split("_")[1].isdigit():
+            dec[name] = m3.linear(f"lins.{int(name.split('_')[1])}")
+    out["output"] = dec
+    return out
+
+
+_CONV_PORTERS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "SAGE": _port_sage,
     "GIN": _port_gin,
     "SchNet": _port_schnet,
     "PNA": _port_pna,
     "CGCNN": _port_cgcnn,
+    "GAT": _port_gat,
+    "EGNN": _port_egnn,
+    "MFC": _port_mfc,
+    "DimeNet": _port_dimenet,
 }
 
 
@@ -200,7 +310,7 @@ def port_state_dict(sd: Mapping[str, Any], model_type: str,
         scope = str(scope)
         if scope.startswith("encoder_conv_"):
             i = int(scope.split("_")[-1])
-            got = porter(_Scope(sd, f"graph_convs.{i}."))
+            got = porter(_Scope(sd, f"graph_convs.{i}."), sd, sub)
             _check_match(scope, sub, got)
             new_params[scope] = got
         elif scope.startswith("encoder_bn_"):
