@@ -707,15 +707,14 @@ def test_forward_parity_dimenet():
         counts = torch.bincount(gid, minlength=int(gm.sum())).clamp(min=1)
         pooled = torch.zeros(int(gm.sum()), x.shape[1]).index_add_(0, gid, x)
         pooled = pooled / counts[:, None].float()
-        z = pooled
-        for k in (0, 2):
-            z = torch.relu(
-                z @ sd[f"graph_shared.{k}.weight"].T
-                + sd[f"graph_shared.{k}.bias"])
-        for k in (0, 2):
-            z = torch.relu(
-                z @ sd[f"heads_NN.0.{k}.weight"].T + sd[f"heads_NN.0.{k}.bias"])
-        z = z @ sd["heads_NN.0.4.weight"].T + sd["heads_NN.0.4.bias"]
+        # run the heads through REAL twin modules loaded from sd — no
+        # hand-rolled slot arithmetic to drift out of sync
+        skel = TorchTwinModel(TwinSAGE, False, ("graph",))
+        skel.load_state_dict(
+            {k: v for k, v in sd.items()
+             if k.startswith(("graph_shared", "heads_NN"))}, strict=False)
+        skel.eval()
+        z = skel.heads_NN[0](skel.graph_shared(pooled))
 
     np.testing.assert_allclose(
         np.asarray(flax_out[0])[gm], z.numpy(), atol=2e-4, rtol=2e-4)
