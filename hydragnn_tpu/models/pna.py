@@ -31,7 +31,10 @@ class PNAConv(nn.Module):
         f = self.in_dim
         src, dst = g.senders, g.receivers
 
-        h_src, h_dst = x[src], x[dst]
+        # gathers whose backward rides the dense sorted scatter instead of
+        # XLA's scatter-add (marker-gated; plain gathers otherwise)
+        h_src = segment.gather_sender(x, g)
+        h_dst = segment.gather_receiver_sorted(x, g)
         if self.edge_dim:
             e = nn.Dense(f, name="edge_encoder")(g.edge_attr)
             z = jnp.concatenate([h_dst, h_src, e], axis=-1)
@@ -41,18 +44,17 @@ class PNAConv(nn.Module):
 
         # mean and std share ONE masked sum pair riding the dense-schedule
         # sorted scatter when available (same numerics as
-        # segment_mean/segment_std: max(deg,1) divide, eps 1e-5);
-        # min/max keep the masked scatter paths
+        # segment_mean/segment_std: max(deg,1) divide, eps 1e-5); min and
+        # max share ONE scatter-max over [msg, -msg] — XLA expands each
+        # segment max/min into a long sort pipeline, so halving the count
+        # matters (min(x) = -max(-x), same values and gradients)
         deg = jnp.maximum(segment.degree(dst, n, g.edge_mask), 1.0)[:, None]
         mean = segment.scatter_segment(msg, g) / deg
         sq_mean = segment.scatter_segment(msg * msg, g) / deg
         std = jnp.sqrt(jnp.maximum(sq_mean - mean * mean, 0.0) + 1e-5)
-        aggs = [
-            mean,
-            segment.segment_min(msg, dst, n, g.edge_mask),
-            segment.segment_max(msg, dst, n, g.edge_mask),
-            std,
-        ]
+        mxmn = segment.segment_max(
+            jnp.concatenate([msg, -msg], axis=-1), dst, n, g.edge_mask)
+        aggs = [mean, -mxmn[:, f:], mxmn[:, :f], std]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4F]
 
         log_deg = jnp.log(deg + 1.0)
