@@ -36,7 +36,10 @@ class EGCL(nn.Module):
         # for the energy-gradient force loss (jax.grad wrt pos).
         diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)  # norm_diff=True
 
-        parts = [x[src], x[dst], radial]
+        # gathers whose backward rides the dense sorted scatter
+        # (marker-gated; measured +9% end-to-end on the v5e sweep)
+        parts = [segment.gather_sender(x, g),
+                 segment.gather_receiver_sorted(x, g), radial]
         if self.edge_dim and g.edge_attr is not None:
             parts.append(g.edge_attr)
         m = jnp.concatenate(parts, axis=-1)
