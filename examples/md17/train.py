@@ -91,20 +91,35 @@ def synthesize_md_trajectory(n_frames: int = 500, n_atoms: int = 21,
 
 
 def load_md17_npz(path: str, max_frames: int = 1000, radius: float = 2.2):
-    data = np.load(path)
-    E, F, R, z = data["E"], data["F"], data["R"], data["z"]
-    n = min(max_frames, R.shape[0])
-    idx = np.linspace(0, R.shape[0] - 1, n).astype(int)
+    """Real-data ingest: an MD17 ``.npz`` (sgdml keys E/F/R/z; reference
+    examples/md17/md17.py:15-23) or an ANI-release ``.h5`` (the ani1_x
+    example delegates here; reference examples/ani1_x/train.py:126-146) —
+    parsed by hydragnn_tpu.data.formats, evenly subsampled to
+    ``max_frames``, energies per-atom like the reference pre-transform."""
+    from hydragnn_tpu.data import formats
+
+    if path.endswith((".h5", ".hdf5")):
+        # evenly spread ~2x the budget across ALL formula buckets (no
+        # alphabetical prefix bias); the linspace below trims to max_frames
+        frames = formats.load_ani1x_h5(path, spread_total=max_frames * 2)
+    else:
+        frames = formats.load_md17_npz(path)
+    idx = np.linspace(0, len(frames) - 1,
+                      min(max_frames, len(frames))).astype(int)
     samples = []
     for i in idx:
-        pos = R[i]
+        fr = frames[i]
+        pos = np.asarray(fr.pos, np.float64)
         ei = radius_graph(pos, radius, max_neighbours=12)
+        n = fr.num_nodes
+        forces = (fr.forces if fr.forces is not None
+                  else np.zeros((n, 3)))
         samples.append(GraphSample(
-            x=z[:, None].astype(np.float32),
+            x=fr.z[:, None].astype(np.float32),
             pos=pos.astype(np.float32),
             edge_index=ei,
-            graph_y=np.asarray([float(E[i]) / len(z)], np.float32),
-            node_y=F[i].astype(np.float32),
+            graph_y=np.asarray([float(fr.energy) / n], np.float32),
+            node_y=forces.astype(np.float32),
             extras={},
         ))
     return _standardize(samples)

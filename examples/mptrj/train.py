@@ -102,10 +102,7 @@ def synthesize_trajectories(n_traj: int = 40, frames: int = 5, seed: int = 0,
             ei, lengths = radius_graph_pbc(
                 pos, cellm, radius, max_neighbours=max_neighbours,
                 check_duplicates=False)
-            d1 = np.zeros(n)
-            d2 = np.zeros(n)
-            np.add.at(d1, ei[1], (1.0 - lengths / radius) ** 2)
-            np.add.at(d2, ei[1], np.exp(-(lengths / 1.2) ** 2))
+            d1, d2 = _descriptors(ei, lengths, radius, n)
             samples.append(GraphSample(
                 x=np.stack([z.astype(float), d1, d2], 1).astype(np.float32),
                 pos=pos.astype(np.float32),
@@ -117,6 +114,20 @@ def synthesize_trajectories(n_traj: int = 40, frames: int = 5, seed: int = 0,
                 cell=cellm.astype(np.float32),
             ))
     # standardize energy; scale forces by the same convention as LJ example
+    return _standardize_ef(samples)
+
+
+def _descriptors(ei, lengths, radius, n):
+    """The [d1, d2] per-node radial descriptors both ingest paths share."""
+    d1 = np.zeros(n)
+    d2 = np.zeros(n)
+    np.add.at(d1, ei[1], (1.0 - lengths / radius) ** 2)
+    np.add.at(d2, ei[1], np.exp(-(lengths / 1.2) ** 2))
+    return d1, d2
+
+
+def _standardize_ef(samples):
+    """Standardize energies, scale forces (columns 3:) by their std."""
     e = np.asarray([s.graph_y[0] for s in samples])
     f = np.concatenate([s.node_y[:, 3:].reshape(-1) for s in samples])
     mu, s_e = float(e.mean()), float(e.std()) or 1.0
@@ -128,10 +139,51 @@ def synthesize_trajectories(n_traj: int = 40, frames: int = 5, seed: int = 0,
     return samples
 
 
+def load_mptrj(path: str, radius: float, max_neighbours: int,
+               energy_per_atom: bool = True, max_frames: int = 2000):
+    """Real MPTrj ingest: the MPtrj_2022.9_full.json layout (pymatgen
+    structure dicts + energy_per_atom/corrected_total_energy + forces;
+    reference examples/mptrj/train.py:76-151) parsed by
+    hydragnn_tpu.data.formats, converted to the same node-feature schema
+    as the synthesized trajectories ([z, d1, d2] descriptors)."""
+    from hydragnn_tpu.data import formats
+
+    frames = formats.load_mptrj_json(
+        path, energy_per_atom=energy_per_atom, max_frames=max_frames)
+    samples = []
+    for fr in frames:
+        pos = np.asarray(fr.pos, np.float64)
+        n = fr.num_nodes
+        ei, lengths = radius_graph_pbc(
+            pos, np.asarray(fr.cell, np.float64), radius,
+            max_neighbours=max_neighbours, check_duplicates=False)
+        if ei.shape[1] == 0:
+            continue
+        d1, d2 = _descriptors(ei, lengths, radius, n)
+        forces = fr.forces if fr.forces is not None else np.zeros((n, 3))
+        energy = 0.0 if fr.energy is None else float(fr.energy)
+        samples.append(GraphSample(
+            x=np.stack([fr.z, d1, d2], 1).astype(np.float32),
+            pos=pos.astype(np.float32),
+            edge_index=ei,
+            edge_attr=(lengths.reshape(-1, 1) / radius).astype(np.float32),
+            graph_y=np.asarray([energy], np.float32),
+            node_y=np.concatenate(
+                [np.stack([fr.z, d1, d2], 1), forces], 1).astype(np.float32),
+            cell=np.asarray(fr.cell, np.float32),
+        ))
+    if not samples:
+        raise ValueError(
+            f"no frames ingested from {path} (empty archive, or every "
+            f"structure produced 0 edges at radius={radius})")
+    return _standardize_ef(samples)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--inputfile", default=os.path.join(_HERE, "mptrj.json"))
-    ap.add_argument("--data", default="")  # harness compat
+    ap.add_argument("--data", default="",
+                    help="path to an MPtrj_*.json archive (real-data mode)")
     ap.add_argument("--num_traj", type=int, default=40)
     ap.add_argument("--preonly", action="store_true")
     ap.add_argument("--gpack", default=os.path.join(_HERE, "dataset/mptrj.gpack"))
@@ -153,6 +205,10 @@ def main():
         from hydragnn_tpu.data.gpack import GpackDataset
 
         samples = list(GpackDataset(args.gpack, preload=True))
+    elif args.data and os.path.isfile(args.data):
+        samples = load_mptrj(
+            args.data, radius=float(arch.get("radius", 2.2)),
+            max_neighbours=int(arch.get("max_neighbours", 24)))
     else:
         samples = synthesize_trajectories(
             args.num_traj, radius=float(arch.get("radius", 2.2)),

@@ -126,7 +126,42 @@ def _standardize_energy(samples):
 
 
 def load_frames(dirpath: str, radius: float, max_neighbours: int):
-    """Parse per-frame text files: line0 N, line1 energy, then Z x y z."""
+    """Real OC20 ingest: a directory of S2EF/IS2RE ``.extxyz`` frame files
+    (the distribution layout the reference reads through ASE in
+    examples/open_catalyst_2020/utils/atoms_to_graphs.py — species, pos,
+    Lattice, energy, tags) parsed by hydragnn_tpu.data.formats; falls back
+    to the simple per-frame text layout (line0 N, line1 energy, then
+    ``Z x y z``) for hand-staged frames."""
+    from hydragnn_tpu.data import formats
+
+    has_xyz = any(f.endswith((".xyz", ".extxyz"))
+                  for f in os.listdir(dirpath))
+    if has_xyz:
+        frames = formats.load_extxyz(dirpath)
+        samples = []
+        for fr in frames:
+            pos = np.asarray(fr.pos, np.float64)
+            # reference a2g uses r_pbc=False (train.py:87)
+            ei = radius_graph(pos, radius, max_neighbours=max_neighbours)
+            if ei.shape[1] == 0:
+                continue
+            tags = (fr.tags if fr.tags is not None
+                    else np.zeros(fr.num_nodes))
+            energy = 0.0 if fr.energy is None else float(fr.energy)
+            samples.append(GraphSample(
+                x=np.stack([fr.z, tags], axis=1).astype(np.float32),
+                pos=pos.astype(np.float32),
+                edge_index=ei,
+                edge_attr=edge_lengths(pos, ei) / radius,
+                graph_y=np.asarray([energy / fr.num_nodes], np.float32),
+            ))
+        if not samples:
+            raise ValueError(
+                f"no frames ingested from {dirpath} (unparseable extxyz, "
+                f"or every frame produced 0 edges at radius={radius})")
+        _standardize_energy(samples)
+        return samples
+
     samples = []
     for fname in sorted(os.listdir(dirpath)):
         fp = os.path.join(dirpath, fname)

@@ -218,6 +218,15 @@ def gather_receiver_sorted(x, g):
     return x[g.receivers]
 
 
+def gather_perm(x, idx, perm):
+    """``x[idx]`` for an ARBITRARY index vector whose backward rides the
+    dense sorted-scatter kernel through a host-precomputed stable argsort
+    ``perm`` of ``idx`` (DimeNet's triplet-side ``idx_kj`` gathers).  Same
+    zero-cotangent requirement as the other dense-backward gathers (see
+    :func:`_gather_dense_bwd`)."""
+    return _gather_dense_bwd(x, idx, perm)
+
+
 def gather_sender(x, g):
     """``x[senders]`` whose BACKWARD rides the dense scatter through
     collate's sender-sorted permutation — marker-gated."""
@@ -229,6 +238,17 @@ def gather_sender(x, g):
 
 @jax.custom_vjp
 def _gather_dense_bwd(x, idx, perm):
+    """Gather with a dense-sorted-scatter backward.
+
+    ZERO-COTANGENT REQUIREMENT: the backward scatters the incoming
+    cotangent UNMASKED.  Padding rows of ``idx`` park on a REAL slot
+    (node N-1 / edge E-1 by collate convention), so every caller must
+    guarantee the cotangent is exactly zero on padding rows — i.e. the
+    gathered value must be multiplied by the edge/triplet mask somewhere
+    downstream before any loss.  All current call sites
+    (gather_sender/gather_receiver_sorted/gather_perm) satisfy this; a
+    new unmasked consumer would silently corrupt the parked slot's
+    gradient."""
     return x[idx]
 
 

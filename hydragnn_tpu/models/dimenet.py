@@ -106,8 +106,14 @@ def add_dimenet_extras(batch, max_triplets: int):
     extras["dn_idx_i"] = _pad(ti, n - 1)
     extras["dn_idx_j"] = _pad(tj, n - 1)
     extras["dn_idx_k"] = _pad(tk, n - 1)
-    extras["dn_idx_kj"] = _pad(real_ids[tkj] if t else tkj, e - 1)
+    idx_kj = _pad(real_ids[tkj] if t else tkj, e - 1)
+    extras["dn_idx_kj"] = idx_kj
     extras["dn_idx_ji"] = _pad(real_ids[tji] if t else tji, e - 1)
+    # stable argsort of idx_kj: lets the triplet-side gathers
+    # (x_kj[idx_kj], rbf[idx_kj]) ride the dense sorted-scatter kernel in
+    # their BACKWARD (otherwise XLA scatter-adds 188k unsorted rows per
+    # layer — measured as the dominant cost of the DimeNet step)
+    extras["dn_perm_kj"] = np.argsort(idx_kj, kind="stable").astype(np.int32)
     mask = np.zeros((max_triplets,), np.float32)
     mask[:t] = 1.0
     extras["dn_triplet_mask"] = mask
@@ -227,10 +233,10 @@ class BesselBasis(nn.Module):
         return envelope(d, self.envelope_exponent) * jnp.sin(freq * d)
 
 
-def spherical_basis(
-    dist_norm, angle, idx_kj, num_spherical: int, num_radial: int, envelope_exponent: int
-):
-    """[T, num_spherical*num_radial] spherical basis per triplet."""
+def radial_sbf(dist_norm, num_spherical: int, num_radial: int,
+               envelope_exponent: int):
+    """Per-EDGE radial part of the spherical basis: [E, S, R] with
+    norm * j_l(z_lr * d) * envelope(d) at slot (l, r)."""
     zeros = jnp.asarray(
         spherical_bessel_zeros(num_spherical, num_radial), jnp.float32
     )  # [S, R]
@@ -239,24 +245,48 @@ def spherical_basis(
     x = dist_norm[:, None, None] * zeros[None, :, :]  # [E, S, R]
     jls = _spherical_jl(num_spherical - 1, x.reshape(-1))  # list of [E*S*R]
     e = dist_norm.shape[0]
-    jl_stack = jnp.stack([j.reshape(e, num_spherical, num_radial) for j in jls], axis=1)
-    # select l-th bessel order for slot l
-    sel = jnp.eye(num_spherical, dtype=jnp.float32)
-    rbf = jnp.einsum("elsr,ls->esr", jl_stack, sel)  # [E, S, R] with j_l at slot l
+    # slot l needs only order l: slice the diagonal directly instead of
+    # stacking all orders into [E, S, S, R] and einsum-selecting (the
+    # round-3 code's 7x-materialized intermediate)
+    rbf = jnp.stack(
+        [jls[l].reshape(e, num_spherical, num_radial)[:, l, :]
+         for l in range(num_spherical)],
+        axis=1)  # [E, S, R]
     rbf = rbf * norms[None, :, :]
-    rbf = rbf * envelope(dist_norm[:, None, None], envelope_exponent)
+    return rbf * envelope(dist_norm[:, None, None], envelope_exponent)
 
+
+def angular_cbf(angle, num_spherical: int):
+    """Per-TRIPLET angular part: [T, S] real-spherical-harmonic Legendre."""
     cos_a = jnp.cos(angle)
     pl = _legendre(num_spherical - 1, cos_a)
-    cbf = jnp.stack(
+    return jnp.stack(
         [
             math.sqrt((2 * l + 1) / (4 * math.pi)) * pl[l]
             for l in range(num_spherical)
         ],
         axis=1,
-    )  # [T, S]
+    )
 
-    out = rbf[idx_kj] * cbf[:, :, None]  # [T, S, R]
+
+def spherical_basis(
+    dist_norm, angle, idx_kj, num_spherical: int, num_radial: int,
+    envelope_exponent: int, perm_kj=None
+):
+    """[T, num_spherical*num_radial] spherical basis per triplet.
+
+    ``perm_kj`` (host-precomputed stable argsort of ``idx_kj``) routes the
+    edge->triplet gather's backward through the dense sorted scatter.
+    """
+    rbf = radial_sbf(dist_norm, num_spherical, num_radial, envelope_exponent)
+    cbf = angular_cbf(angle, num_spherical)
+    e = dist_norm.shape[0]
+    rbf2 = rbf.reshape(e, num_spherical * num_radial)
+    if perm_kj is not None:
+        rbf_t = segment.gather_perm(rbf2, idx_kj, perm_kj)
+    else:
+        rbf_t = rbf2[idx_kj]
+    out = rbf_t.reshape(-1, num_spherical, num_radial) * cbf[:, :, None]
     return out.reshape(-1, num_spherical * num_radial)
 
 
@@ -298,6 +328,14 @@ class InteractionPPBlock(nn.Module):
 
         sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
         sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
+        # NOTE: this gather deliberately does NOT use gather_perm — its
+        # backward (scatter-add over idx_kj) fuses into the surrounding
+        # elementwise cotangent under XLA, and routing it through the dense
+        # sorted scatter (which needs an extra g[perm] gather first) was
+        # measured 12 ms/step SLOWER on the v5e sweep config.  The
+        # rbf->triplet gather in spherical_basis keeps the perm: its
+        # backward only runs under pos-grad (force training), where the
+        # dense path halves the cost (tools/profile_dimenet*.py, round 4).
         msg = x_kj[idx_kj] * sbf_emb * triplet_mask[:, None]
         # build_triplets emits idx_ji in nondecreasing order (outer loop
         # over edge ids) — the dense-schedule sorted scatter applies
@@ -360,6 +398,7 @@ class DimeNetConv(nn.Module):
         idx_i, idx_j, idx_k = ex["dn_idx_i"], ex["dn_idx_j"], ex["dn_idx_k"]
         idx_kj, idx_ji = ex["dn_idx_kj"], ex["dn_idx_ji"]
         tmask = ex["dn_triplet_mask"]
+        perm_kj = ex.get("dn_perm_kj")
 
         dist = jnp.sqrt(
             jnp.sum((pos[dst] - pos[src]) ** 2, axis=-1) + 1e-14
@@ -383,6 +422,7 @@ class DimeNetConv(nn.Module):
             self.num_spherical,
             self.num_radial,
             self.envelope_exponent,
+            perm_kj=perm_kj,
         )
 
         h = nn.Dense(hidden, name="lin_in")(x)

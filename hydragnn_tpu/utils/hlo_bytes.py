@@ -107,9 +107,20 @@ def entry_fusion_boundary_bytes(hlo_text: str) -> Tuple[int, Dict[str, int]]:
             continue
         name, shape_text, op = im.groups()
         defs[name] = (shape_text, op)
-        # operand names: everything inside the top-level call parens
-        paren = line[line.index("(", im.end(3) - 1):]
-        operands = re.findall(r"%([\w.\-]+)", paren)
+        # operand names: only inside the BALANCED top-level call parens —
+        # %names in trailing attributes (control-predecessors={%a}, ...)
+        # must not be billed as operands (round-3 advisor)
+        start = line.index("(", im.end(3) - 1)
+        depth, end = 0, len(line)
+        for i in range(start, len(line)):
+            if line[i] == "(":
+                depth += 1
+            elif line[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i + 1
+                    break
+        operands = re.findall(r"%([\w.\-]+)", line[start:end])
         parsed.append((name, shape_text, op, operands))
 
     per_instr: Dict[str, int] = {}

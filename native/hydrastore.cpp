@@ -211,7 +211,15 @@ static int env_ms(const char* name, int dflt) {
   long ms = strtol(v, &end, 10);
   // malformed or non-positive values fall back to the default — a bad env
   // var must not silently disable the timeout (0) or poison every fetch (1)
-  if (end == v || *end != '\0' || ms <= 0 || ms > 3600000) return dflt;
+  if (end == v || *end != '\0' || ms <= 0) return dflt;
+  // oversized values CLAMP to the 1h ceiling (an operator asking for a
+  // 2h timeout should get the longest supported one, not a 10s default);
+  // warn so the truncation is visible (round-3 advisor)
+  if (ms > 3600000) {
+    fprintf(stderr, "hydrastore: %s=%ld ms exceeds the 3600000 ms ceiling; "
+            "clamping to 3600000\n", name, ms);
+    return 3600000;
+  }
   return (int)ms;
 }
 

@@ -98,3 +98,34 @@ def test_check_data_samples_equivalence():
     ei2 = ei.copy()
     ei2[1, 0] = (ei2[1, 0] + 1) % 7
     assert not check_data_samples_equivalence(mk(ei, attr), mk(ei2, attr))
+
+
+def test_equivalence_multigraph_duplicate_edges():
+    """Parallel duplicate (src,dst) edges: attrs matching as a MULTISET in
+    different order must pass (round-3 advisor), including the near-tie
+    case where a leading attr column differs by < tol and the sorted
+    pairing misaligns — the per-group assignment fallback must recover."""
+    from hydragnn_tpu.graph.batch import GraphSample
+    from hydragnn_tpu.data.transform import check_data_samples_equivalence
+
+    pos = np.zeros((2, 3), np.float32)
+    x = np.ones((2, 1), np.float32)
+    ei = np.asarray([[0, 0, 1], [1, 1, 0]])   # two parallel 0->1 edges
+    mk = lambda a: GraphSample(
+        x=x, pos=pos, edge_index=ei, graph_y=np.ones(1, np.float32),
+        node_y=x, edge_attr=np.asarray(a, np.float32))
+
+    # same multiset, different duplicate order
+    assert check_data_samples_equivalence(
+        mk([[1.0, 5.0], [2.0, 9.0], [0.5, 0.5]]),
+        mk([[2.0, 9.0], [1.0, 5.0], [0.5, 0.5]]))
+    # near-tie in column 0 (difference < tol): sorted pairing misaligns,
+    # but a valid within-tol matching exists
+    tol = 1e-6
+    assert check_data_samples_equivalence(
+        mk([[0.0, 5.0], [1e-7, 9.0], [0.5, 0.5]]),
+        mk([[1e-7, 5.0], [0.0, 9.0], [0.5, 0.5]]), tol=tol)
+    # genuinely different multisets must still fail
+    assert not check_data_samples_equivalence(
+        mk([[1.0, 5.0], [2.0, 9.0], [0.5, 0.5]]),
+        mk([[1.0, 9.0], [2.0, 5.0], [0.5, 0.5]]))

@@ -1,0 +1,127 @@
+"""Decompose DimeNet's 64 ms step (round-4 VERDICT item 2).
+
+Times the full train step, then ablated jitted sub-computations at the
+exact bench shapes, so the 64 ms can be attributed to triplet-space ops
+vs basis eval vs everything else.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+
+
+def sync(tree):
+    np.asarray(jax.tree_util.tree_leaves(tree)[0])
+
+
+def _sync_small(tree):
+    # fetch ONE element of the committed output: forces completion without
+    # moving the full array over the tunnel, and cannot be DCE'd (the jit
+    # boundary already materialized the whole output buffer)
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    np.asarray(leaf.ravel()[0])
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    _sync_small(out)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync_small(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3  # ms
+
+
+def main():
+    state, batch, step, cfg, samples, heads = bench._build("DimeNet", hidden=64)
+    ex = batch.extras
+    E = batch.senders.shape[0]
+    T = ex["dn_idx_kj"].shape[0]
+    N = batch.x.shape[0]
+    print(f"N={N} E={E} T={T}")
+
+    step_ms, state = bench._chip_loop(state, batch, step, 20, 3)
+    print(f"full train step: {step_ms*1e3:.2f} ms")
+
+    from hydragnn_tpu.models.create import create_model
+    model = create_model(cfg)
+
+    params = state.params
+
+    @jax.jit
+    def fwd(p):
+        return model.apply({"params": p}, batch, train=False)
+
+    print(f"fwd only: {timeit(fwd, params):.2f} ms")
+
+    # spherical basis alone (fwd)
+    from hydragnn_tpu.models.dimenet import spherical_basis, envelope
+
+    pos = batch.pos
+    src, dst = batch.senders, batch.receivers
+    idx_i, idx_j, idx_k = ex["dn_idx_i"], ex["dn_idx_j"], ex["dn_idx_k"]
+    idx_kj, idx_ji = ex["dn_idx_kj"], ex["dn_idx_ji"]
+
+    @jax.jit
+    def sbf_only(pos):
+        dist = jnp.sqrt(jnp.sum((pos[dst] - pos[src]) ** 2, -1) + 1e-14)
+        dist = jnp.where(batch.edge_mask > 0, dist, cfg.radius)
+        pos_i = pos[idx_i]
+        v_ji = pos[idx_j] - pos_i
+        v_ki = pos[idx_k] - pos_i
+        a = jnp.sum(v_ji * v_ki, -1)
+        b = jnp.linalg.norm(jnp.cross(v_ji, v_ki) + 1e-14, axis=-1)
+        angle = jnp.arctan2(b, a)
+        return spherical_basis(dist / cfg.radius, angle, idx_kj, 7, 6, 5)
+
+    print(f"sbf fwd: {timeit(sbf_only, pos):.2f} ms")
+
+    @jax.jit
+    def sbf_grad(pos):
+        return jax.grad(lambda p: sbf_only(p).sum())(pos)
+
+    print(f"sbf fwd+bwd: {timeit(sbf_grad, pos):.2f} ms")
+
+    # triplet chain: gather -> mul -> sorted scatter (the interaction core)
+    from hydragnn_tpu.graph import segment
+
+    x_kj = jnp.zeros((E, 64), jnp.float32)
+    sbf_emb = jnp.zeros((T, 64), jnp.float32)
+    tmask = ex["dn_triplet_mask"]
+
+    @jax.jit
+    def tri_chain(x_kj, sbf_emb):
+        msg = x_kj[idx_kj] * sbf_emb * tmask[:, None]
+        return segment.sorted_segment_sum(msg, idx_ji, E, sorted_hint=True)
+
+    print(f"triplet gather+scatter fwd: {timeit(tri_chain, x_kj, sbf_emb):.2f} ms")
+
+    @jax.jit
+    def tri_grad(x_kj, sbf_emb):
+        return jax.grad(lambda a, b: tri_chain(a, b).sum(), argnums=(0, 1))(x_kj, sbf_emb)
+
+    print(f"triplet chain fwd+bwd: {timeit(tri_grad, x_kj, sbf_emb):.2f} ms")
+
+    # full fwd+bwd
+    @jax.jit
+    def full_grad(p, pos_):
+        def loss(p, pos_):
+            b2 = batch.replace(pos=pos_)
+            out = model.apply({"params": p}, b2, train=False)
+            return sum(jnp.sum(o) for o in jax.tree_util.tree_leaves(out))
+        return jax.grad(loss, argnums=(0, 1))(p, pos_)
+
+    print(f"model fwd+bwd (grad wrt params+pos): {timeit(full_grad, params, pos):.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Isolate which perm-gather site regresses the params-grad step."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from hydragnn_tpu.models import dimenet as dn
+
+
+def _sync_small(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    np.asarray(leaf.ravel()[0])
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    _sync_small(out)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync_small(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    state, batch, step, cfg, samples, heads = bench._build("DimeNet", hidden=64)
+    from hydragnn_tpu.models.create import create_model
+    model = create_model(cfg)
+    params = state.params
+
+    orig_sbf = dn.spherical_basis
+
+    def sbf_noperm(dist_norm, angle, idx_kj, S, R, ee, perm_kj=None):
+        return orig_sbf(dist_norm, angle, idx_kj, S, R, ee, perm_kj=None)
+
+    variants = {
+        "both-perm": (True, batch),
+        "sbf-noperm": (False, batch),
+    }
+    ex_noperm = dict(batch.extras)
+    del ex_noperm["dn_perm_kj"]
+    variants["neither"] = (True, batch.replace(extras=ex_noperm))
+
+    for name, (sbf_perm, b) in variants.items():
+        dn.spherical_basis = orig_sbf if sbf_perm else sbf_noperm
+
+        def pgrad_fn(p, b=b):
+            def loss(p):
+                out = model.apply({"params": p}, b, train=False)
+                return sum(jnp.sum(o) for o in jax.tree_util.tree_leaves(out))
+            return jax.grad(loss)(p)
+
+        pgrad = jax.jit(pgrad_fn)
+        print(f"{name}: params-grad {timeit(pgrad, params):.2f} ms", flush=True)
+    dn.spherical_basis = orig_sbf
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Decompose GAT's 55 ms step (round-4 VERDICT item 3)."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+
+
+def _sync_small(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    np.asarray(leaf.ravel()[0])
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    _sync_small(out)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync_small(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    state, batch, step, cfg, samples, heads = bench._build("GAT", hidden=64)
+    N = batch.x.shape[0]
+    E = batch.senders.shape[0]
+    print(f"N={N} E={E}", flush=True)
+
+    null = jax.jit(lambda a: a + 1.0)
+    print(f"null dispatch: {timeit(null, jnp.float32(1.0)):.2f} ms", flush=True)
+
+    step_ms, state = bench._chip_loop(state, batch, step, 20, 3)
+    print(f"full train step: {step_ms*1e3:.2f} ms", flush=True)
+
+    from hydragnn_tpu.models.create import create_model
+    model = create_model(cfg)
+    params = state.params
+
+    @jax.jit
+    def fwd(p):
+        return model.apply({"params": p}, batch, train=False)
+
+    print(f"fwd only: {timeit(fwd, params):.2f} ms", flush=True)
+
+    from hydragnn_tpu.graph import segment
+
+    h, f = 6, 64
+    src, dst = batch.senders, batch.receivers
+    xl = jnp.ones((N, h * f), jnp.float32)
+    xr = jnp.ones((N, h * f), jnp.float32)
+    att = jnp.ones((1, h, f), jnp.float32)
+
+    # one GATv2Conv-equivalent fwd (no params)
+    def conv_like(xl, xr, att):
+        g = batch
+
+        def logits(s, t):
+            z = jax.nn.leaky_relu(s + t, 0.05)
+            return jnp.sum(z.reshape(-1, h, f) * att, axis=-1)
+
+        e_edge = logits(segment.gather_sender(xl, g),
+                        segment.gather_receiver_sorted(xr, g))
+        e_self = logits(xl, xr)
+        neg = -1e9
+        e_edge = jnp.where(g.edge_mask[:, None] > 0, e_edge, neg)
+        seg_max = segment.segment_max(e_edge, dst, N)
+        deg = segment.degree(dst, N, g.edge_mask)
+        seg_max = jnp.where(deg[:, None] > 0, seg_max, e_self)
+        seg_max = jax.lax.stop_gradient(jnp.maximum(seg_max, e_self))
+        exp_edge = jnp.exp(e_edge - seg_max[dst]) * g.edge_mask[:, None]
+        exp_self = jnp.exp(e_self - seg_max)
+        denom = segment.scatter_segment(exp_edge, g) + exp_self
+        alpha_edge = exp_edge / jnp.maximum(denom, 1e-16)[dst]
+        alpha_self = exp_self / jnp.maximum(denom, 1e-16)
+        w_alpha = jnp.repeat(alpha_edge, f, axis=1)
+        out = segment.gather_mul_segment(xl, w_alpha, g)
+        return out.reshape(N, h, f) + alpha_self[:, :, None] * xl.reshape(N, h, f)
+
+    cj = jax.jit(conv_like)
+    print(f"conv fwd: {timeit(cj, xl, xr, att):.2f} ms", flush=True)
+
+    gj = jax.jit(jax.grad(lambda a, b, c: conv_like(a, b, c).sum(), argnums=(0, 1, 2)))
+    print(f"conv fwd+bwd: {timeit(gj, xl, xr, att):.2f} ms", flush=True)
+
+    # pieces
+    def logits_part(xl, xr, att):
+        g = batch
+
+        def logits(s, t):
+            z = jax.nn.leaky_relu(s + t, 0.05)
+            return jnp.sum(z.reshape(-1, h, f) * att, axis=-1)
+
+        return logits(segment.gather_sender(xl, g),
+                      segment.gather_receiver_sorted(xr, g))
+
+    lj = jax.jit(logits_part)
+    print(f"edge logits fwd: {timeit(lj, xl, xr, att):.2f} ms", flush=True)
+    lgj = jax.jit(jax.grad(lambda a, b, c: logits_part(a, b, c).sum(), argnums=(0, 1)))
+    print(f"edge logits fwd+bwd: {timeit(lgj, xl, xr, att):.2f} ms", flush=True)
+
+    e_edge = jnp.ones((E, h), jnp.float32)
+
+    def softmax_part(e_edge):
+        g = batch
+        seg_max = segment.segment_max(e_edge, dst, N)
+        exp_edge = jnp.exp(e_edge - seg_max[dst]) * g.edge_mask[:, None]
+        denom = segment.scatter_segment(exp_edge, g)
+        return exp_edge / jnp.maximum(denom, 1e-16)[dst]
+
+    sj = jax.jit(softmax_part)
+    print(f"segment softmax fwd: {timeit(sj, e_edge):.2f} ms", flush=True)
+    sgj = jax.jit(jax.grad(lambda a: softmax_part(a).sum()))
+    print(f"segment softmax fwd+bwd: {timeit(sgj, e_edge):.2f} ms", flush=True)
+
+    # the seg_max alone
+    mj = jax.jit(lambda e: segment.segment_max(e, dst, N))
+    print(f"segment_max fwd: {timeit(mj, e_edge):.2f} ms", flush=True)
+
+    alpha = jnp.ones((E, h), jnp.float32)
+
+    def aggr_part(xl, alpha):
+        g = batch
+        w_alpha = jnp.repeat(alpha, f, axis=1)
+        return segment.gather_mul_segment(xl, w_alpha, g)
+
+    aj = jax.jit(aggr_part)
+    print(f"aggregate fwd: {timeit(aj, xl, alpha):.2f} ms", flush=True)
+    agj = jax.jit(jax.grad(lambda a, b: aggr_part(a, b).sum(), argnums=(0, 1)))
+    print(f"aggregate fwd+bwd: {timeit(agj, xl, alpha):.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

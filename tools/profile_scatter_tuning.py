@@ -1,0 +1,66 @@
+"""Tune the dense-scatter block size for the DimeNet T->E shape.
+
+T=188k sorted triplet rows scattering into E=82k edge slots: the round-3
+128-row node block gives a ~1650-step grid; larger blocks trade per-step
+overhead for bigger one-hot contractions.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from hydragnn_tpu.ops import fused_mp
+
+
+def _sync_small(tree):
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    np.asarray(leaf.ravel()[0])
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    _sync_small(out)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync_small(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    state, batch, step, cfg, samples, heads = bench._build("DimeNet", hidden=64)
+    ex = batch.extras
+    idx_kj = np.asarray(ex["dn_idx_kj"])
+    perm = np.asarray(ex["dn_perm_kj"])
+    E = batch.senders.shape[0]
+    T = idx_kj.shape[0]
+    ids_sorted = jnp.asarray(idx_kj[perm])
+    for F in (64, 42):
+        data = jnp.ones((T, F), jnp.float32)
+        print(f"--- T={T} E={E} F={F}", flush=True)
+
+        xla = jax.jit(lambda d, i=jnp.asarray(idx_kj): jax.ops.segment_sum(d, i, E))
+        print(f"xla unsorted scatter: {timeit(xla, data):.3f} ms", flush=True)
+        xs = jax.jit(lambda d, i=ids_sorted: jax.ops.segment_sum(d, i, E))
+        print(f"xla sorted scatter:   {timeit(xs, data):.3f} ms", flush=True)
+
+        for bn, be in [(128, 512), (256, 512), (512, 512), (512, 1024),
+                       (1024, 1024), (256, 1024)]:
+            fused_mp._NODE_BLOCK, fused_mp._EDGE_BLOCK = bn, be
+            dense = jax.jit(
+                lambda d, i=ids_sorted: fused_mp.segment_sum_dense(d, i, E))
+            print(f"dense bn={bn} be={be}:  {timeit(dense, data):.3f} ms",
+                  flush=True)
+        fused_mp._NODE_BLOCK, fused_mp._EDGE_BLOCK = 128, 512
+
+
+if __name__ == "__main__":
+    main()
