@@ -8,6 +8,9 @@ The single line carries nested evidence blocks (round-3 VERDICT items 1/2/5):
   sustained              what a ``run_training`` user gets end-to-end:
                          loader -> stack -> resident replay -> scanned step,
                          measured through the real trainer epoch loop
+  sustained_default      the same loop with NO env knobs: _auto_pipeline
+                         picks scan/residency, val/test epochs run — the
+                         true out-of-the-box number (round-4 item 7)
   roofline               measured-method roofline for the SAME program that
                          is timed: flops from XLA's cost model (fusion-
                          invariant), bytes from XLA's buffer assignment
@@ -39,9 +42,9 @@ complete finished measurement.
 
 Env knobs: HYDRAGNN_BENCH_PLATFORM=tpu|cpu|auto (default auto),
 HYDRAGNN_BENCH_TIMEOUT (seconds per TPU attempt, default 1800),
-HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,sustained,dense,archs;
-default all on TPU, ceiling-only on CPU), HYDRAGNN_BENCH_DTYPE (flagship
-compute dtype, default float32).
+HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,sustained_default,
+sustained,dense,archs; default all on TPU, ceiling-only on CPU),
+HYDRAGNN_BENCH_DTYPE (flagship compute dtype, default float32).
 """
 
 from __future__ import annotations
@@ -293,10 +296,16 @@ def _membw_probe():
     return out
 
 
-def _sustained(samples, heads):
+def _sustained(samples, heads, default_path=False):
     """What a run_training user gets: the real trainer epoch loop (loader ->
     DeviceStackLoader -> ResidentDeviceLoader -> scanned jit step), measured
-    over full epochs after a warmup epoch that pays compile + staging."""
+    over full epochs after a warmup epoch that pays compile + staging.
+
+    ``default_path=True`` measures the OUT-OF-THE-BOX configuration: no env
+    knobs at all — scan chunking/residency are whatever _auto_pipeline
+    selects, and val/test epochs run (the round-4 default-path headline).
+    """
+    import jax
     import numpy as np
 
     from hydragnn_tpu.data.dataloader import create_dataloaders
@@ -306,11 +315,18 @@ def _sustained(samples, heads):
     from hydragnn_tpu.train.trainer import (
         create_train_state, train_validate_test)
 
-    os.environ["HYDRAGNN_VALTEST"] = "0"
-    # scan-32: at ~21 ms/dispatch tunnel latency (docs/PERF.md), 8 steps per
-    # dispatch left a 31% gap to the chip ceiling; 32 amortizes it 4x
-    os.environ.setdefault("HYDRAGNN_STEPS_PER_DISPATCH", "32")
-    os.environ.setdefault("HYDRAGNN_RESIDENT_DATASET", "1")
+    knob_keys = ("HYDRAGNN_VALTEST", "HYDRAGNN_STEPS_PER_DISPATCH",
+                 "HYDRAGNN_RESIDENT_DATASET")
+    saved_env = {k: os.environ.get(k) for k in knob_keys}
+    if default_path:
+        for k in knob_keys:
+            os.environ.pop(k, None)
+    else:
+        os.environ["HYDRAGNN_VALTEST"] = "0"
+        # scan-32: at ~21 ms/dispatch tunnel latency (docs/PERF.md), 8 steps
+        # per dispatch left a 31% gap to the chip ceiling; 32 amortizes it 4x
+        os.environ.setdefault("HYDRAGNN_STEPS_PER_DISPATCH", "32")
+        os.environ.setdefault("HYDRAGNN_RESIDENT_DATASET", "1")
 
     n_batches = 64
     batch_size = 512
@@ -339,12 +355,36 @@ def _sustained(samples, heads):
     # history["epoch_time"], so the steady-state epochs are separable
     # without re-running (a second call would re-trace and re-stage,
     # measuring harness artifacts instead of training)
-    state, history = train_validate_test(
-        model, cfg, state, opt_spec, train_loader, val_loader, test_loader,
-        config_nn, "bench_sustained", verbosity=0, rank=0, world_size=1)
-    _sync(state.params)
-    # drop_last stacking: graphs actually consumed per epoch
-    spd = int(os.environ.get("HYDRAGNN_STEPS_PER_DISPATCH", "1"))
+    try:
+        state, history = train_validate_test(
+            model, cfg, state, opt_spec, train_loader, val_loader,
+            test_loader, config_nn, "bench_sustained", verbosity=0, rank=0,
+            world_size=1)
+        _sync(state.params)
+        # drop_last stacking: graphs actually consumed per epoch
+        if default_path:
+            from hydragnn_tpu.train.trainer import _auto_pipeline
+
+            # SAME stack_factor the trainer used (mesh path device-stacks
+            # before K-stacking on multi-device hosts)
+            n_local = len(jax.local_devices())
+            spd, resident = _auto_pipeline(
+                train_loader, val_loader, test_loader,
+                stack_factor=n_local if n_local > 1 else 1)
+            valtest = 1
+        else:
+            spd = int(os.environ.get("HYDRAGNN_STEPS_PER_DISPATCH", "1"))
+            resident = int(
+                os.environ.get("HYDRAGNN_RESIDENT_DATASET", "0") or 0)
+            valtest = int(os.environ.get("HYDRAGNN_VALTEST", "1") or 0)
+    finally:
+        # restore the caller's knobs even when training raises — a leaked
+        # pop/setdefault would silently change every later bench phase
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     n_used = (n_batches // spd) * spd * batch_size
     steady = sorted(history["epoch_time"][2:])
     med = steady[len(steady) // 2]
@@ -352,13 +392,12 @@ def _sustained(samples, heads):
         "graphs_per_sec": round(n_used / med, 1),
         "epoch_time_s": [round(t, 3) for t in history["epoch_time"]],
         "graphs_per_epoch": n_used,
-        "knobs": {  # ACTUAL env at measurement time (user env wins over
-                    # the setdefaults above) — honest provenance
+        "knobs": {  # ACTUAL configuration at measurement time (for the
+                    # default path: what _auto_pipeline selected)
             "HYDRAGNN_STEPS_PER_DISPATCH": spd,
-            "HYDRAGNN_RESIDENT_DATASET":
-                int(os.environ.get("HYDRAGNN_RESIDENT_DATASET", "0") or 0),
-            "HYDRAGNN_VALTEST":
-                int(os.environ.get("HYDRAGNN_VALTEST", "1") or 0),
+            "HYDRAGNN_RESIDENT_DATASET": int(bool(resident)),
+            "HYDRAGNN_VALTEST": valtest,
+            "auto_selected": bool(default_path),
         },
         "method": "median steady-state epoch wall time (epochs 2+; epoch 0 "
                   "pays compile + one-time device staging) of the real "
@@ -391,8 +430,9 @@ def _child(platform: str) -> None:
     print(f"bench: platform={devs[0].platform} devices={len(devs)}",
           file=sys.stderr)
 
-    default_phases = ("ceiling,roofline,sustained,dense,archs" if on_tpu
-                      else "ceiling")
+    default_phases = (
+        "ceiling,roofline,sustained_default,sustained,dense,archs"
+        if on_tpu else "ceiling")
     phases = [p.strip() for p in os.getenv(
         "HYDRAGNN_BENCH_PHASES", default_phases).split(",") if p.strip()]
     dtype = os.getenv("HYDRAGNN_BENCH_DTYPE", "float32").strip()
@@ -425,6 +465,19 @@ def _child(platform: str) -> None:
             emit()
         except Exception as e:  # noqa: BLE001
             print(f"bench: roofline failed: {e!r}", file=sys.stderr)
+
+    if "sustained_default" in phases:
+        # out-of-the-box run_training: NO env knobs; _auto_pipeline picks
+        # scan/residency, val/test epochs run (round-4 default-path number)
+        try:
+            t0 = time.perf_counter()
+            result["sustained_default"] = _sustained(
+                samples, heads, default_path=True)
+            print(f"bench: sustained_default {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: sustained_default failed: {e!r}", file=sys.stderr)
 
     if "sustained" in phases:
         try:

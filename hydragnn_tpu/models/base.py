@@ -471,8 +471,13 @@ def multihead_loss(
 
     Parity with reference Base.loss_hpweighted (Base.py:343-360): per-head
     loss via the configured loss function, total = sum of per-head losses
-    times normalized task weights.
+    times normalized task weights.  ``loss_function_type: "gaussian_nll"``
+    selects the UQ loss (heads emit [mean, log_sigma] at 2x the label dim;
+    pair with ``Architecture.initial_bias`` — parity-plus over the
+    reference's disabled stub, Base.py:322-341).
     """
+    if cfg.loss_fn == "gaussian_nll":
+        return multihead_loss_nll(cfg, outputs, g)
     loss_fn = loss_function(cfg.loss_fn)
     weights = cfg.norm_task_weights
     total = 0.0

@@ -24,6 +24,19 @@ from hydragnn_tpu.graph import segment
 from hydragnn_tpu.models.base import Base
 
 
+def _fused_gat_enabled() -> bool:
+    """One-pass Pallas attention gate: HYDRAGNN_GAT_FUSED overrides, else
+    it follows the fused aggregation backend selection."""
+    import os
+
+    v = os.environ.get("HYDRAGNN_GAT_FUSED")
+    if v is not None:
+        return v not in ("", "0", "false", "False")
+    from hydragnn_tpu.ops.aggregate import aggr_backend
+
+    return aggr_backend() == "fused"
+
+
 class GATv2Conv(nn.Module):
     out_dim: int  # per-head output dim
     heads: int
@@ -47,6 +60,47 @@ class GATv2Conv(nn.Module):
         def logits(s, t):
             z = nn.leaky_relu(s + t, self.negative_slope)
             return jnp.sum(z.reshape(-1, h, f) * att, axis=-1)  # [., h]
+
+        b_edge, b_self = self._dropout_bits(
+            train, g.senders.shape[0], n, x.dtype)
+
+        perm = g.extras.get("edge_perm_sender") if g.extras else None
+        if perm is not None and _fused_gat_enabled():
+            out = self._fused_attention(xl, xr, att, logits, g, perm,
+                                        b_edge, b_self)
+        else:
+            out = self._composed_attention(xl, xr, logits, g,
+                                           b_edge, b_self)
+
+        if self.concat:
+            out = out.reshape(n, h * f)
+            bias = self.param("bias", nn.initializers.zeros, (h * f,))
+        else:
+            out = jnp.mean(out, axis=1)
+            bias = self.param("bias", nn.initializers.zeros, (f,))
+        return out + bias, pos
+
+    def _dropout_bits(self, train, e_count, n, dtype):
+        """Attention-dropout bits/keep for edges and self-loops (None when
+        inactive) — ONE definition serving both attention paths."""
+        h = self.heads
+        if not (train and self.dropout > 0):
+            return None, None
+        rng = self.make_rng("dropout")
+        keep = 1.0 - self.dropout
+        k1, k2 = jax.random.split(rng)
+        b_edge = (jax.random.bernoulli(k1, keep, (e_count, h))
+                  .astype(dtype) / keep)
+        b_self = (jax.random.bernoulli(k2, keep, (n, h))
+                  .astype(dtype) / keep)
+        return b_edge, b_self
+
+    def _composed_attention(self, xl, xr, logits, g, b_edge, b_self):
+        """Segment-op attention path: separate logits gathers, segment
+        softmax, fused-or-XLA aggregation.  Returns [N, h, f]."""
+        n = xl.shape[0]
+        h, f = self.heads, self.out_dim
+        dst = g.receivers
 
         # gathers whose backward rides the dense sorted scatter instead of
         # XLA's scatter-add (marker-gated; plain gather otherwise)
@@ -74,20 +128,9 @@ class GATv2Conv(nn.Module):
         alpha_edge = exp_edge / jnp.maximum(denom, 1e-16)[dst]
         alpha_self = exp_self / jnp.maximum(denom, 1e-16)
 
-        if train and self.dropout > 0:
-            rng = self.make_rng("dropout")
-            keep = 1.0 - self.dropout
-            k1, k2 = jax.random.split(rng)
-            alpha_edge = (
-                alpha_edge
-                * jax.random.bernoulli(k1, keep, alpha_edge.shape).astype(x.dtype)
-                / keep
-            )
-            alpha_self = (
-                alpha_self
-                * jax.random.bernoulli(k2, keep, alpha_self.shape).astype(x.dtype)
-                / keep
-            )
+        if b_edge is not None:
+            alpha_edge = alpha_edge * b_edge
+            alpha_self = alpha_self * b_self
 
         # out[n] = sum_e alpha[e] * xl[src[e]] — the gather-multiply-
         # segment-sum core; per-head alpha broadcast across the head's f
@@ -95,15 +138,49 @@ class GATv2Conv(nn.Module):
         # Pallas kernel when the batch carries the collate marker)
         w_alpha = jnp.repeat(alpha_edge, f, axis=1)  # [E, h*f]
         out = segment.gather_mul_segment(xl, w_alpha, g)
-        out = out.reshape(n, h, f) + alpha_self[:, :, None] * xl.reshape(n, h, f)
+        return out.reshape(n, h, f) + alpha_self[:, :, None] * xl.reshape(
+            n, h, f)
 
-        if self.concat:
-            out = out.reshape(n, h * f)
-            bias = self.param("bias", nn.initializers.zeros, (h * f,))
-        else:
-            out = jnp.mean(out, axis=1)
-            bias = self.param("bias", nn.initializers.zeros, (f,))
-        return out + bias, pos
+    def _fused_attention(self, xl, xr, att, logits, g, perm, b_edge,
+                         b_self):
+        """One-pass Pallas edge attention (ops/gat_mp.py) + the self-loop
+        merged here in plain jnp.  Numerically the same softmax over
+        {incident edges} U {self} as the composed path; the max shifts are
+        stop_gradient'd (shift invariance) exactly as there.  Returns
+        [N, h, f] in the compute dtype."""
+        from hydragnn_tpu.ops.gat_mp import gat_edge_attention
+
+        n = xl.shape[0]
+        h, f = self.heads, self.out_dim
+
+        # block-diagonal logit matrix (autodiff carries datt_mat -> att)
+        rows = jnp.arange(h * f)
+        att_mat = jnp.zeros((h * f, h), xl.dtype).at[rows, rows // f].set(
+            att.reshape(-1))
+
+        e_count = g.senders.shape[0]
+        if b_edge is None:
+            b_edge = jnp.ones((e_count, h), jnp.float32)
+            b_self = jnp.ones((n, h), jnp.float32)
+
+        acc, m, d = gat_edge_attention(
+            xl, xr, att_mat, g.senders, g.receivers, perm,
+            g.edge_mask, b_edge, (self.negative_slope, f))
+        m = jax.lax.stop_gradient(m)
+
+        e_self = logits(xl, xr)                       # [N, h]
+        m_t = jax.lax.stop_gradient(jnp.maximum(m, e_self))
+        r_e = jnp.exp(m - m_t)
+        r_s = jnp.exp(e_self - m_t)
+        d_t = jnp.maximum(d * r_e + r_s, 1e-16)
+
+        def expand(v):
+            return jnp.repeat(v, f, axis=1)           # [N, h] -> [N, h*f]
+
+        num = acc * expand(r_e) + expand(b_self * r_s) * xl
+        # the kernel accumulates in f32; rejoin the compute-dtype pipeline
+        out = (num / expand(d_t)).astype(xl.dtype)
+        return out.reshape(n, h, f)
 
 
 class GATStack(Base):

@@ -252,6 +252,56 @@ def _align_bucket_group(loader, factor: int) -> None:
         obj.bucket_group = factor * (-(-bg // factor))
 
 
+def _auto_pipeline(train_loader, val_loader, test_loader, stack_factor=1):
+    """Default-on fast-path selection for single-host runs (round-4
+    VERDICT item 7): pick scan chunking K and device residency
+    automatically when the explicit env knobs are unset, so the
+    out-of-the-box `run_training` gets the measured-fast pipeline instead
+    of requiring HYDRAGNN_STEPS_PER_DISPATCH/RESIDENT_DATASET tuning.
+
+    Returns (auto_k, auto_resident).  Conservative by design:
+    - only when every loader reports a length (peeking one batch costs one
+      collate) and the run is single-process;
+    - scan K only when the epoch has >= 8 dispatch units — a unit is
+      ``stack_factor`` raw batches when the mesh path device-stacks them
+      first — so K-stacking (drop_last) can never leave a zero-step epoch
+      and trims at most a quarter of it (shuffling rotates what's dropped);
+    - residency only for >= 32 batches (ResidentDeviceLoader freezes batch
+      COMPOSITION after epoch 0 — harmless at scale, load-bearing for tiny
+      CI runs) and when the staged train+val+test corpus fits the HBM
+      budget (HYDRAGNN_RESIDENT_BUDGET_MB, default 6144).
+    HYDRAGNN_AUTO_PIPELINE=0 disables both.
+    """
+    if os.environ.get("HYDRAGNN_AUTO_PIPELINE", "1") in ("", "0", "false",
+                                                         "False"):
+        return 1, False
+    if jax.process_count() > 1:
+        return 1, False
+    try:
+        n_train = len(train_loader)
+        n_total = n_train + len(val_loader) + len(test_loader)
+    except TypeError:
+        return 1, False
+    n_units = n_train // max(1, stack_factor)
+    if n_units < 8:
+        return 1, False
+    # largest K <= 32 whose drop_last waste is <= 1/8 of the epoch
+    auto_k = 1
+    for k in range(min(32, n_units), 0, -1):
+        if (n_units % k) * 8 <= n_units:
+            auto_k = k
+            break
+    try:
+        first = next(iter(train_loader))
+    except StopIteration:
+        return 1, False
+    batch_bytes = sum(
+        getattr(l, "nbytes", 0) for l in jax.tree_util.tree_leaves(first))
+    budget = env_int("HYDRAGNN_RESIDENT_BUDGET_MB", 6144) * (1 << 20)
+    auto_resident = (n_train >= 32 and batch_bytes * n_total <= budget)
+    return auto_k, auto_resident
+
+
 def make_scan_train_step(
     model: Base,
     cfg: ModelConfig,
@@ -511,6 +561,18 @@ def train_validate_test(
         # gradients and each rank would train a divergent model.  An explicit
         # ``mesh`` (e.g. a HostGroup ensemble-branch mesh) also forces it.
         use_mesh_dp = n_local_devices > 1 or n_proc > 1 or mesh is not None
+    # fast-pipeline defaults (scan chunking + device residency) when the
+    # explicit knobs are unset — see _auto_pipeline.  The mesh path stacks
+    # n_local_devices batches per dispatch unit before any K-stacking.
+    auto_k, auto_resident = 1, False
+    if ("HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ
+            or "HYDRAGNN_RESIDENT_DATASET" not in os.environ):
+        auto_k, auto_resident = _auto_pipeline(
+            train_loader, val_loader, test_loader,
+            stack_factor=n_local_devices if use_mesh_dp else 1)
+    resident_on = (env_flag("HYDRAGNN_RESIDENT_DATASET")
+                   if "HYDRAGNN_RESIDENT_DATASET" in os.environ
+                   else auto_resident)
     if use_mesh_dp:
         from hydragnn_tpu.parallel.mesh import (
             DeviceStackLoader,
@@ -550,7 +612,7 @@ def train_validate_test(
         # step — K steps of cross-host psum per dispatch, amortizing the
         # per-dispatch host latency that multi-host runs otherwise pay
         # per step (docs/SCALING.md "Dispatch overhead")
-        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1))
+        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
             zero_specs=zero_specs, steps=steps_per_dispatch)
@@ -593,7 +655,7 @@ def train_validate_test(
                 val_loader = DevicePrefetcher(val_loader, sharding=eval_shard)
                 test_loader = DevicePrefetcher(
                     test_loader, sharding=eval_shard)
-            if env_flag("HYDRAGNN_RESIDENT_DATASET"):
+            if resident_on:
                 from hydragnn_tpu.data.prefetch import ResidentDeviceLoader
 
                 train_loader = ResidentDeviceLoader(
@@ -603,7 +665,7 @@ def train_validate_test(
                 test_loader = ResidentDeviceLoader(
                     test_loader, sharding=eval_shard)
     else:
-        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1))
+        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
         if steps_per_dispatch > 1:
             # amortize per-step Python dispatch + arg-ingest latency by
             # scanning K train steps inside one executable (the batch
@@ -630,7 +692,7 @@ def train_validate_test(
             train_loader = DevicePrefetcher(train_loader)
             val_loader = DevicePrefetcher(val_loader)
             test_loader = DevicePrefetcher(test_loader)
-        if env_flag("HYDRAGNN_RESIDENT_DATASET"):
+        if resident_on:
             # stage each (stacked) batch to HBM once, replay thereafter —
             # removes steady-state H2D transfer for datasets that fit
             from hydragnn_tpu.data.prefetch import ResidentDeviceLoader
@@ -806,7 +868,9 @@ def test(
             # padded node count equals padded graph count
             mask = gm if output_types[ih] == "graph" else nm
             true_values[ih].append(lab[mask])
-            pred_values[ih].append(out[mask])
+            # gaussian_nll heads emit [mean, log_sigma] at 2x the label
+            # width — the prediction is the mean block
+            pred_values[ih].append(out[mask][:, : lab.shape[-1]])
         if dump_file is not None:
             pickle.dump(
                 {f"head{ih}": {"true": true_values[ih][-1],

@@ -16,14 +16,37 @@ def _sync_small(tree):
     np.asarray(leaf.ravel()[0])
 
 
-def timeit(fn, *args, iters=20):
-    out = fn(*args)
+def timeit(fn, *args, iters=30):
+    """Loop-amortized on-chip timing: the computation is repeated inside
+    ONE compiled fori_loop (null dispatch measured 4.5 ms on the tunneled
+    runtime, flooring any per-call measurement), with the carry threaded
+    through the args (output-sum * 1e-30 perturbation) so LICM cannot
+    hoist it and DCE cannot drop outputs."""
+    from jax import lax
+
+    @jax.jit
+    def run(a):
+        def body(_, a):
+            out = fn(*a)
+            s = jnp.float32(0)
+            for l in jax.tree_util.tree_leaves(out):
+                s = s + jnp.sum(l).astype(jnp.float32)
+            eps = s * 1e-30
+
+            def nudge(x):
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return x + eps.astype(x.dtype)
+                return x
+
+            return jax.tree_util.tree_map(nudge, a)
+        return lax.fori_loop(0, iters, body, a)
+
+    out = run(args)
     _sync_small(out)
     best = float("inf")
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
+        out = run(args)
         _sync_small(out)
         best = min(best, (time.perf_counter() - t0) / iters)
     return best * 1e3
@@ -45,9 +68,12 @@ def main():
     model = create_model(cfg)
     params = state.params
 
+    bstats = state.batch_stats
+
     @jax.jit
     def fwd(p):
-        return model.apply({"params": p}, batch, train=False)
+        return model.apply(
+            {"params": p, "batch_stats": bstats}, batch, train=False)
 
     print(f"fwd only: {timeit(fwd, params):.2f} ms", flush=True)
 
