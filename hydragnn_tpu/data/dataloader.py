@@ -114,31 +114,46 @@ class GraphDataLoader:
             return n // self.batch_size
         return int(math.ceil(n / self.batch_size))
 
-    def _batch_plan(self) -> List[Tuple[List[GraphSample], PadSpec]]:
-        """The epoch's (samples, pad_spec) per batch — cheap host metadata.
-
-        Separated from collation so PrefetchLoader can run collations on a
-        thread pool in plan order (parallel but order-preserving: stacked
-        device groups must not straddle bucket boundaries).
-        """
+    def _index_plan(self) -> List[Tuple[np.ndarray, PadSpec]]:
+        """The epoch's (sample-index array, pad_spec) per batch — cheap
+        host metadata, and the process-pool collate protocol (index arrays
+        are tiny to pickle; samples reach forked workers by inheritance).
+        Also refreshes the padding-efficiency counters."""
         order = self._local_indices()
         nb = len(self)
         self.real_nodes = 0
         self.padded_nodes = 0
-        plan: List[Tuple[List[GraphSample], PadSpec]] = []
+        plan: List[Tuple[np.ndarray, PadSpec]] = []
         for g0 in range(0, nb, self.bucket_group):
-            group = [
-                [self.samples[i]
-                 for i in order[b * self.batch_size:(b + 1) * self.batch_size]]
-                for b in range(g0, min(g0 + self.bucket_group, nb))
-            ]
-            spec = (self.pad_spec if len(self.pad_specs) == 1
-                    else self._pick_spec(group))
-            for batch in group:
-                self.real_nodes += sum(s.num_nodes for s in batch)
+            idxs = [order[b * self.batch_size:(b + 1) * self.batch_size]
+                    for b in range(g0, min(g0 + self.bucket_group, nb))]
+            if len(self.pad_specs) == 1:
+                spec = self.pad_spec
+            else:
+                spec = self._pick_spec(
+                    [[self.samples[i] for i in ix] for ix in idxs])
+            for ix in idxs:
+                self.real_nodes += sum(
+                    self.samples[i].num_nodes for i in ix)
                 self.padded_nodes += spec.num_nodes
-                plan.append((batch, spec))
+                plan.append((np.asarray(ix), spec))
         return plan
+
+    def _batch_plan(self) -> List[Tuple[List[GraphSample], PadSpec]]:
+        """The epoch's (samples, pad_spec) per batch — the thread-pool
+        collate protocol (PrefetchLoader runs collations in plan order:
+        parallel but order-preserving, since stacked device groups must
+        not straddle bucket boundaries).  Thin wrapper over
+        :meth:`_index_plan`, the single source of batching truth."""
+        return [([self.samples[i] for i in ix], spec)
+                for ix, spec in self._index_plan()]
+
+    def _collate_index_item(
+        self, item: Tuple[np.ndarray, PadSpec]
+    ) -> GraphBatch:
+        idx, spec = item
+        return self._collate_plan_item(
+            ([self.samples[i] for i in idx], spec))
 
     def _collate_plan_item(
         self, item: Tuple[List[GraphSample], PadSpec]
@@ -293,6 +308,16 @@ def create_dataloaders(
         bucket_group=bucket_group,
     )
     loaders = (mk(trainset, True), mk(valset, False), mk(testset, False))
+    # HYDRAGNN_COLLATE_PROCS>0: collation on forked PROCESS workers (true
+    # parallelism; the thread pool below is GIL-bound for numpy-heavy
+    # collate — reference HydraDataLoader's process workers + affinity,
+    # load_data.py:94-204)
+    n_procs = int(os.getenv("HYDRAGNN_COLLATE_PROCS", "0"))
+    if n_procs > 0:
+        from hydragnn_tpu.data.prefetch import ProcessPrefetchLoader
+
+        return tuple(
+            ProcessPrefetchLoader(l, num_workers=n_procs) for l in loaders)
     # HYDRAGNN_NUM_WORKERS>0 overlaps host-side collation with device compute
     # (reference HYDRAGNN_NUM_WORKERS DataLoader workers, load_data.py:245)
     n_workers = int(os.getenv("HYDRAGNN_NUM_WORKERS", "0"))

@@ -117,6 +117,47 @@ def add_dimenet_extras(batch, max_triplets: int):
     mask = np.zeros((max_triplets,), np.float32)
     mask[:t] = 1.0
     extras["dn_triplet_mask"] = mask
+
+    # fused-triplet window marker: the interaction's triplet contraction is
+    # message passing in EDGE space (x_kj[idx_kj] * sbf scattered over
+    # idx_ji) and can ride the W-window fused kernel when every graph's
+    # edge-id span fits the window.  Encoded in the marker array's SHAPE
+    # (static under jit): shape[0] == window.  Gated like collate's
+    # edge_perm_sender: only under the fused backend.
+    from hydragnn_tpu.ops.aggregate import aggr_backend
+
+    # OPT-IN (HYDRAGNN_DIMENET_FUSED_TRI=1): measured SLOWER than the XLA
+    # composed path on the v5e sweep config (61.9 vs 56.9 ms/step; larger
+    # block variants 60.4-61.0) — the T->E schedule's output-block count
+    # (E/128 blocks for only ~2.3 triplets/edge) pays more per-step
+    # overhead than the fused gather+scatter saves.  Kept as a tested
+    # capability for shapes with denser triplet fan-in.
+    from hydragnn_tpu.utils.env import env_flag
+
+    if (aggr_backend() == "fused" and t
+            and env_flag("HYDRAGNN_DIMENET_FUSED_TRI")):
+        from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK
+
+        gid_of_edge = np.asarray(batch.node_gid)[
+            np.asarray(batch.receivers)[real]].astype(np.int64)
+        blocks = (real_ids // _NODE_BLOCK).astype(np.int64)
+        ng = int(gid_of_edge.max()) + 1
+        lo = np.full(ng, np.iinfo(np.int64).max)
+        hi = np.full(ng, -1)
+        np.minimum.at(lo, gid_of_edge, blocks)
+        np.maximum.at(hi, gid_of_edge, blocks)
+        occ = hi >= 0
+        span = int((hi[occ] - lo[occ]).max()) if occ.any() else 0
+        # FIXED (5,) marker shape: per-batch-varying extras shapes (or
+        # presence) would break DeviceStackLoader's tree-map np.stack and
+        # force a retrace per distinct window — the user opted in, so a
+        # batch whose graphs exceed the window is an error, not a fallback
+        if span > 2:
+            raise ValueError(
+                f"HYDRAGNN_DIMENET_FUSED_TRI: a graph spans {span} edge "
+                f"blocks (> 2); the 5-block window cannot cover it — "
+                f"unset the knob for this dataset")
+        extras["dn_tri_window"] = np.zeros((5,), np.float32)
     return batch.replace(extras=extras)
 
 
@@ -314,9 +355,11 @@ class InteractionPPBlock(nn.Module):
     num_before_skip: int
     num_after_skip: int
     sorted_hint: bool = False  # idx_ji is nondecreasing (builder order)
+    tri_window: int = 0  # >0: fused edge-space kernel window (collate-vouched)
 
     @nn.compact
-    def __call__(self, x_edge, rbf, sbf, idx_kj, idx_ji, triplet_mask):
+    def __call__(self, x_edge, rbf, sbf, idx_kj, idx_ji, triplet_mask,
+                 perm_kj=None):
         e = x_edge.shape[0]
         x_ji = _silu(nn.Dense(self.hidden, name="lin_ji")(x_edge))
         x_kj = _silu(nn.Dense(self.hidden, name="lin_kj")(x_edge))
@@ -328,19 +371,31 @@ class InteractionPPBlock(nn.Module):
 
         sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
         sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
-        # NOTE: this gather deliberately does NOT use gather_perm — its
-        # backward (scatter-add over idx_kj) fuses into the surrounding
-        # elementwise cotangent under XLA, and routing it through the dense
-        # sorted scatter (which needs an extra g[perm] gather first) was
-        # measured 12 ms/step SLOWER on the v5e sweep config.  The
-        # rbf->triplet gather in spherical_basis keeps the perm: its
-        # backward only runs under pos-grad (force training), where the
-        # dense path halves the cost (tools/profile_dimenet*.py, round 4).
-        msg = x_kj[idx_kj] * sbf_emb * triplet_mask[:, None]
-        # build_triplets emits idx_ji in nondecreasing order (outer loop
-        # over edge ids) — the dense-schedule sorted scatter applies
-        x_kj = segment.sorted_segment_sum(
-            msg, idx_ji, e, sorted_hint=self.sorted_hint)
+        if self.tri_window:
+            # the triplet contraction IS message passing in EDGE space:
+            # out[e'] = sum_{t: ji(t)=e'} x_kj[kj(t)] * sbf_emb[t] — one
+            # fused W-window pass (fwd AND its dx backward via perm_kj)
+            # instead of gather + [T, D] materialization + sorted scatter
+            from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
+
+            x_kj = gather_mul_segment_sum(
+                x_kj, sbf_emb * triplet_mask[:, None], idx_kj, idx_ji,
+                perm_kj, self.tri_window)
+        else:
+            # NOTE: this gather deliberately does NOT use gather_perm — its
+            # backward (scatter-add over idx_kj) fuses into the surrounding
+            # elementwise cotangent under XLA, and routing it through the
+            # dense sorted scatter (which needs an extra g[perm] gather
+            # first) was measured 12 ms/step SLOWER on the v5e sweep
+            # config.  The rbf->triplet gather in spherical_basis keeps the
+            # perm: its backward only runs under pos-grad (force training),
+            # where the dense path halves the cost (tools/profile_dimenet*.py).
+            msg = x_kj[idx_kj] * sbf_emb * triplet_mask[:, None]
+            # build_triplets emits idx_ji in nondecreasing order (outer
+            # loop over edge ids) — the dense-schedule sorted scatter
+            # applies
+            x_kj = segment.sorted_segment_sum(
+                msg, idx_ji, e, sorted_hint=self.sorted_hint)
         x_kj = _silu(nn.Dense(self.hidden, use_bias=False, name="lin_up")(x_kj))
 
         h = x_ji + x_kj
@@ -434,6 +489,9 @@ class DimeNetConv(nn.Module):
             )
         )
         sorted_hint = bool(g.extras and "edge_perm_sender" in g.extras)
+        # window encoded in the marker array's SHAPE (static under jit)
+        tri_w = ex.get("dn_tri_window")
+        tri_window = int(tri_w.shape[0]) if tri_w is not None else 0
         x_edge = InteractionPPBlock(
             hidden,
             self.int_emb_size,
@@ -441,8 +499,9 @@ class DimeNetConv(nn.Module):
             self.num_before_skip,
             self.num_after_skip,
             sorted_hint=sorted_hint,
+            tri_window=tri_window,
             name="interaction",
-        )(x_edge, rbf, sbf, idx_kj, idx_ji, tmask)
+        )(x_edge, rbf, sbf, idx_kj, idx_ji, tmask, perm_kj=perm_kj)
         out = OutputPPBlock(
             hidden, self.out_emb_size, self.out_dim, num_layers=1,
             sorted_hint=sorted_hint, name="output"
@@ -455,7 +514,20 @@ class DIMEStack(Base):
 
     def make_conv(self, name, in_dim, out_dim, last_layer):
         c = self.cfg
-        return DimeNetConv(
+        # HYDRAGNN_DIMENET_REMAT=1 rematerializes each conv in the
+        # backward.  Measured and REJECTED as a default on the v5e sweep
+        # config (92.0 vs 65.0 ms/step): although the step moves ~9.4 GB
+        # of residuals (round-4 attribution), remat re-evaluates the
+        # spherical basis inside every layer's backward — losing the
+        # cross-layer CSE that normally computes it once — and the
+        # recompute costs more than the saved HBM round-trips.  Kept as an
+        # opt-in for memory-limited configs (wide OC20-scale batches).
+        from hydragnn_tpu.utils.env import env_flag
+
+        cls = DimeNetConv
+        if env_flag("HYDRAGNN_DIMENET_REMAT"):
+            cls = nn.remat(DimeNetConv, static_argnums=(3,))
+        return cls(
             in_dim=in_dim,
             out_dim=out_dim,
             num_radial=c.num_radial,

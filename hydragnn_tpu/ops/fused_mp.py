@@ -88,16 +88,18 @@ def _dense_schedule(sorted_ids, n_blocks, bn, be, n_eblocks):
     return step_i, step_eb, acc_valid, is_first, s_max
 
 
-def _fwd_kernel(has_w, si_ref, se_ref, av_ref, fi_ref, send_ref, recv_ref,
-                *rest):
+def _fwd_kernel(has_w, window, si_ref, se_ref, av_ref, fi_ref, send_ref,
+                recv_ref, *rest):
     from jax.experimental import pallas as pl
 
     if has_w:
-        w_ref, xm1_ref, x0_ref, xp1_ref, out_ref = rest
+        w_ref = rest[0]
     else:
         # w omitted: messages are the gathered features themselves, scaled
         # by the scalar edge mask (GIN/MFC-style sum aggregation)
-        mask_ref, xm1_ref, x0_ref, xp1_ref, out_ref = rest
+        mask_ref = rest[0]
+    xwin_refs = rest[1:1 + window]
+    out_ref = rest[1 + window]
 
     s = pl.program_id(0)
     i = si_ref[s]
@@ -110,16 +112,17 @@ def _fwd_kernel(has_w, si_ref, se_ref, av_ref, fi_ref, send_ref, recv_ref,
     def _acc():
         bn = out_ref.shape[0]
         be = send_ref.shape[0]
-        # window rows are blocks [i-1, i, i+1]; at the boundaries the
+        # window rows are blocks [i-hw .. i+hw]; at the boundaries the
         # clamped duplicate slots are unreachable because the base stays
-        # (i-1)*bn (negative at i=0 is fine — senders then map into the
-        # x0/xp1 rows, never the duplicated xm1 rows)
-        base = (i - 1) * bn
+        # (i-hw)*bn (negative at the low edge is fine — senders then map
+        # into the later window rows, never the duplicated ones)
+        hw = window // 2
+        base = (i - hw) * bn
         sloc = send_ref[:] - base                       # [BE, 1]
         onehot_s = (sloc == jax.lax.broadcasted_iota(
-            jnp.int32, (be, 3 * bn), 1)).astype(jnp.float32)
+            jnp.int32, (be, window * bn), 1)).astype(jnp.float32)
         xcat = jnp.concatenate(
-            [xm1_ref[:], x0_ref[:], xp1_ref[:]], axis=0).astype(jnp.float32)
+            [r[:] for r in xwin_refs], axis=0).astype(jnp.float32)
         msgs = jax.lax.dot_general(
             onehot_s, xcat, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [BE, F]
@@ -135,7 +138,7 @@ def _fwd_kernel(has_w, si_ref, se_ref, av_ref, fi_ref, send_ref, recv_ref,
             preferred_element_type=jnp.float32)          # [BN, F]
 
 
-def _fused_impl(x, w, senders, receivers, interpret, mask=None):
+def _fused_impl(x, w, senders, receivers, interpret, mask=None, window=3):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -168,15 +171,13 @@ def _fused_impl(x, w, senders, receivers, interpret, mask=None):
     def eix(s, si, se, av, fi):
         return (se[s], 0)
 
-    def xm1(s, si, se, av, fi):
-        return (jnp.maximum(si[s] - 1, 0), 0)
+    def xoff(off):
+        def f(s, si, se, av, fi):
+            return (jnp.clip(si[s] + off, 0, n_blocks - 1), 0)
+        return f
 
-    def x0(s, si, se, av, fi):
-        return (si[s], 0)
-
-    def xp1(s, si, se, av, fi):
-        return (jnp.minimum(si[s] + 1, n_blocks - 1), 0)
-
+    assert window % 2 == 1, "window must be odd"
+    hw = window // 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(s_max,),
@@ -184,25 +185,24 @@ def _fused_impl(x, w, senders, receivers, interpret, mask=None):
             pl.BlockSpec((be, 1), eix),
             pl.BlockSpec((be, 1), eix),
             pl.BlockSpec((be, f_pad if has_w else 1), eix),
-            pl.BlockSpec((bn, f_pad), xm1),
-            pl.BlockSpec((bn, f_pad), x0),
-            pl.BlockSpec((bn, f_pad), xp1),
-        ],
+        ] + [pl.BlockSpec((bn, f_pad), xoff(o))
+             for o in range(-hw, hw + 1)],
         out_specs=pl.BlockSpec(
             (bn, f_pad), lambda s, si, se, av, fi: (si[s], 0)),
     )
     out = pl.pallas_call(
-        functools.partial(_fwd_kernel, has_w),
+        functools.partial(_fwd_kernel, has_w, window),
         out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(step_i, step_eb, acc_valid, is_first, send_p, recv_p, w_p,
-      x_p, x_p, x_p)
+      *([x_p] * window))
     return out[:n, :f].astype(x.dtype)
 
 
-@jax.custom_vjp
-def gather_mul_segment_sum(x, w, senders, receivers, sender_perm):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
+                           window=3):
     """``out[n, f] = sum_{e: recv[e]=n} x[send[e], f] * w[e, f]``.
 
     REQUIRES (collate invariants — see module docstring): nondecreasing
@@ -214,17 +214,23 @@ def gather_mul_segment_sum(x, w, senders, receivers, sender_perm):
     per batch) used by the backward; pass None for a forward-only call.
     Exact (f32 accumulation, deterministic order); differentiable wrt x
     and w.
+
+    ``window`` (odd, static) widens the sender one-hot window: segment i
+    gathers from blocks i-w//2..i+w//2 — 3 suffices for node-space message
+    passing (graphs within one node block); DimeNet's triplet interaction
+    runs in EDGE space where graphs span up to ~2 blocks and needs 5.
     """
     interpret = jax.default_backend() != "tpu"
-    return _fused_impl(x, w, senders, receivers, interpret)
+    return _fused_impl(x, w, senders, receivers, interpret, window=window)
 
 
-def _vjp_fwd(x, w, senders, receivers, sender_perm):
-    out = gather_mul_segment_sum(x, w, senders, receivers, sender_perm)
+def _vjp_fwd(x, w, senders, receivers, sender_perm, window=3):
+    out = gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
+                                 window)
     return out, (x, w, senders, receivers, sender_perm)
 
 
-def _vjp_bwd(res, g):
+def _vjp_bwd(window, res, g):
     x, w, senders, receivers, sender_perm = res
     # dL/dw[e] = x[send[e]] * g[recv[e]] — plain gathers (recv gather is
     # over sorted indices)
@@ -237,7 +243,7 @@ def _vjp_bwd(res, g):
     dx = _fused_impl(
         g.astype(jnp.float32), w[sender_perm].astype(jnp.float32),
         receivers[sender_perm], senders[sender_perm],
-        jax.default_backend() != "tpu")
+        jax.default_backend() != "tpu", window=window)
     return dx.astype(x.dtype), dw, None, None, None
 
 

@@ -297,6 +297,17 @@ def _auto_pipeline(train_loader, val_loader, test_loader, stack_factor=1):
         return 1, False
     batch_bytes = sum(
         getattr(l, "nbytes", 0) for l in jax.tree_util.tree_leaves(first))
+    # bucketed loaders: the peeked batch may come from the SMALLEST
+    # bucket; scale to the worst-case spec so residency never turns on
+    # from an underestimate and OOMs HBM during staging
+    base = train_loader
+    while base is not None and not hasattr(base, "pad_specs"):
+        base = getattr(base, "loader", None)
+    if base is not None and len(base.pad_specs) > 1:
+        lo, hi = base.pad_specs[0], base.pad_specs[-1]
+        batch_bytes *= max(
+            hi.num_nodes / max(lo.num_nodes, 1),
+            hi.num_edges / max(lo.num_edges, 1))
     budget = env_int("HYDRAGNN_RESIDENT_BUDGET_MB", 6144) * (1 << 20)
     auto_resident = (n_train >= 32 and batch_bytes * n_total <= budget)
     return auto_k, auto_resident
@@ -738,8 +749,15 @@ def train_validate_test(
     # profiler.step() per train batch, train_validate_test.py:503)
     profiler = Profiler(profile_config, log_name, logs_dir)
 
-    history: Dict[str, List[float]] = {
-        "train": [], "val": [], "test": [], "lr": [], "epoch_time": []}
+    history: Dict[str, Any] = {
+        "train": [], "val": [], "test": [], "lr": [], "epoch_time": [],
+        # the fast-pipeline configuration THIS run actually used — exact
+        # provenance for bench/telemetry (re-deriving it afterwards can
+        # disagree near the residency budget boundary)
+        "pipeline": {"steps_per_dispatch": steps_per_dispatch,
+                     "resident": bool(resident_on),
+                     "auto_selected":
+                         "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
 
     for epoch in range(num_epoch):

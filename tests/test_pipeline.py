@@ -327,3 +327,45 @@ def test_trainer_mesh_knobs_smoke(monkeypatch, tmp_path):
     )
     losses = hist["train"]
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_process_collate_matches_sequential():
+    """ProcessPrefetchLoader (forked collate workers) yields batch-for-batch
+    the same arrays, in the same order, as the plain loader; a second epoch
+    (reused pool) reshuffles identically to the sequential loader."""
+    import numpy as np
+
+    from hydragnn_tpu.data.dataloader import GraphDataLoader
+    from hydragnn_tpu.data.prefetch import ProcessPrefetchLoader
+    from hydragnn_tpu.graph.batch import GraphSample, HeadSpec
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(40):
+        pos = rng.rand(7, 3).astype(np.float32) * 2
+        samples.append(GraphSample(
+            x=rng.rand(7, 2).astype(np.float32), pos=pos,
+            edge_index=radius_graph(pos, 1.3, 8),
+            graph_y=rng.rand(1).astype(np.float32)))
+    heads = [HeadSpec("e", "graph", 1)]
+
+    def mk():
+        return GraphDataLoader(samples, heads, 8, shuffle=True, seed=3)
+
+    plain = mk()
+    proc = ProcessPrefetchLoader(mk(), num_workers=2)
+    try:
+        for epoch in (0, 1):
+            plain.set_epoch(epoch)
+            proc.set_epoch(epoch)
+            got = list(proc)
+            want = list(plain)
+            assert len(got) == len(want) == len(plain)
+            for a, b in zip(got, want):
+                for la, lb in zip(jax.tree_util.tree_leaves(a),
+                                  jax.tree_util.tree_leaves(b)):
+                    np.testing.assert_array_equal(
+                        np.asarray(la), np.asarray(lb))
+    finally:
+        proc.close()

@@ -1,0 +1,271 @@
+"""Torch-twin convergence A/B (round-4 VERDICT item 4).
+
+Trains the SAME architecture on the SAME generated corpora in both
+frameworks and records side-by-side val/test MAE — the only realization of
+BASELINE.md's "matching val MAE" available in a zero-egress environment
+(PyG is absent so the actual reference cannot run; the torch twins in
+tests/test_weight_port.py are reference-keyed and forward-parity-verified
+against the flax stacks).
+
+Subcommands:
+  torch-qm9   train the torch SchNet twin (flagship shape: hidden 64,
+              4 interactions, 50 gaussians) on the Morse-QM9 corpus, CPU
+  flax-qm9    the flax side = examples/qm9/train.py (run on the TPU)
+  torch-lj    torch PNA twin on the periodic-LJ corpus with the
+              reference's un-normalized force self-consistency loss
+  flax-lj     the flax side = examples/LennardJones/train.py
+
+Protocol pinned to the flax example defaults: synthesize seed 0, the SAME
+split_dataset split, batch 64 (LJ: 32), AdamW lr 1e-3, ReduceLROnPlateau
+(factor 0.5, patience 5, min_lr 1e-5), identical epoch counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+
+def _load_example(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_ab", os.path.join(_REPO, "examples", name, "train.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# torch side
+# ---------------------------------------------------------------------------
+
+
+def _torch_batches(samples, batch_size, rng):
+    """Shuffled minibatches as torch tensors (no padding needed on CPU)."""
+    import torch
+
+    order = rng.permutation(len(samples))
+    for i in range(0, len(order), batch_size):
+        chunk = [samples[j] for j in order[i:i + batch_size]]
+        xs, poss, eis, gids, ys, fys, scs = [], [], [], [], [], [], []
+        off = 0
+        for gi, s in enumerate(chunk):
+            n = s.num_nodes
+            xs.append(np.asarray(s.x, np.float32))
+            poss.append(np.asarray(s.pos, np.float32))
+            eis.append(np.asarray(s.edge_index) + off)
+            gids.append(np.full(n, gi))
+            ys.append(np.asarray(s.graph_y, np.float32))
+            if s.node_y is not None:
+                fys.append(np.asarray(s.node_y, np.float32))
+            if s.extras and "grad_energy_post_scaling_factor" in s.extras:
+                scs.append(np.asarray(
+                    s.extras["grad_energy_post_scaling_factor"], np.float32))
+            off += n
+        yield (torch.from_numpy(np.concatenate(xs)),
+               torch.from_numpy(np.concatenate(eis, 1).astype(np.int64)),
+               torch.from_numpy(np.concatenate(poss)),
+               torch.from_numpy(np.concatenate(gids).astype(np.int64)),
+               len(chunk),
+               torch.from_numpy(np.stack(ys)),
+               torch.from_numpy(np.concatenate(fys)) if fys else None,
+               torch.from_numpy(np.concatenate(scs)) if scs else None)
+
+
+def torch_qm9(num_mols: int, num_epoch: int, seed: int = 0):
+    import torch
+    import torch.nn as tnn
+
+    import test_weight_port as twp
+    from hydragnn_tpu.data.splitting import split_dataset
+
+    qm9 = _load_example("qm9")
+    samples = qm9.synthesize_molecules(num_mols, seed=seed, radius=2.0)
+    train, val, tst = split_dataset(samples, 0.8)
+
+    # flagship shape (examples/qm9/qm9.json): hidden 64, 4 interactions,
+    # 50 gaussians, cutoff 2.0, shared MLP 2x64, head 2x[64,64] -> 1
+    twp.HIDDEN = 64
+    conv = lambda din, dout: twp.TwinSchNet(
+        din, dout, num_gaussians=50, num_filters=64, cutoff=2.0)
+    model = twp.TorchTwinModel(
+        conv, with_bn=False, heads=("graph",), num_layers=4,
+        shared=(64, 64), headlayers=(64, 64), in_dim=1)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
+        opt, factor=0.5, patience=5, min_lr=1e-5)
+
+    def eval_mse(dataset):
+        model.eval()
+        errs, maes, n = 0.0, 0.0, 0
+        with torch.no_grad():
+            for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(
+                    dataset, 64, np.random.RandomState(0)):
+                out = model(x, ei, pos, gid, ng)[0]
+                errs += float(((out - y) ** 2).sum())
+                maes += float((out - y).abs().sum())
+                n += ng
+        return errs / max(n, 1), maes / max(n, 1)
+
+    rng = np.random.RandomState(1)
+    hist = []
+    best_val = float("inf")
+    t0 = time.time()
+    for epoch in range(num_epoch):
+        model.train()
+        for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(train, 64, rng):
+            opt.zero_grad()
+            out = model(x, ei, pos, gid, ng)[0]
+            loss = ((out - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+        val_mse, val_mae = eval_mse(val)
+        best_val = min(best_val, val_mse)
+        sched.step(val_mse)
+        hist.append(round(val_mse, 5))
+        print(f"epoch {epoch}: val mse {val_mse:.5f}", flush=True)
+    test_mse, test_mae = eval_mse(tst)
+    return {
+        "framework": "torch-twin (reference-keyed TwinSchNet, CPU)",
+        "dataset": f"Morse-QM9 {num_mols} molecules (seed {seed})",
+        "epochs": num_epoch,
+        "wall_clock_s": round(time.time() - t0, 1),
+        "val_mse_first_epoch": hist[0],
+        "val_mse_best": round(best_val, 5),
+        "test_mse": round(test_mse, 5),
+        "test_energy_mae_standardized": round(test_mae, 5),
+        "val_mse_trajectory": hist,
+    }
+
+
+def torch_lj(num_configs: int, num_epoch: int, seed: int = 0):
+    """PNA twin, energy + force heads, with the reference's un-normalized
+    sum-abs energy-gradient self-consistency term (the convention under
+    test: does it cap force MAE in torch the way it does in flax?)."""
+    import tempfile
+
+    import torch
+    import torch.nn as tnn
+
+    import test_weight_port as twp
+    from hydragnn_tpu.data.splitting import split_dataset
+
+    # generate the SAME corpus the flax LJ example trains on
+    gd_spec = importlib.util.spec_from_file_location(
+        "lj_generate_ab",
+        os.path.join(_REPO, "examples", "LennardJones", "generate_data.py"))
+    gd = importlib.util.module_from_spec(gd_spec)
+    gd_spec.loader.exec_module(gd)
+    lj = _load_example("LennardJones")
+    data_dir = os.path.join(tempfile.mkdtemp(), "data")
+    gd.generate(data_dir, num_configs=num_configs)
+    ds = lj.LJDataset(data_dir)
+    samples = list(ds.dataset)
+    train, val, tst = split_dataset(samples, 0.8)
+
+    # PNA degree statistics from the training split (flax finalize() does
+    # the same); the twin reads them from module globals
+    deg = np.concatenate([
+        np.bincount(np.asarray(s.edge_index[1]), minlength=s.num_nodes)
+        for s in train])
+    twp.AVG_DEG_LOG = float(np.log(deg + 1.0).mean())
+    twp.AVG_DEG_LIN = float(deg.mean())
+    twp.HIDDEN = 32
+    model = twp.TorchTwinModel(
+        twp.TwinPNA, with_bn=True, heads=("graph", "node"), num_layers=4,
+        shared=(32, 32), headlayers=(32, 32), in_dim=3)
+    # LJ node head predicts 3 force components (the twin default is 1-dim)
+    model.heads_NN[1].mlp[0][-1] = tnn.Linear(32, 3)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
+        opt, factor=0.5, patience=5, min_lr=1e-5)
+
+    def run_eval(dataset):
+        model.eval()
+        e_mae = f_mae = tot = 0.0
+        n = nn_f = 0
+        for x, ei, pos, gid, ng, y, fy, _sc in _torch_batches(
+                dataset, 16, np.random.RandomState(0)):
+            with torch.no_grad():
+                outs = model(x, ei, pos, gid, ng)
+            e_mae += float((outs[0] - y).abs().sum())
+            f_mae += float((outs[1] - fy).abs().sum())
+            tot += float(((outs[0] - y) ** 2).mean()
+                         + ((outs[1] - fy) ** 2).mean()) * ng
+            n += ng
+            nn_f += fy.numel()
+        return e_mae / max(n, 1), f_mae / max(nn_f, 1), tot / max(n, 1)
+
+    rng = np.random.RandomState(1)
+    t0 = time.time()
+    hist = []
+    for epoch in range(num_epoch):
+        model.train()
+        for x, ei, pos, gid, ng, y, fy, sc in _torch_batches(train, 16, rng):
+            opt.zero_grad()
+            pos = pos.clone().requires_grad_(True)
+            outs = model(x, ei, pos, gid, ng)
+            e_out, f_out = outs[0], outs[1]
+            loss = (((e_out - y) ** 2).mean()
+                    + ((f_out - fy) ** 2).mean())
+            # reference convention (train_validate_test.py:478-488):
+            # un-normalized sum |dE/dpos * scale + F_label|.  For PNA the
+            # conv consumes PRECOMPUTED edge lengths/descriptors, so
+            # dE/dpos is exactly zero in BOTH frameworks (the reference's
+            # pre-transformed edge_attr is just as constant) and the term
+            # is a large constant |F| sum — allow_unused mirrors that.
+            grads = torch.autograd.grad(
+                e_out.sum(), pos, create_graph=True, allow_unused=True)[0]
+            if grads is None:
+                grads = torch.zeros_like(pos)
+            loss = loss + (grads * sc + fy).abs().sum()
+            loss.backward()
+            opt.step()
+        e_mae, f_mae, val_mse = run_eval(val)
+        sched.step(val_mse)
+        hist.append(round(val_mse, 4))
+        print(f"epoch {epoch}: val mse {val_mse:.4f} "
+              f"E-mae {e_mae:.4f} F-mae {f_mae:.4f}", flush=True)
+    e_mae, f_mae, test_mse = run_eval(tst)
+    return {
+        "framework": "torch-twin (reference-keyed TwinPNA, CPU)",
+        "dataset": f"periodic-LJ {num_configs} configs",
+        "epochs": num_epoch,
+        "wall_clock_s": round(time.time() - t0, 1),
+        "test_mse": round(test_mse, 5),
+        "head_mae": {"total_energy": round(e_mae, 4),
+                     "atomic_forces": round(f_mae, 4)},
+        "val_mse_trajectory": hist,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cmd", choices=["torch-qm9", "torch-lj"])
+    ap.add_argument("--num", type=int, default=8000)
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.cmd == "torch-qm9":
+        res = torch_qm9(args.num, args.epochs)
+    else:
+        res = torch_lj(args.num, args.epochs)
+    print(json.dumps(res, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""Multi-host dispatch-overhead characterization (round-4 VERDICT item 8).
+
+Launches N jax.distributed CPU processes (1/2/4/8), each holding one
+virtual device of a global DP mesh, and times the scan-chunked global-mesh
+train step at K = 1/8/32 steps-per-dispatch.  The quantity recorded is the
+per-STEP wall cost as a function of process count and K — the number that
+predicts whether the single-chip sustained throughput survives a real pod
+(every per-dispatch host cost is paid once per K steps; cross-host psum
+happens every step inside the scan).
+
+Writes docs-ready JSON to stdout; drive with:
+    python tools/measure_dispatch_overhead.py [--out file.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r'''
+import json, os, sys, time
+rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+if world > 1:
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=world, process_id=rank)
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax.numpy as jnp
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import create_train_state
+from hydragnn_tpu.parallel.mesh import (
+    make_dp_train_step, make_mesh, mesh_dp_axes, replicate_state)
+
+rng = np.random.RandomState(0)
+samples = []
+for _ in range(32):
+    pos = rng.rand(12, 3).astype(np.float32) * 3.0
+    samples.append(GraphSample(
+        x=rng.rand(12, 1).astype(np.float32), pos=pos,
+        edge_index=radius_graph(pos, 1.6, 10),
+        graph_y=rng.rand(1).astype(np.float32)))
+pad = PadSpec.for_batch(32, 12, max(s.num_edges for s in samples))
+batch = collate(samples, pad, [HeadSpec("e", "graph", 1)])
+
+cfg = ModelConfig(
+    model_type="SAGE", input_dim=1, hidden_dim=32, output_dim=(1,),
+    output_type=("graph",), graph_head=GraphHeadCfg(1, 32, 1, (32,)),
+    node_head=None, task_weights=(1.0,), num_conv_layers=2)
+model = create_model(cfg)
+opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+
+mesh = make_mesh()
+axes = mesh_dp_axes(mesh)
+
+results = {}
+from jax.sharding import NamedSharding, PartitionSpec as P
+for K in (1, 8, 32):
+    step = make_dp_train_step(model, cfg, opt, mesh, None, axis=axes,
+                              steps=K)
+    # build the global superbatch by hand: each process contributes its
+    # one-device slice of the leading device axis ([K, D, ...] when
+    # scanning, [D, ...] otherwise)
+    local = jax.tree_util.tree_map(lambda x: np.asarray(x)[None], batch)
+    if K > 1:
+        local = jax.tree_util.tree_map(
+            lambda x: np.repeat(x[None], K, 0), local)
+        spec = P(None, axes)
+    else:
+        spec = P(axes)
+    sharding = NamedSharding(mesh, spec)
+    gbatch = jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        local)
+    # fresh replicated state per K: the step donates its state argument,
+    # so a shared one would be consumed by the first variant
+    st = replicate_state(create_train_state(model, batch, opt), mesh)
+    st, m = step(st, gbatch)        # compile
+    np.asarray(jax.device_get(m["loss"]))
+    # cross-host CPU psum makes big-K dispatches seconds long on the gloo
+    # fabric; fewer repeats keep the matrix tractable at larger worlds
+    n_disp = 30 if K == 1 else (10 if world <= 2 else 4)
+    t0 = time.perf_counter()
+    for _ in range(n_disp):
+        st, m = step(st, gbatch)
+    np.asarray(jax.device_get(m["loss"]))
+    dt = time.perf_counter() - t0
+    results[str(K)] = {
+        "per_dispatch_ms": round(dt / n_disp * 1e3, 3),
+        "per_step_ms": round(dt / n_disp / K * 1e3, 3),
+    }
+
+if rank == 0:
+    print("RESULT " + json.dumps(results), flush=True)
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def run_world(world: int):
+    port = _free_port()
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(_WORKER % {"repo": _REPO})
+        path = f.name
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, path, str(r), str(world), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for r in range(world)
+    ]
+    out0 = procs[0].communicate(timeout=900)[0]
+    for p in procs[1:]:
+        p.communicate(timeout=900)
+    for line in out0.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(f"world={world} produced no RESULT:\n{out0[-3000:]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--worlds", default="1,2,4,8")
+    args = ap.parse_args()
+    res = {}
+    for w in [int(v) for v in args.worlds.split(",")]:
+        res[str(w)] = run_world(w)
+        print(f"world {w}: {res[str(w)]}", flush=True)
+    doc = {
+        "method": "N jax.distributed CPU processes, one virtual device "
+                  "each, global DP mesh; shard_map train step (SAGE h32, "
+                  "32-graph local batch) timed over 30 dispatches after "
+                  "compile; per_step_ms = dispatch cost / K",
+        "results": res,
+    }
+    print(json.dumps(doc))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
