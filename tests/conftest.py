@@ -13,6 +13,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Keep the axon plugin from dialing the TPU tunnel — for THIS process and,
+# via env inheritance, for every subprocess the tests spawn (JAX_PLATFORMS
+# alone does not stop the dial, and only one process may hold the tunnel:
+# a concurrent TPU job would deadlock any test subprocess that dials).
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

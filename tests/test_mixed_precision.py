@@ -76,6 +76,42 @@ def test_bf16_training_decreases_loss():
     assert np.isfinite(last) and last < first
 
 
+def test_bf16_dimenet_triplet_chain():
+    """DimeNet under bf16: the basis outputs are cast to the compute dtype
+    (models/dimenet.py DimeNetConv) so the [T, *] triplet streams — the
+    step's dominant HBM traffic — run in bf16 instead of promoting back to
+    f32 through the f32 basis/mask operands.  Loss must stay within bf16
+    tolerance of the f32 step and training must still converge."""
+    from hydragnn_tpu.models.dimenet import add_dimenet_extras, count_triplets
+
+    cfg, batch = _setup("DimeNet")
+    cfg = dataclasses.replace(
+        cfg, envelope_exponent=5, num_before_skip=1, num_after_skip=1,
+        num_radial=4, num_spherical=3, basis_emb_size=4, int_emb_size=16,
+        out_emb_size=16)
+    real = np.asarray(batch.edge_mask) > 0
+    ei = np.stack([np.asarray(batch.senders)[real],
+                   np.asarray(batch.receivers)[real]])
+    t = count_triplets(ei, batch.x.shape[0])
+    batch = add_dimenet_extras(batch, max_triplets=t + 4)
+    batch = jax.device_put(batch)
+
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    state = create_train_state(model, batch, opt)
+    losses = {}
+    for dt in ("float32", "bfloat16"):
+        cfg_dt = dataclasses.replace(cfg, compute_dtype=dt)
+        step = jax.jit(make_train_step(create_model(cfg_dt), cfg_dt, opt))
+        s = state
+        for _ in range(10):
+            s, metrics = step(s, batch)
+        losses[dt] = float(metrics["loss"])
+        assert np.isfinite(losses[dt])
+    assert abs(losses["bfloat16"] - losses["float32"]) < 0.1 * (
+        abs(losses["float32"]) + 1e-3)
+
+
 def test_mixed_precision_config_key():
     arch = {
         "model_type": "SAGE", "input_dim": 1, "hidden_dim": 8,

@@ -1,0 +1,62 @@
+"""Characterize the attached device: dispatch latency vs compute rate.
+
+Distinguishes "slow per-dispatch tunnel" from "degraded/shared chip":
+a 4096^2 bf16 matmul is ~0.7 ms of MXU work on a v5e; if the amortized
+chained-iteration time is ~1 ms the chip is fine and only sync latency is
+high, if it is 100x that the device itself is not delivering.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, dev.platform, flush=True)
+
+    x = jnp.ones((4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def mm(x):
+        return x @ x
+
+    y = mm(x)
+    y.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(30):
+        y = mm(y)
+    y.block_until_ready()
+    per = (time.perf_counter() - t0) / 30
+    tf = 2 * 4096**3 / per / 1e12
+    print(f"chained 4096^2 bf16 matmul: {per*1e3:.2f} ms/iter = {tf:.1f} TF/s",
+          flush=True)
+
+    @jax.jit
+    def tiny(x):
+        return x + 1.0
+
+    z = jnp.zeros((8, 8))
+    z = tiny(z)
+    z.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(20):
+        z = tiny(z)
+    z.block_until_ready()
+    per = (time.perf_counter() - t0) / 20
+    print(f"chained tiny add: {per*1e3:.2f} ms/iter", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(10):
+        z = tiny(z)
+        np.asarray(z)
+    per = (time.perf_counter() - t0) / 10
+    print(f"dispatch+sync tiny: {per*1e3:.2f} ms/iter", flush=True)
+
+
+if __name__ == "__main__":
+    main()

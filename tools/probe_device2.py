@@ -1,0 +1,51 @@
+"""True execution-rate probe: N chained matmuls, ONE scalar fetch.
+
+Healthy v5e: 30 x 4096^2 bf16 matmuls ~ 21 ms of MXU work + 1 RTT.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    x = jnp.ones((4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def mm(x):
+        return x @ (x * 0.001)
+
+    y = mm(x)
+    np.asarray(y[0, 0])
+    for n in (1, 10, 30):
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(n):
+            y = mm(y)
+        np.asarray(y[0, 0])
+        dt = time.perf_counter() - t0
+        print(f"{n} chained matmul + 1 scalar fetch: {dt*1e3:.1f} ms total "
+              f"-> {dt*1e3/n:.2f} ms/iter", flush=True)
+
+    # small program, big INPUT each call (fresh host array -> upload cost)
+    h = np.ones((1024, 1024), np.float32)
+
+    @jax.jit
+    def s(a):
+        return a.sum()
+
+    s(h).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        v = s(np.ones((1024, 1024), np.float32))
+        np.asarray(v)
+    dt = (time.perf_counter() - t0) / 5
+    print(f"4MB fresh-host-input sum + fetch: {dt*1e3:.1f} ms/iter", flush=True)
+
+
+if __name__ == "__main__":
+    main()

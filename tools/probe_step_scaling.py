@@ -1,0 +1,43 @@
+"""Chained-step scaling probe: is the slow DimeNet step real execution
+time or per-dispatch tunnel overhead?
+
+For each arch, time N chained train steps with ONE scalar fetch at the
+end, N in {1, 5, 20}: real execution scales linearly in N with a ~110 ms
+RTT intercept; per-dispatch overhead shows up as a large per-N slope that
+the chained matmul probe (0.8 ms/iter) does not have.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import bench
+
+
+def run(arch, dtype="float32"):
+    state, batch, step, cfg, samples, heads = bench._build(
+        arch, hidden=64, dtype=dtype)
+    s, metrics = step(state, batch)
+    np.asarray(metrics["loss"])
+    for n in (1, 5, 20):
+        best = float("inf")
+        for _ in range(3):
+            s = state
+            t0 = time.perf_counter()
+            for _ in range(n):
+                s, metrics = step(s, batch)
+            np.asarray(metrics["loss"])
+            best = min(best, time.perf_counter() - t0)
+        print(f"{arch} {dtype} N={n}: {best*1e3:.1f} ms total -> "
+              f"{best*1e3/n:.1f} ms/step", flush=True)
+
+
+def main():
+    for arch in sys.argv[1:] or ["SchNet", "DimeNet"]:
+        run(arch)
+
+
+if __name__ == "__main__":
+    main()

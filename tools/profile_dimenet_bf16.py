@@ -1,0 +1,31 @@
+"""Measure DimeNet sweep-config step time at f32 vs bf16 compute.
+
+The round-4 attribution showed the step is bandwidth-bound on [T, *]
+triplet streams; with the DimeNetConv basis cast the whole chain runs in
+the compute dtype, halving those bytes under bf16.
+
+Timing uses bench._chip_loop (K steps inside one fori_loop dispatch):
+on the tunneled PJRT runtime a per-step dispatch pays ~0.1-1 s of
+transfer/latency overhead that has nothing to do with the chip.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+import bench
+
+
+def main():
+    for dtype in ("float32", "bfloat16"):
+        state, batch, step, cfg, samples, heads = bench._build(
+            "DimeNet", hidden=64, dtype=dtype)
+        s_per_step, state = bench._chip_loop(state, batch, step,
+                                             n_iters=20, n_repeats=3)
+        ms = s_per_step * 1e3
+        gps = 512 / s_per_step
+        print(f"DimeNet h64 b512 {dtype}: {ms:.1f} ms/step = {gps:,.0f} graphs/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

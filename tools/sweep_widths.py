@@ -1,0 +1,47 @@
+"""Width sweep for the two slow-tail archs (round-3 VERDICT weak #6).
+
+GAT and DimeNet were only ever measured at hidden 64, where fixed
+overheads dominate — this records step time at realistic widths
+(h64/h128/h256) to separate "structurally slow" from "overhead-bound at
+toy width", plus DimeNet at bf16 where the triplet streams halve.
+
+Usage: python tools/sweep_widths.py [arch ...]
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+import bench
+
+
+def timeit(step, state, batch, iters=20):
+    """bench._chip_loop: K steps per dispatch — per-step dispatch pays
+    ~0.1-1 s of tunnel transfer/latency that is not chip time."""
+    s_per_step, _ = bench._chip_loop(state, batch, step,
+                                     n_iters=iters, n_repeats=3)
+    return s_per_step * 1e3
+
+
+def main():
+    want = sys.argv[1:] or ["GAT", "DimeNet"]
+    plans = []
+    for arch in want:
+        for hidden in (64, 128, 256):
+            plans.append((arch, hidden, "float32"))
+        if arch == "DimeNet":
+            for hidden in (64, 128, 256):
+                plans.append((arch, hidden, "bfloat16"))
+    for arch, hidden, dtype in plans:
+        try:
+            state, batch, step, cfg, samples, heads = bench._build(
+                arch, hidden=hidden, dtype=dtype)
+            ms = timeit(step, state, batch)
+            gps = 512 / (ms / 1e3)
+            print(f"{arch} h{hidden} b512 {dtype}: {ms:.1f} ms/step = "
+                  f"{gps:,.0f} graphs/s", flush=True)
+        except Exception as e:  # keep sweeping on OOM etc.
+            print(f"{arch} h{hidden} {dtype}: FAILED {e!r}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
