@@ -119,6 +119,10 @@ def main():
     ap.add_argument("--data", default="")
     ap.add_argument("--num_mols", type=int, default=800)
     ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--model_type", default="",
+                    help="override Architecture.model_type (accuracy A/B)")
+    ap.add_argument("--lr", type=float, default=0.0,
+                    help="override Optimizer.learning_rate (accuracy A/B)")
     args = ap.parse_args()
 
     with open(args.inputfile) as f:
@@ -126,7 +130,11 @@ def main():
     training = config["NeuralNetwork"]["Training"]
     if args.num_epoch:
         training["num_epoch"] = args.num_epoch
+    if args.lr:
+        training["Optimizer"]["learning_rate"] = args.lr
     arch = config["NeuralNetwork"]["Architecture"]
+    if args.model_type:
+        arch["model_type"] = args.model_type
     radius = float(arch.get("radius", 2.0))
 
     if args.data and os.path.isdir(args.data) and any(

@@ -22,6 +22,7 @@ import flax.linen as nn
 
 from hydragnn_tpu.graph import segment
 from hydragnn_tpu.models.base import Base
+from hydragnn_tpu.ops.gat_mp import FUSED_HF_LIMIT
 
 
 def _fused_gat_enabled() -> bool:
@@ -65,7 +66,8 @@ class GATv2Conv(nn.Module):
             train, g.senders.shape[0], n, x.dtype)
 
         perm = g.extras.get("edge_perm_sender") if g.extras else None
-        if perm is not None and _fused_gat_enabled():
+        if (perm is not None and _fused_gat_enabled()
+                and h * f <= FUSED_HF_LIMIT):
             out = self._fused_attention(xl, xr, att, logits, g, perm,
                                         b_edge, b_self)
         else:

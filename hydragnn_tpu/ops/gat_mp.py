@@ -51,6 +51,26 @@ from hydragnn_tpu.ops.fused_mp import _dense_schedule
 
 _NODE_BLOCK = 128
 _EDGE_BLOCK = 512
+
+# Widest flat head-feature width (h*f) the fused kernels compile for: the
+# per-iteration [BE, HF] temporaries and the double-buffered [BN, HF]
+# window blocks scale with HF against the v5e's 16 MB scoped-VMEM budget.
+# Measured on the v5e: hf=768 (34.6 ms/step) and hf=1020 (49.3 ms/step)
+# compile and run at BE=256; hf=1536 (h256 x 6 heads) OOMs at BE=512 AND
+# at BE=128 (the backward's seven double-buffered [BN, HF] node windows
+# alone approach the budget), so above 1024 GATv2Conv falls back to the
+# composed segment-op path (measured working at every width).
+FUSED_HF_LIMIT = 1024
+
+
+def _edge_block(hf: int) -> int:
+    """Edge-block size that keeps the kernels' [BE, HF]-scale temporaries
+    (4-5 live per iteration, f32) inside scoped VMEM alongside the
+    double-buffered [BN, HF] node windows (hf=768 -> BE=256 measured
+    34.6 ms/step at the h128 sweep config, vs 36.1 at BE=512)."""
+    return _EDGE_BLOCK if hf <= 512 else 256
+
+
 # sentinels deliberately 1e9, NOT 1e30: they ride one-hot MATMULS (m_e =
 # onehot @ m), and reduced-precision matmul backends (CPU oneDNN tf32-ish
 # rounding; MXU bf16 passes) round huge magnitudes with absolute errors
@@ -208,7 +228,7 @@ def _fwd_impl(xl, xr, att_mat, senders, receivers, edge_mask, b_edge,
 
     n, hf = xl.shape
     h = att_mat.shape[1]
-    bn, be = _NODE_BLOCK, _EDGE_BLOCK
+    bn, be = _NODE_BLOCK, _edge_block(hf)
     n_pad = _round_up(n, bn)
     e_pad = _round_up(max(senders.shape[0], 1), be)
     xl_p = _pad_nodes(xl, n_pad)
@@ -480,7 +500,7 @@ def _gea_bwd(slope_f, res, cot):
 
     n, hf = xl.shape
     h = att_mat.shape[1]
-    bn, be = _NODE_BLOCK, _EDGE_BLOCK
+    bn, be = _NODE_BLOCK, _edge_block(hf)
     n_pad = _round_up(n, bn)
     e_pad = _round_up(max(senders.shape[0], 1), be)
     xl_p = _pad_nodes(xl, n_pad)

@@ -1,0 +1,28 @@
+"""GAT fused-kernel width check after the adaptive edge-block fix:
+h128 (hf=768 -> BE=256) and h256 (hf=1536 -> BE=128, previously a
+compile-time VMEM OOM at BE=512)."""
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
+
+import bench
+
+
+def main():
+    for hidden in (128, 256):
+        try:
+            state, batch, step, cfg, samples, heads = bench._build(
+                "GAT", hidden=hidden)
+            s_per_step, _ = bench._chip_loop(state, batch, step,
+                                             n_iters=20, n_repeats=3)
+            print(f"GAT h{hidden} b512 fused: {s_per_step*1e3:.1f} ms/step = "
+                  f"{512/s_per_step:,.0f} graphs/s", flush=True)
+        except Exception as e:
+            print(f"GAT h{hidden} fused: FAILED {e!r}"[:300], flush=True)
+
+
+if __name__ == "__main__":
+    main()
