@@ -172,6 +172,28 @@ def _build(model_type="SchNet", hidden=64, dtype="float32", batch_size=512,
     return state, batch, step, cfg, samples, heads
 
 
+def _release_device():
+    """Free ALL device buffers and compiled executables between phases.
+
+    Each _chip_loop compile closes over its batch, so the jit cache pins
+    every phase's batch/state on the chip for the child's whole lifetime —
+    on the 16 GB v5e the multi-phase run RESOURCE_EXHAUSTs by the dense
+    h1024 build unless earlier phases' buffers are actively dropped
+    (clear_caches releases the executables, delete() the arrays).  Callers
+    must be at a phase boundary: every live array is invalidated."""
+    import gc
+
+    import jax
+
+    jax.clear_caches()
+    gc.collect()
+    try:
+        for a in jax.live_arrays():
+            a.delete()
+    except Exception:  # noqa: BLE001 — best-effort on exotic runtimes
+        pass
+
+
 def _chip_loop(state, batch, step, n_iters, n_repeats):
     """Best-of-N timing of K steps inside one compiled fori_loop (per-step
     host dispatch otherwise dominates; the train state threads through the
@@ -464,6 +486,10 @@ def _child(platform: str) -> None:
         except Exception as e:  # noqa: BLE001
             print(f"bench: roofline failed: {e!r}", file=sys.stderr)
 
+    # flagship state/batch/step are dead past roofline — drop them (and the
+    # executables pinning them) before the trainer-based sustained phases
+    _release_device()
+
     if "sustained_default" in phases:
         # out-of-the-box run_training: NO env knobs; _auto_pipeline picks
         # scan/residency, val/test epochs run (round-4 default-path number)
@@ -476,6 +502,7 @@ def _child(platform: str) -> None:
             emit()
         except Exception as e:  # noqa: BLE001
             print(f"bench: sustained_default failed: {e!r}", file=sys.stderr)
+        _release_device()
 
     if "sustained" in phases:
         try:
@@ -486,6 +513,8 @@ def _child(platform: str) -> None:
             emit()
         except Exception as e:  # noqa: BLE001
             print(f"bench: sustained failed: {e!r}", file=sys.stderr)
+
+    _release_device()
 
     if "dense" in phases:
         # compute-dense flagship ladder: MFU scales with width (measured
@@ -516,13 +545,16 @@ def _child(platform: str) -> None:
             except Exception as e:  # noqa: BLE001
                 print(f"bench: dense h{hidden} failed: {e!r}",
                       file=sys.stderr)
+            _release_device()
 
     if "archs" in phases:
         sweep = {}
         # DimeNet-bf16: user-selectable mixed_precision run of the slow-tail
         # arch — the basis-stream cast (models/dimenet.py) keeps the [T, *]
-        # triplet chain in bf16 (+17% measured over f32 on the v5e)
-        for arch in ARCHS + ["DimeNet-bf16"]:
+        # triplet chain in bf16 (12.5k vs 8.1k g/s measured on the v5e).
+        # Skipped when the whole sweep already runs bf16 (identical config).
+        extra = [] if dtype == "bfloat16" else ["DimeNet-bf16"]
+        for arch in ARCHS + extra:
             try:
                 t0 = time.perf_counter()
                 adtype = dtype
@@ -544,6 +576,7 @@ def _child(platform: str) -> None:
             except Exception as e:  # noqa: BLE001
                 sweep[arch] = {"error": repr(e)[:160]}
                 print(f"bench: arch {arch} failed: {e!r}", file=sys.stderr)
+            _release_device()
             result["archs"] = dict(sweep)
             emit()
 

@@ -83,6 +83,61 @@ def _torch_batches(samples, batch_size, rng):
                torch.from_numpy(np.concatenate(scs)) if scs else None)
 
 
+def _train_eval_graph_mse(model, train, val, tst, num_epoch, framework,
+                          dataset_desc, lr=1e-3, batch=64):
+    """Shared graph-head MSE train/eval scaffold for the QM9-corpus twins:
+    AdamW + ReduceLROnPlateau(0.5, patience 5), shuffled minibatches,
+    per-epoch val MSE, final test MSE/MAE."""
+    import torch
+
+    opt = torch.optim.AdamW(model.parameters(), lr=lr)
+    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
+        opt, factor=0.5, patience=5, min_lr=1e-5)
+
+    def eval_mse(dataset):
+        model.eval()
+        errs, maes, n = 0.0, 0.0, 0
+        with torch.no_grad():
+            for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(
+                    dataset, batch, np.random.RandomState(0)):
+                out = model(x, ei, pos, gid, ng)[0]
+                errs += float(((out - y) ** 2).sum())
+                maes += float((out - y).abs().sum())
+                n += ng
+        return errs / max(n, 1), maes / max(n, 1)
+
+    rng = np.random.RandomState(1)
+    hist = []
+    best_val = float("inf")
+    t0 = time.time()
+    for epoch in range(num_epoch):
+        model.train()
+        for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(
+                train, batch, rng):
+            opt.zero_grad()
+            out = model(x, ei, pos, gid, ng)[0]
+            loss = ((out - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+        val_mse, val_mae = eval_mse(val)
+        best_val = min(best_val, val_mse)
+        sched.step(val_mse)
+        hist.append(round(val_mse, 5))
+        print(f"epoch {epoch}: val mse {val_mse:.5f}", flush=True)
+    test_mse, test_mae = eval_mse(tst)
+    return {
+        "framework": framework,
+        "dataset": dataset_desc,
+        "epochs": num_epoch,
+        "wall_clock_s": round(time.time() - t0, 1),
+        "val_mse_first_epoch": hist[0] if hist else None,
+        "val_mse_best": round(best_val, 5) if hist else None,
+        "test_mse": round(test_mse, 5),
+        "test_energy_mae_standardized": round(test_mae, 5),
+        "val_mse_trajectory": hist,
+    }
+
+
 def torch_qm9(num_mols: int, num_epoch: int, seed: int = 0):
     import torch
     import torch.nn as tnn
@@ -102,51 +157,10 @@ def torch_qm9(num_mols: int, num_epoch: int, seed: int = 0):
     model = twp.TorchTwinModel(
         conv, with_bn=False, heads=("graph",), num_layers=4,
         shared=(64, 64), headlayers=(64, 64), in_dim=1)
-    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
-    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
-        opt, factor=0.5, patience=5, min_lr=1e-5)
-
-    def eval_mse(dataset):
-        model.eval()
-        errs, maes, n = 0.0, 0.0, 0
-        with torch.no_grad():
-            for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(
-                    dataset, 64, np.random.RandomState(0)):
-                out = model(x, ei, pos, gid, ng)[0]
-                errs += float(((out - y) ** 2).sum())
-                maes += float((out - y).abs().sum())
-                n += ng
-        return errs / max(n, 1), maes / max(n, 1)
-
-    rng = np.random.RandomState(1)
-    hist = []
-    best_val = float("inf")
-    t0 = time.time()
-    for epoch in range(num_epoch):
-        model.train()
-        for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(train, 64, rng):
-            opt.zero_grad()
-            out = model(x, ei, pos, gid, ng)[0]
-            loss = ((out - y) ** 2).mean()
-            loss.backward()
-            opt.step()
-        val_mse, val_mae = eval_mse(val)
-        best_val = min(best_val, val_mse)
-        sched.step(val_mse)
-        hist.append(round(val_mse, 5))
-        print(f"epoch {epoch}: val mse {val_mse:.5f}", flush=True)
-    test_mse, test_mae = eval_mse(tst)
-    return {
-        "framework": "torch-twin (reference-keyed TwinSchNet, CPU)",
-        "dataset": f"Morse-QM9 {num_mols} molecules (seed {seed})",
-        "epochs": num_epoch,
-        "wall_clock_s": round(time.time() - t0, 1),
-        "val_mse_first_epoch": hist[0],
-        "val_mse_best": round(best_val, 5),
-        "test_mse": round(test_mse, 5),
-        "test_energy_mae_standardized": round(test_mae, 5),
-        "val_mse_trajectory": hist,
-    }
+    return _train_eval_graph_mse(
+        model, train, val, tst, num_epoch,
+        "torch-twin (reference-keyed TwinSchNet, CPU)",
+        f"Morse-QM9 {num_mols} molecules (seed {seed})")
 
 
 def torch_qm9_gat(num_mols: int, num_epoch: int, seed: int = 0,
@@ -204,51 +218,10 @@ def torch_qm9_gat(num_mols: int, num_epoch: int, seed: int = 0,
             return [self.head(z)]
 
     model = GATNet()
-    opt = torch.optim.AdamW(model.parameters(), lr=lr)
-    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
-        opt, factor=0.5, patience=5, min_lr=1e-5)
-
-    def eval_mse(dataset):
-        model.eval()
-        errs, maes, n = 0.0, 0.0, 0
-        with torch.no_grad():
-            for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(
-                    dataset, 64, np.random.RandomState(0)):
-                out = model(x, ei, pos, gid, ng)[0]
-                errs += float(((out - y) ** 2).sum())
-                maes += float((out - y).abs().sum())
-                n += ng
-        return errs / max(n, 1), maes / max(n, 1)
-
-    rng = np.random.RandomState(1)
-    hist = []
-    best_val = float("inf")
-    t0 = time.time()
-    for epoch in range(num_epoch):
-        model.train()
-        for x, ei, pos, gid, ng, y, _, _sc in _torch_batches(train, 64, rng):
-            opt.zero_grad()
-            out = model(x, ei, pos, gid, ng)[0]
-            loss = ((out - y) ** 2).mean()
-            loss.backward()
-            opt.step()
-        val_mse, val_mae = eval_mse(val)
-        best_val = min(best_val, val_mse)
-        sched.step(val_mse)
-        hist.append(round(val_mse, 5))
-        print(f"epoch {epoch}: val mse {val_mse:.5f}", flush=True)
-    test_mse, test_mae = eval_mse(tst)
-    return {
-        "framework": "torch-twin (reference-keyed TwinGATConv net, CPU)",
-        "dataset": f"Morse-QM9 {num_mols} molecules (seed {seed})",
-        "epochs": num_epoch,
-        "wall_clock_s": round(time.time() - t0, 1),
-        "val_mse_first_epoch": hist[0],
-        "val_mse_best": round(best_val, 5),
-        "test_mse": round(test_mse, 5),
-        "test_energy_mae_standardized": round(test_mae, 5),
-        "val_mse_trajectory": hist,
-    }
+    return _train_eval_graph_mse(
+        model, train, val, tst, num_epoch,
+        "torch-twin (reference-keyed TwinGATConv net, CPU)",
+        f"Morse-QM9 {num_mols} molecules (seed {seed})", lr=lr)
 
 
 def torch_lj(num_configs: int, num_epoch: int, seed: int = 0):
