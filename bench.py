@@ -264,6 +264,16 @@ def _roofline(step, state, batch, step_s):
     return out
 
 
+def _cost_flops(step, state, batch):
+    """XLA cost-model flops of one compiled step."""
+    import jax
+
+    compiled = jax.jit(step).lower(state, batch).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca.get("flops", 0.0))
+
+
 def _membw_probe():
     """Measured achievable HBM bandwidth, overhead-cancelled: time a
     streamed y = x*a at two working-set sizes and take the MARGINAL
@@ -535,6 +545,42 @@ def _child(platform: str) -> None:
                 dres = {"graphs_per_sec": round(dense_batch / dstep_s, 1),
                         "step_ms": round(dstep_s * 1e3, 3)}
                 dres.update(_roofline(dstep, dstate, dbatch, dstep_s))
+                # the fused CFConv edge pipeline (default-on at this width,
+                # models/schnet.py) hides the filter MLP's E*F^2 flops
+                # inside a Pallas call that XLA's cost model cannot see —
+                # take the useful-flops basis from the composed-twin
+                # program (identical math/params) so MFU stays comparable.
+                # Own try: a transient twin-compile failure must not throw
+                # away the rung's already-measured numbers (the fused-
+                # program flops simply remain the — undercounting — basis).
+                from hydragnn_tpu.models.schnet import _scf_pipeline_enabled
+
+                if _scf_pipeline_enabled(hidden, 50):
+                    prior = os.environ.get("HYDRAGNN_SCF_FUSED")
+                    os.environ["HYDRAGNN_SCF_FUSED"] = "0"
+                    try:
+                        cstate, cbatch, cstep, _c, _s2, _h2 = _build(
+                            hidden=hidden, dtype="bfloat16",
+                            batch_size=dense_batch)
+                        fl = _cost_flops(cstep, cstate, cbatch)
+                        dres["flops_per_step"] = round(fl)
+                        dres["achieved_tflops"] = round(
+                            fl / dstep_s / 1e12, 3)
+                        dres["mfu_pct"] = round(
+                            fl / dstep_s / MXU_PEAK * 100, 2)
+                        dres["flops_method"] = (
+                            "useful-flops basis from the composed-twin "
+                            "program (the fused CFConv pipeline's Pallas "
+                            "call is opaque to the XLA cost model)")
+                    except Exception as fe:  # noqa: BLE001
+                        print(f"bench: dense h{hidden} twin-flops basis "
+                              f"failed (kept fused-program flops): {fe!r}",
+                              file=sys.stderr)
+                    finally:
+                        if prior is None:
+                            os.environ.pop("HYDRAGNN_SCF_FUSED", None)
+                        else:
+                            os.environ["HYDRAGNN_SCF_FUSED"] = prior
                 dense[f"SchNet-h{hidden}-bf16-b{dense_batch}"] = dres
                 print(f"bench: dense h{hidden} b{dense_batch} "
                       f"{dres['achieved_tflops']} TF ({dres['mfu_pct']}% "

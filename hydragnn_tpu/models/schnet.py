@@ -15,6 +15,8 @@ No BatchNorm feature layers (reference uses Identity; SCFStack.py:63).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
@@ -29,6 +31,40 @@ def gaussian_smearing(dist, radius, num_gaussians):
     offsets = jnp.linspace(0.0, radius, num_gaussians)
     coeff = -0.5 / (offsets[1] - offsets[0]) ** 2
     return jnp.exp(coeff * (dist[:, None] - offsets[None, :]) ** 2)
+
+
+class _DenseParams(nn.Module):
+    """Parameters of an ``nn.Dense`` WITHOUT its matmul: same names
+    (kernel/bias), same default inits, same param tree — so the fused
+    edge-pipeline path below and the composed path share checkpoints."""
+
+    in_dim: int
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        k = self.param("kernel", nn.linear.default_kernel_init,
+                       (self.in_dim, self.features))
+        b = self.param("bias", nn.initializers.zeros_init(),
+                       (self.features,))
+        return k, b
+
+
+def _scf_pipeline_enabled(num_filters: int, num_gaussians: int) -> bool:
+    """Fused CFConv edge pipeline gate (ops/scf_mp.py): structural limits
+    (basis fits the padded lane count, width fits VMEM) plus a width
+    floor — the in-kernel filter MLP re-evaluates E*F^2 in both backward
+    passes, which only pays off where the composed path is stream-bound
+    (measured crossover: docs/PERF.md round-4 dense ladder).  Env override
+    HYDRAGNN_SCF_FUSED=1/0 forces it either way."""
+    from hydragnn_tpu.ops.scf_mp import SCF_F_LIMIT
+
+    if num_gaussians > 127 or num_filters > SCF_F_LIMIT:
+        return False
+    v = os.environ.get("HYDRAGNN_SCF_FUSED")
+    if v is not None:
+        return v.strip().lower() not in ("0", "false", "off", "no", "")
+    return num_filters >= 256
 
 
 class SCFConv(nn.Module):
@@ -57,10 +93,24 @@ class SCFConv(nn.Module):
         # static, so drifted positions must not re-enter with full weight)
         cut = 0.5 * (jnp.cos(w * jnp.pi / self.cutoff) + 1.0)
         cut = jnp.where(w <= self.cutoff, cut, 0.0)
-        filt = nn.Dense(self.num_filters, name="filter_0")(rbf)
-        filt = shifted_softplus(filt)
-        filt = nn.Dense(self.num_filters, name="filter_1")(filt)
-        filt = filt * cut[:, None] * g.edge_mask[:, None]
+
+        # filter params are declared matmul-free so the fused edge
+        # pipeline below can consume them raw; the composed path applies
+        # them exactly as the nn.Dense layers they replace (identical
+        # names/inits — checkpoints are path-independent)
+        k0, b0 = _DenseParams(self.num_gaussians, self.num_filters,
+                              name="filter_0")()
+        k1, b1 = _DenseParams(self.num_filters, self.num_filters,
+                              name="filter_1")()
+        perm = g.extras.get("edge_perm_sender") if g.extras else None
+        fused_pipeline = (
+            perm is not None and not self.equivariant
+            and _scf_pipeline_enabled(self.num_filters, self.num_gaussians))
+
+        filt = None
+        if not fused_pipeline:
+            filt = shifted_softplus(rbf @ k0 + b0) @ k1 + b1
+            filt = filt * cut[:, None] * g.edge_mask[:, None]
 
         # xavier-uniform init on lin1/lin2, zero bias — parity with reference
         # CFConv.reset_parameters (SCFStack.py:185-188)
@@ -90,10 +140,19 @@ class SCFConv(nn.Module):
             # coord_model (SCFStack.py:173-181)
             pos = pos + segment.segment_mean(trans, src, n, g.edge_mask)
 
-        # lowers to the fused gather-multiply-aggregate Pallas kernel under
-        # HYDRAGNN_AGGR_BACKEND=fused (ops/fused_mp.py; measured numbers in
-        # docs/PERF.md)
-        agg = segment.gather_mul_segment(h, filt, g)
+        if fused_pipeline:
+            # whole-edge-pipeline Pallas kernel (ops/scf_mp.py): filter MLP
+            # + gather + multiply + segment-sum with no [E, F] HBM streams
+            from hydragnn_tpu.ops.scf_mp import scf_edge_pipeline
+
+            cm = cut * g.edge_mask
+            agg = scf_edge_pipeline(h, rbf, cm, k0, b0, k1, b1,
+                                    g.senders, g.receivers, perm)
+        else:
+            # lowers to the fused gather-multiply-aggregate Pallas kernel
+            # under HYDRAGNN_AGGR_BACKEND=fused (ops/fused_mp.py; measured
+            # numbers in docs/PERF.md)
+            agg = segment.gather_mul_segment(h, filt, g)
         out = nn.Dense(self.out_dim,
                        kernel_init=nn.initializers.xavier_uniform(),
                        name="lin2")(agg)

@@ -227,3 +227,42 @@ def test_model_level_fused_equals_composed(monkeypatch):
     for a, b in zip(out_fused, out_plain):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_wide_heads_fall_back_to_composed(monkeypatch):
+    """hf = heads*hidden above FUSED_HF_LIMIT must take the composed path
+    even with the fused gate forced on — the Pallas kernels VMEM-OOM at
+    TPU compile time above the limit (measured: hf=1536 fails at every
+    edge block), so the width gate is what keeps wide-GAT configs
+    RUNNABLE rather than a hard compile error."""
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.models.gat import FUSED_HF_LIMIT, GATv2Conv
+
+    assert 6 * 256 > FUSED_HF_LIMIT  # the shape below must exceed the gate
+
+    calls = []
+    orig = GATv2Conv._fused_attention
+
+    def spy(self, *a, **k):
+        calls.append(self.out_dim)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(GATv2Conv, "_fused_attention", spy)
+    monkeypatch.setenv("HYDRAGNN_GAT_FUSED", "1")
+
+    g = _batch(seed=11)
+    cfg = ModelConfig(
+        model_type="GAT", input_dim=2, hidden_dim=256, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        dropout=0.0)
+    model = create_model(cfg)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        g, train=False)
+    out = model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})}, g, train=False)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+    assert calls == []  # every layer stayed on the composed path

@@ -1,0 +1,164 @@
+"""Parity tests for the fused CFConv edge pipeline (ops/scf_mp.py):
+forward, all gradients, and the model-level SCFConv wiring vs the
+composed path — interpret mode on CPU."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.ops.scf_mp import scf_edge_pipeline
+from hydragnn_tpu.models.layers import shifted_softplus
+
+F, G = 16, 7
+
+
+def _batch(n_graphs=6, nodes=9, seed=0):
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n_graphs):
+        pos = rng.rand(nodes, 3).astype(np.float32) * 2.2
+        samples.append(GraphSample(
+            x=rng.rand(nodes, 2).astype(np.float32), pos=pos,
+            edge_index=radius_graph(pos, 1.4, 8),
+            graph_y=rng.rand(1).astype(np.float32)))
+    pad = PadSpec.for_batch(n_graphs, nodes,
+                            max(s.num_edges for s in samples))
+    prev = os.environ.get("HYDRAGNN_AGGR_BACKEND")
+    os.environ["HYDRAGNN_AGGR_BACKEND"] = "fused"
+    try:
+        return collate(samples, pad, [HeadSpec("e", "graph", 1)])
+    finally:
+        if prev is None:
+            os.environ.pop("HYDRAGNN_AGGR_BACKEND", None)
+        else:
+            os.environ["HYDRAGNN_AGGR_BACKEND"] = prev
+
+
+def _inputs(g, seed=1):
+    rng = np.random.RandomState(seed)
+    n = g.x.shape[0]
+    e = g.senders.shape[0]
+    h = jnp.asarray(rng.randn(n, F), jnp.float32)
+    rbf = jnp.asarray(rng.rand(e, G), jnp.float32)
+    cm = jnp.asarray(rng.rand(e).astype(np.float32)
+                     * np.asarray(g.edge_mask))
+    w0 = jnp.asarray(rng.randn(G, F) * 0.4, jnp.float32)
+    b0 = jnp.asarray(rng.randn(F) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.randn(F, F) * 0.3, jnp.float32)
+    b1 = jnp.asarray(rng.randn(F) * 0.1, jnp.float32)
+    return h, rbf, cm, w0, b0, w1, b1
+
+
+def _composed(h, rbf, cm, w0, b0, w1, b1, senders, receivers, num_nodes):
+    filt = (shifted_softplus(rbf @ w0 + b0) @ w1 + b1) * cm[:, None]
+    msgs = h[senders] * filt
+    return jax.ops.segment_sum(msgs, receivers, num_segments=num_nodes)
+
+
+def test_forward_matches_composed():
+    g = _batch()
+    h, rbf, cm, w0, b0, w1, b1 = _inputs(g)
+    perm = jnp.asarray(g.extras["edge_perm_sender"])
+    out = scf_edge_pipeline(h, rbf, cm, w0, b0, w1, b1,
+                            g.senders, g.receivers, perm)
+    ref = _composed(h, rbf, cm, w0, b0, w1, b1, g.senders, g.receivers,
+                    h.shape[0])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_match_composed():
+    g = _batch(seed=3)
+    inputs = _inputs(g, seed=4)
+    perm = jnp.asarray(g.extras["edge_perm_sender"])
+    n = inputs[0].shape[0]
+    # non-uniform weighting catches transposition errors a plain sum hides
+    rng = np.random.RandomState(7)
+    wmat = jnp.asarray(rng.randn(n, F), jnp.float32)
+
+    def loss_fused(args):
+        out = scf_edge_pipeline(*args, g.senders, g.receivers, perm)
+        return jnp.sum(out * wmat)
+
+    def loss_ref(args):
+        out = _composed(*args, g.senders, g.receivers, n)
+        return jnp.sum(out * wmat)
+
+    gf = jax.grad(loss_fused)(inputs)
+    gr = jax.grad(loss_ref)(inputs)
+    names = ("h", "rbf", "cm", "w0", "b0", "w1", "b1")
+    for name, a, b in zip(names, gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4,
+            err_msg=name)
+
+
+def test_model_level_fused_equals_composed(monkeypatch):
+    """SCFConv with the pipeline forced on vs off: same params (the
+    _DenseParams tree matches the composed path's), same forward, same
+    param grads."""
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+
+    g = _batch(seed=5)
+    cfg = ModelConfig(
+        model_type="SchNet", input_dim=2, hidden_dim=F, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        num_gaussians=G, num_filters=F, radius=1.4, max_neighbours=8)
+    model = create_model(cfg)
+    monkeypatch.setenv("HYDRAGNN_SCF_FUSED", "1")
+    variables = model.init({"params": jax.random.PRNGKey(0)}, g, train=False)
+
+    def loss(params, fused):
+        monkeypatch.setenv("HYDRAGNN_SCF_FUSED", "1" if fused else "0")
+        out = model.apply({"params": params}, g, train=False)
+        return sum(jnp.sum(o * o) for o in out)
+
+    lf, lg = loss(variables["params"], True), loss(variables["params"], False)
+    np.testing.assert_allclose(float(lf), float(lg), rtol=2e-5)
+
+    gf = jax.grad(lambda p: loss(p, True))(variables["params"])
+    gp = jax.grad(lambda p: loss(p, False))(variables["params"])
+    flat_f = jax.tree_util.tree_leaves_with_path(gf)
+    flat_p = dict(jax.tree_util.tree_leaves_with_path(gp))
+    assert flat_f  # same tree structure both ways
+    for path, leaf in flat_f:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_p[path]), rtol=5e-4, atol=5e-4,
+            err_msg=str(path))
+
+
+def test_pipeline_gate_defaults():
+    from hydragnn_tpu.models.schnet import _scf_pipeline_enabled
+
+    assert not _scf_pipeline_enabled(64, 50)       # narrow: composed wins
+    assert _scf_pipeline_enabled(256, 50)          # wide: pipeline on
+    assert not _scf_pipeline_enabled(2048, 50)     # beyond VMEM limit
+    assert not _scf_pipeline_enabled(512, 200)     # basis exceeds lanes
+    os.environ["HYDRAGNN_SCF_FUSED"] = "1"
+    try:
+        assert _scf_pipeline_enabled(64, 50)       # forced on
+    finally:
+        del os.environ["HYDRAGNN_SCF_FUSED"]
+
+
+def test_bf16_forward_within_tolerance():
+    """bf16 inputs ride bf16 windows/W1 in VMEM (halved stream bytes);
+    result must stay within bf16 tolerance of the f32 composed path."""
+    g = _batch(seed=6)
+    h, rbf, cm, w0, b0, w1, b1 = _inputs(g, seed=8)
+    perm = jnp.asarray(g.extras["edge_perm_sender"])
+    out = scf_edge_pipeline(h.astype(jnp.bfloat16), rbf, cm,
+                            w0, b0, w1, b1, g.senders, g.receivers, perm)
+    ref = _composed(h, rbf, cm, w0, b0, w1, b1, g.senders, g.receivers,
+                    h.shape[0])
+    assert out.dtype == jnp.bfloat16
+    scale = float(jnp.max(jnp.abs(ref))) + 1e-6
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) / scale
+    assert err < 0.03, err

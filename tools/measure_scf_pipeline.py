@@ -1,0 +1,33 @@
+"""A/B the fused CFConv edge pipeline at the dense flagship config
+(SchNet h1024 b2048 bf16) and the h512 rung: step time + MFU basis."""
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
+
+import bench
+
+
+def main():
+    for hidden, batch in ((512, 512), (1024, 2048)):
+        for scf in ("0", "1"):
+            os.environ["HYDRAGNN_SCF_FUSED"] = scf
+            try:
+                state, b, step, cfg, _s, _h = bench._build(
+                    hidden=hidden, dtype="bfloat16", batch_size=batch)
+                s_per_step, _ = bench._chip_loop(state, b, step,
+                                                 n_iters=10, n_repeats=2)
+                ms = s_per_step * 1e3
+                print(f"SchNet h{hidden} b{batch} bf16 scf_fused={scf}: "
+                      f"{ms:.1f} ms/step = {batch/s_per_step:,.0f} g/s",
+                      flush=True)
+            except Exception as e:
+                print(f"h{hidden} scf_fused={scf}: FAILED {repr(e)[:400]}",
+                      flush=True)
+            bench._release_device()
+
+
+if __name__ == "__main__":
+    main()
