@@ -55,8 +55,10 @@ def _scf_pipeline_enabled(num_filters: int, num_gaussians: int) -> bool:
     (basis fits the padded lane count, width fits VMEM) plus a width
     floor — the in-kernel filter MLP re-evaluates E*F^2 in both backward
     passes, which only pays off where the composed path is stream-bound
-    (measured crossover: docs/PERF.md round-4 dense ladder).  Env override
-    HYDRAGNN_SCF_FUSED=1/0 forces it either way."""
+    (measured BOTH sides of the crossover on the v5e: h64 f32 7.62 ->
+    8.19 ms = pipeline loses; h512/h1024 bf16 +27% = pipeline wins —
+    docs/PERF.md round 4).  Env override HYDRAGNN_SCF_FUSED=1/0 forces
+    it either way."""
     from hydragnn_tpu.ops.scf_mp import SCF_F_LIMIT
 
     if num_gaussians > 127 or num_filters > SCF_F_LIMIT:
