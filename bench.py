@@ -527,11 +527,12 @@ def _child(platform: str) -> None:
     _release_device()
 
     if "dense" in phases:
-        # compute-dense flagship ladder: MFU scales with width (measured
-        # 7.0% -> 13.8% -> 19.0% -> 24.6% at hidden 256/512/768/1024 bf16;
-        # round-4 batch sweep tops at 25.2% at h1024/b2048 with the per-op
-        # attribution in docs/PERF.md) — the bench records the realistic
-        # points plus the best-MFU corner, the doc records the full ladder
+        # compute-dense flagship ladder: MFU scales with width (with the
+        # fused CFConv edge pipeline active the measured ladder is
+        # 8.3% -> 18.5% -> 29.7% at h256/h512/h1024-b2048 bf16; the
+        # composed path's was 6.4/14.0/24.2 — full history in
+        # docs/PERF.md) — the bench records the realistic points plus the
+        # best-MFU corner, the doc records the full ladder
         dense = {}
         for hidden, dense_batch in ((256, 512), (512, 512), (1024, 2048)):
             try:
