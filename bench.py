@@ -1,7 +1,32 @@
 """Benchmark: training throughput + honest roofline of the flagship config.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-The single line carries nested evidence blocks (round-3 VERDICT items 1/2/5):
+Prints ONE COMPACT JSON line (<1 KB): {"metric", "value", "unit",
+"vs_baseline", "mfu_pct", "dense", "archs", ...}; the full evidence
+blocks (rooflines, methods, epoch times, knobs) go to
+``BENCH_evidence.json`` next to this file.  Round-4 post-mortem drove
+this split: the r03 cumulative line (~4 KB) overflowed the driver's tail
+window (``parsed: null`` at rc=0), and r04's grown phase list blew the
+driver's wall-clock budget (rc=124) while the parent BUFFERED the
+child's stdout — so an outer SIGKILL lost every phase the child had
+already finished.  Three fixes, in this file:
+
+  1. STREAM, don't buffer: the parent tees each child line to its own
+     stdout the moment it arrives, so the driver's tail always holds the
+     last finished measurement even if the parent itself is SIGKILLed.
+  2. COMPACT final line: headline + MFU + per-rung/per-arch numbers
+     only; everything else in BENCH_evidence.json.
+  3. DEADLINE-AWARE phases: the parent passes an absolute deadline down
+     (HYDRAGNN_BENCH_DEADLINE); the child checks a per-unit wall-clock
+     estimate before starting each expensive unit and records what it
+     skipped, so rc=0 + a parseable line survive ANY outer budget.
+
+The child also enables JAX's persistent compilation cache
+(``.jax_cache/`` beside this file, opt out HYDRAGNN_BENCH_NOCACHE=1):
+measured 2.2 s -> 0.03 s across processes on this chip's axon runtime,
+which converts the dominant per-phase cost (20-40 s XLA compiles) into
+cache hits on every run after the first.
+
+Evidence blocks (round-3 VERDICT items 1/2/5):
 
   value                  chip-loop ceiling, graphs/sec/chip (headline; same
                          definition as rounds 1-2 for comparability)
@@ -41,10 +66,15 @@ parent scans stdout in reverse — a timeout mid-phase still yields the most
 complete finished measurement.
 
 Env knobs: HYDRAGNN_BENCH_PLATFORM=tpu|cpu|auto (default auto),
-HYDRAGNN_BENCH_TIMEOUT (seconds per TPU attempt, default 1800),
-HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,sustained_default,
-sustained,dense,archs; default all on TPU, ceiling-only on CPU),
-HYDRAGNN_BENCH_DTYPE (flagship compute dtype, default float32).
+HYDRAGNN_BENCH_TOTAL_BUDGET (parent wall-clock seconds, default 1500 —
+sized to sit under the driver's observed ~30 min kill with headroom),
+HYDRAGNN_BENCH_TIMEOUT (seconds for the first TPU attempt, default
+1260), HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,
+sustained_default,sustained,dense,archs; default all-but-`sustained`
+on TPU — the knobbed sustained variant duplicates sustained_default's
+path and is opt-in — ceiling-only on CPU), HYDRAGNN_BENCH_DTYPE
+(flagship compute dtype, default float32), HYDRAGNN_BENCH_NOCACHE=1
+(disable the persistent compile cache).
 """
 
 from __future__ import annotations
@@ -440,28 +470,80 @@ def _sustained(samples, heads, default_path=False):
 # child
 # ---------------------------------------------------------------------------
 
+_EVIDENCE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_evidence.json")
+
+# conservative per-unit wall-clock estimates (s) for the deadline guard —
+# COLD-compile numbers; with the persistent compile cache warm the real
+# costs are several times smaller, so the guard only bites when the cache
+# is cold AND the outer budget is tight, which is exactly when skipping
+# the tail phases is the right call.
+_EST = {
+    "roofline": 60, "dense_256": 100, "dense_512": 150, "dense_1024": 340,
+    "arch": 50, "arch_slow": 100, "sustained_default": 180, "sustained": 160,
+}
+
+
+def _deadline_remaining() -> float:
+    d = float(os.getenv("HYDRAGNN_BENCH_DEADLINE", "0") or 0.0)
+    return (d - time.time()) if d > 0 else float("inf")
+
+
+def _shrunk(compact: dict) -> str:
+    """Serialize the compact line, enforcing the <1 KB driver-tail contract
+    by dropping optional blocks in reverse-importance order if needed."""
+    line = json.dumps(compact, separators=(",", ":"))
+    for drop in ("skipped", "sustained_gps", "dense", "archs"):
+        if len(line) <= 1000:
+            break
+        compact = {k: v for k, v in compact.items() if k != drop}
+        line = json.dumps(compact, separators=(",", ":"))
+    return line
+
 
 def _child(platform: str) -> None:
-    """Run the measurement phases, re-printing the cumulative headline JSON
-    line after each.  May hang/crash on a bad TPU backend — the parent
-    enforces the timeout and keeps the last finished line."""
+    """Run the measurement phases under the parent-supplied deadline,
+    printing the cumulative COMPACT line after every finished unit (the
+    parent tees it straight through, so a kill at any point leaves the
+    most complete measurement as the last stdout line) and mirroring the
+    full evidence to BENCH_evidence.json."""
     # flagship tuning: the fused message-passing kernel (ops/fused_mp.py) is
     # exact (tests/test_fused_mp.py) and measured +26% end-to-end at these
-    # shapes (61.0k -> 76.6k graphs/s dense-schedule; docs/PERF.md)
-    os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
+    # shapes (61.0k -> 76.6k graphs/s dense-schedule; docs/PERF.md).  On the
+    # CPU fallback the fused kernels would run in Pallas INTERPRET mode —
+    # minutes per step — so the composed XLA path (what a CPU user gets)
+    # stays the backend there.
+    if platform != "cpu":
+        os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
 
     import jax
+
+    if os.getenv("HYDRAGNN_BENCH_NOCACHE", "0") != "1":
+        # persistent XLA compile cache: 20-40 s cold compiles become ~30 ms
+        # hits on every later run (measured on this chip's axon runtime) —
+        # the single biggest lever for fitting the driver's wall budget
+        try:
+            cache_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception as e:  # noqa: BLE001 — cache is an optimization
+            print(f"bench: compile cache unavailable: {e!r}", file=sys.stderr)
 
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
     devs = jax.devices()
     on_tpu = devs[0].platform == "tpu"
-    print(f"bench: platform={devs[0].platform} devices={len(devs)}",
-          file=sys.stderr)
+    print(f"bench: platform={devs[0].platform} devices={len(devs)} "
+          f"deadline_in={_deadline_remaining():.0f}s", file=sys.stderr)
 
+    # `sustained` (the hand-knobbed variant) is opt-in: sustained_default
+    # measures the same trainer path as _auto_pipeline actually ships it
     default_phases = (
-        "ceiling,roofline,sustained_default,sustained,dense,archs"
+        "ceiling,roofline,sustained_default,dense,archs"
         if on_tpu else "ceiling")
     phases = [p.strip() for p in os.getenv(
         "HYDRAGNN_BENCH_PHASES", default_phases).split(",") if p.strip()]
@@ -469,11 +551,37 @@ def _child(platform: str) -> None:
     n_iters = 200 if on_tpu else 5
     n_repeats = 3 if on_tpu else 1
 
-    result = {"metric": METRIC, "value": 0.0, "unit": UNIT,
-              "vs_baseline": 0.0, "platform": devs[0].platform}
+    # compact: what the driver's tail window parses (<1 KB).
+    # evidence: the full record, mirrored to BENCH_evidence.json.
+    compact = {"metric": METRIC, "value": 0.0, "unit": UNIT,
+               "vs_baseline": 0.0, "platform": devs[0].platform,
+               "evidence": "BENCH_evidence.json"}
+    evidence = {"metric": METRIC, "value": 0.0, "unit": UNIT,
+                "vs_baseline": 0.0, "platform": devs[0].platform}
+    skipped = []
 
     def emit():
-        print(json.dumps(result), flush=True)
+        if skipped:
+            compact["skipped"] = skipped
+            evidence["skipped"] = skipped
+        try:
+            tmp = _EVIDENCE_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(evidence, f, indent=1)
+            os.replace(tmp, _EVIDENCE_PATH)
+        except Exception as e:  # noqa: BLE001 — never fail the line for it
+            print(f"bench: evidence write failed: {e!r}", file=sys.stderr)
+        print(_shrunk(dict(compact)), flush=True)
+
+    def want(phase, est):
+        if phase not in phases:
+            return False
+        if _deadline_remaining() < est:
+            skipped.append(phase)
+            print(f"bench: skipping {phase} (needs ~{est}s, "
+                  f"{_deadline_remaining():.0f}s left)", file=sys.stderr)
+            return False
+        return True
 
     # --- ceiling (headline) ---
     t_c = time.perf_counter()
@@ -482,48 +590,28 @@ def _child(platform: str) -> None:
     print(f"bench: flagship compile+measure "
           f"{time.perf_counter() - t_c:.1f}s", file=sys.stderr)
     gps = 512 / step_s
-    result["value"] = round(gps, 2)
-    # a CPU-fallback run must not be ratioed against the TPU baseline
-    result["vs_baseline"] = round(_baseline_ratio(gps) if on_tpu else 1.0, 4)
-    result["step_ms"] = round(step_s * 1e3, 3)
+    for d in (compact, evidence):
+        d["value"] = round(gps, 2)
+        # a CPU-fallback run must not be ratioed against the TPU baseline
+        d["vs_baseline"] = round(_baseline_ratio(gps) if on_tpu else 1.0, 4)
+        d["step_ms"] = round(step_s * 1e3, 3)
     emit()
 
-    if "roofline" in phases:
+    if want("roofline", _EST["roofline"]):
         try:
-            result["roofline"] = _roofline(step, state, batch, step_s)
-            result["membw_probe_gbps"] = _membw_probe()
+            rf = _roofline(step, state, batch, step_s)
+            evidence["roofline"] = rf
+            evidence["membw_probe_gbps"] = _membw_probe()
+            compact["roofline"] = {
+                "mfu_pct": rf["mfu_pct"], "hbm_gbps": rf["hbm_gbps"]}
             emit()
         except Exception as e:  # noqa: BLE001
             print(f"bench: roofline failed: {e!r}", file=sys.stderr)
 
     # flagship state/batch/step are dead past roofline — drop them (and the
-    # executables pinning them) before the trainer-based sustained phases
-    _release_device()
-
-    if "sustained_default" in phases:
-        # out-of-the-box run_training: NO env knobs; _auto_pipeline picks
-        # scan/residency, val/test epochs run (round-4 default-path number)
-        try:
-            t0 = time.perf_counter()
-            result["sustained_default"] = _sustained(
-                samples, heads, default_path=True)
-            print(f"bench: sustained_default {time.perf_counter() - t0:.1f}s",
-                  file=sys.stderr)
-            emit()
-        except Exception as e:  # noqa: BLE001
-            print(f"bench: sustained_default failed: {e!r}", file=sys.stderr)
-        _release_device()
-
-    if "sustained" in phases:
-        try:
-            t0 = time.perf_counter()
-            result["sustained"] = _sustained(samples, heads)
-            print(f"bench: sustained {time.perf_counter() - t0:.1f}s",
-                  file=sys.stderr)
-            emit()
-        except Exception as e:  # noqa: BLE001
-            print(f"bench: sustained failed: {e!r}", file=sys.stderr)
-
+    # executables pinning them) before the trainer-based phases.  NOTE
+    # (_release_device contract): no device array may be held across this
+    # call; `samples`/`heads` used below are host-side numpy.
     _release_device()
 
     if "dense" in phases:
@@ -534,7 +622,14 @@ def _child(platform: str) -> None:
         # docs/PERF.md) — the bench records the realistic points plus the
         # best-MFU corner, the doc records the full ladder
         dense = {}
+        dense_c = {}
         for hidden, dense_batch in ((256, 512), (512, 512), (1024, 2048)):
+            est = _EST[f"dense_{hidden}"]
+            if _deadline_remaining() < est:
+                skipped.append(f"dense_{hidden}")
+                print(f"bench: skipping dense h{hidden} (needs ~{est}s, "
+                      f"{_deadline_remaining():.0f}s left)", file=sys.stderr)
+                continue
             try:
                 t0 = time.perf_counter()
                 dstate, dbatch, dstep, dcfg, _s, _h = _build(
@@ -556,6 +651,7 @@ def _child(platform: str) -> None:
                 # program flops simply remain the — undercounting — basis).
                 from hydragnn_tpu.models.schnet import _scf_pipeline_enabled
 
+                dres["flops_method"] = "XLA cost model of the timed program"
                 if _scf_pipeline_enabled(hidden, 50):
                     prior = os.environ.get("HYDRAGNN_SCF_FUSED")
                     os.environ["HYDRAGNN_SCF_FUSED"] = "0"
@@ -574,6 +670,9 @@ def _child(platform: str) -> None:
                             "program (the fused CFConv pipeline's Pallas "
                             "call is opaque to the XLA cost model)")
                     except Exception as fe:  # noqa: BLE001
+                        dres["flops_method"] = (
+                            "fused-program cost model (twin compile "
+                            "failed — undercounts the Pallas call)")
                         print(f"bench: dense h{hidden} twin-flops basis "
                               f"failed (kept fused-program flops): {fe!r}",
                               file=sys.stderr)
@@ -582,12 +681,19 @@ def _child(platform: str) -> None:
                             os.environ.pop("HYDRAGNN_SCF_FUSED", None)
                         else:
                             os.environ["HYDRAGNN_SCF_FUSED"] = prior
-                dense[f"SchNet-h{hidden}-bf16-b{dense_batch}"] = dres
+                name = f"SchNet-h{hidden}-bf16-b{dense_batch}"
+                dense[name] = dres
+                dense_c[f"h{hidden}"] = {
+                    "gps": round(dres["graphs_per_sec"]),
+                    "mfu": dres["mfu_pct"]}
                 print(f"bench: dense h{hidden} b{dense_batch} "
                       f"{dres['achieved_tflops']} TF ({dres['mfu_pct']}% "
                       f"MFU) {time.perf_counter() - t0:.1f}s",
                       file=sys.stderr)
-                result["dense"] = dict(dense)
+                evidence["dense"] = dict(dense)
+                compact["dense"] = dict(dense_c)
+                compact["mfu_pct"] = max(
+                    v["mfu"] for v in dense_c.values())
                 emit()
             except Exception as e:  # noqa: BLE001
                 print(f"bench: dense h{hidden} failed: {e!r}",
@@ -596,21 +702,32 @@ def _child(platform: str) -> None:
 
     if "archs" in phases:
         sweep = {}
+        sweep_c = {}
         # DimeNet-bf16: user-selectable mixed_precision run of the slow-tail
         # arch — the basis-stream cast (models/dimenet.py) keeps the [T, *]
         # triplet chain in bf16 (12.5k vs 8.1k g/s measured on the v5e).
         # Skipped when the whole sweep already runs bf16 (identical config).
+        # GAT-h128: the one at-width zoo row (round-4 VERDICT item 8) — the
+        # fused GATv2 kernel's width win, driver-visible.
         extra = [] if dtype == "bfloat16" else ["DimeNet-bf16"]
+        extra.append("GAT-h128")
         for arch in ARCHS + extra:
+            est = (_EST["arch_slow"] if arch.startswith(("DimeNet", "GAT"))
+                   else _EST["arch"])
+            if _deadline_remaining() < est:
+                skipped.append(f"arch_{arch}")
+                continue
             try:
                 t0 = time.perf_counter()
                 adtype = dtype
+                hidden = 64
+                arch_model = arch
                 if arch.endswith("-bf16"):
                     arch_model, adtype = arch[:-5], "bfloat16"
-                else:
-                    arch_model = arch
+                elif arch.endswith("-h128"):
+                    arch_model, hidden = arch[:-5], 128
                 astate, abatch, astep, acfg, _s, _h = _build(
-                    model_type=arch_model, dtype=adtype)
+                    model_type=arch_model, hidden=hidden, dtype=adtype)
                 astep_s, astate = _chip_loop(
                     astate, abatch, astep, max(n_iters // 4, 2),
                     max(n_repeats - 1, 1))
@@ -618,14 +735,46 @@ def _child(platform: str) -> None:
                     "graphs_per_sec": round(512 / astep_s, 1),
                     "step_ms": round(astep_s * 1e3, 3),
                 }
+                sweep_c[arch] = round(512 / astep_s)
                 print(f"bench: arch {arch} {512 / astep_s:,.0f} g/s "
                       f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
             except Exception as e:  # noqa: BLE001
                 sweep[arch] = {"error": repr(e)[:160]}
+                sweep_c[arch] = -1
                 print(f"bench: arch {arch} failed: {e!r}", file=sys.stderr)
             _release_device()
-            result["archs"] = dict(sweep)
+            evidence["archs"] = dict(sweep)
+            compact["archs"] = dict(sweep_c)
             emit()
+
+    if want("sustained_default", _EST["sustained_default"]):
+        # out-of-the-box run_training: NO env knobs; _auto_pipeline picks
+        # scan/residency, val/test epochs run (round-4 default-path number)
+        try:
+            t0 = time.perf_counter()
+            sd = _sustained(samples, heads, default_path=True)
+            evidence["sustained_default"] = sd
+            compact["sustained_gps"] = round(sd["graphs_per_sec"])
+            print(f"bench: sustained_default {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: sustained_default failed: {e!r}", file=sys.stderr)
+        _release_device()
+
+    if want("sustained", _EST["sustained"]):
+        try:
+            t0 = time.perf_counter()
+            evidence["sustained"] = _sustained(samples, heads)
+            print(f"bench: sustained {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: sustained failed: {e!r}", file=sys.stderr)
+
+    # unconditional final emit: deadline-skipped phases must still be
+    # visible in the LAST line even when no later phase emitted
+    emit()
 
 
 # ---------------------------------------------------------------------------
@@ -634,63 +783,84 @@ def _child(platform: str) -> None:
 
 
 def _try_child(platform: str, timeout: float):
-    """Run the child; return the parsed JSON dict or None."""
+    """Run the child, TEEING its stdout through live (round-4 post-mortem:
+    a buffered parent loses every finished phase when the DRIVER kills the
+    parent — teed lines are already on the driver's captured stdout the
+    moment the child emits them).  Returns the last parsed line or None."""
+    import threading
+
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
     else:
         # let the pre-registered TPU plugin claim the backend
         env.pop("JAX_PLATFORMS", None)
+    # absolute deadline for the child's phase guard, with teardown margin
+    env["HYDRAGNN_BENCH_DEADLINE"] = repr(
+        time.time() + max(timeout - 30.0, 60.0))
 
-    def parse(stdout):
-        for line in reversed((stdout or "").strip().splitlines()):
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", platform],
+        env=env, stdout=subprocess.PIPE, text=True, bufsize=1)
+    holder = {}
+
+    def pump():
+        for line in p.stdout:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            print(line, flush=True)  # tee: survives an outer parent-kill
             try:
                 d = json.loads(line)
                 if d.get("metric") == METRIC:
-                    return d
-            except (json.JSONDecodeError, AttributeError):
-                continue
-        return None
+                    holder["last"] = d
+            except json.JSONDecodeError:
+                pass
 
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
     try:
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", platform],
-            env=env, capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired as e:
+        p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
         print(f"bench: {platform} attempt timed out after {timeout:.0f}s",
               file=sys.stderr)
-        # the child prints a finished line after every phase, so a timeout
-        # mid-phase still leaves the most complete measurement in stdout
-        out = e.stdout
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
-        return parse(out)
-    if p.stderr:
-        sys.stderr.write(p.stderr[-4000:])
+        p.kill()
+        p.wait()
+    t.join(timeout=10)
     if p.returncode != 0:
         print(f"bench: {platform} attempt rc={p.returncode}", file=sys.stderr)
-        # a crash mid-phase may still follow completed emits
-        return parse(p.stdout)
-    got = parse(p.stdout)
-    if got is None:
+    if holder.get("last") is None:
         print(f"bench: {platform} attempt printed no JSON line",
               file=sys.stderr)
-    return got
+    return holder.get("last")
 
 
 def main() -> None:
     want = os.getenv("HYDRAGNN_BENCH_PLATFORM", "auto").lower()
-    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "1800"))
-    attempts = []
+    # overall parent budget, sized to finish (rc=0) inside the driver's
+    # wall-clock kill with headroom; the r04 rc=124 means the old
+    # 2x1800s-attempt structure could never fit
+    start = time.time()
+    total = float(os.getenv("HYDRAGNN_BENCH_TOTAL_BUDGET", "1500"))
+    deadline = start + total
+    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "1260"))
+    result = None
     if want in ("auto", "tpu"):
-        attempts += [("tpu", tpu_timeout), ("tpu", tpu_timeout)]
-    if want in ("auto", "cpu"):
-        attempts += [("cpu", 1200.0)]
-    for platform, timeout in attempts:
-        result = _try_child(platform, timeout)
-        if result is not None and result.get("value"):
-            print(json.dumps(result))
-            return
+        result = _try_child("tpu", min(tpu_timeout, deadline - time.time()))
+        if (result is None or not result.get("value")) \
+                and deadline - time.time() > 180:
+            # one shorter retry only if the first attempt produced nothing
+            result = _try_child(
+                "tpu", min(420.0, deadline - time.time())) or result
+    if (result is None or not result.get("value")) and want in ("auto",
+                                                                "cpu"):
+        budget = max(min(600.0, deadline - time.time()), 120.0)
+        result = _try_child("cpu", budget) or result
+    if result is not None and result.get("value"):
+        # re-print so the LAST stdout line is always the best parse (teed
+        # partials from a killed attempt precede it)
+        print(json.dumps(result))
+        return
     # total failure: still emit a parseable line with diagnostics
     print(json.dumps({
         "metric": METRIC,

@@ -57,7 +57,10 @@ def gather_mul_segment(x, w, g):
         from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
 
         w = w * _bcast(g.edge_mask, w)
-        return gather_mul_segment_sum(x, w, g.senders, g.receivers, perm)
+        # edge_valid: the kernel's schedule skips masked-edge blocks
+        # outright (~half the slots at flagship padding ratios)
+        return gather_mul_segment_sum(x, w, g.senders, g.receivers, perm,
+                                      edge_valid=g.edge_mask)
     return segment_sum(
         x[g.senders] * w, g.receivers, x.shape[0], g.edge_mask)
 
@@ -178,7 +181,10 @@ def scatter_segment(data, g):
         from hydragnn_tpu.ops.fused_mp import segment_sum_dense
 
         data = data * _bcast(g.edge_mask, data)
-        return segment_sum_dense(data, g.receivers, g.num_nodes)
+        # valid: schedule-skips padding-edge blocks (collate parks them
+        # zero-valued and tail-sorted)
+        return segment_sum_dense(data, g.receivers, g.num_nodes,
+                                 valid=g.edge_mask)
     return segment_sum(data, g.receivers, g.num_nodes, g.edge_mask)
 
 

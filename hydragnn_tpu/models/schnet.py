@@ -148,7 +148,10 @@ class SCFConv(nn.Module):
             from hydragnn_tpu.ops.scf_mp import scf_edge_pipeline
 
             cm = cut * g.edge_mask
-            agg = scf_edge_pipeline(h, rbf, cm, k0, b0, k1, b1,
+            # em: schedule-skip validity (kernel never visits masked-edge
+            # blocks — ~half the edge slots at flagship padding ratios)
+            em = g.edge_mask.astype(jnp.int32)
+            agg = scf_edge_pipeline(h, rbf, cm, em, k0, b0, k1, b1,
                                     g.senders, g.receivers, perm)
         else:
             # lowers to the fused gather-multiply-aggregate Pallas kernel

@@ -138,7 +138,8 @@ def _fwd_kernel(has_w, window, si_ref, se_ref, av_ref, fi_ref, send_ref,
             preferred_element_type=jnp.float32)          # [BN, F]
 
 
-def _fused_impl(x, w, senders, receivers, interpret, mask=None, window=3):
+def _fused_impl(x, w, senders, receivers, interpret, mask=None, window=3,
+                edge_valid=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -159,7 +160,18 @@ def _fused_impl(x, w, senders, receivers, interpret, mask=None, window=3):
              else mask.astype(jnp.float32))
         w_p = jnp.zeros((e_pad, 1), jnp.float32).at[:e, 0].set(m)
     # shape-padding edges: park outside every block/window so they can't
-    # contribute even with nonzero data (their w rows are zero anyway)
+    # contribute even with nonzero data (their w rows are zero anyway).
+    # MASK-padding edges (edge_valid == 0 — the batch's own padding, ~half
+    # the edge slots at flagship collate shapes) are parked the same way,
+    # so the dense schedule assigns their edge blocks to NO node block and
+    # never spends a step on them.  Contract (callers): masked edges carry
+    # zero w/mask AND sort after all real edges in the current ordering
+    # (collate parks them on node N-1, the maximum id, so both the
+    # receiver sort and the stable sender argsort keep them last).
+    if edge_valid is not None:
+        ev = edge_valid != 0
+        senders = jnp.where(ev, senders, n_pad)
+        receivers = jnp.where(ev, receivers, n_pad)
     send_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
         senders.astype(jnp.int32))
     recv_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
@@ -202,7 +214,7 @@ def _fused_impl(x, w, senders, receivers, interpret, mask=None, window=3):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
-                           window=3):
+                           window=3, edge_valid=None):
     """``out[n, f] = sum_{e: recv[e]=n} x[send[e], f] * w[e, f]``.
 
     REQUIRES (collate invariants — see module docstring): nondecreasing
@@ -219,19 +231,28 @@ def gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
     gathers from blocks i-w//2..i+w//2 — 3 suffices for node-space message
     passing (graphs within one node block); DimeNet's triplet interaction
     runs in EDGE space where graphs span up to ~2 blocks and needs 5.
+
+    ``edge_valid`` (optional int mask, 1 = real) lets the schedule SKIP
+    masked-edge blocks outright (halves scheduled work at flagship
+    padding ratios).  Contract: edge_valid == 0 edges carry zero ``w``
+    rows and sort after all real edges in BOTH edge orderings (collate
+    guarantees this); their dw cotangent is computed densely and is
+    exact regardless.
     """
     interpret = jax.default_backend() != "tpu"
-    return _fused_impl(x, w, senders, receivers, interpret, window=window)
+    return _fused_impl(x, w, senders, receivers, interpret, window=window,
+                       edge_valid=edge_valid)
 
 
-def _vjp_fwd(x, w, senders, receivers, sender_perm, window=3):
+def _vjp_fwd(x, w, senders, receivers, sender_perm, window=3,
+             edge_valid=None):
     out = gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
-                                 window)
-    return out, (x, w, senders, receivers, sender_perm)
+                                 window, edge_valid)
+    return out, (x, w, senders, receivers, sender_perm, edge_valid)
 
 
 def _vjp_bwd(window, res, g):
-    x, w, senders, receivers, sender_perm = res
+    x, w, senders, receivers, sender_perm, edge_valid = res
     # dL/dw[e] = x[send[e]] * g[recv[e]] — plain gathers (recv gather is
     # over sorted indices)
     dw = (x[senders] * g[receivers]).astype(w.dtype)
@@ -243,8 +264,9 @@ def _vjp_bwd(window, res, g):
     dx = _fused_impl(
         g.astype(jnp.float32), w[sender_perm].astype(jnp.float32),
         receivers[sender_perm], senders[sender_perm],
-        jax.default_backend() != "tpu", window=window)
-    return dx.astype(x.dtype), dw, None, None, None
+        jax.default_backend() != "tpu", window=window,
+        edge_valid=None if edge_valid is None else edge_valid[sender_perm])
+    return dx.astype(x.dtype), dw, None, None, None, None
 
 
 gather_mul_segment_sum.defvjp(_vjp_fwd, _vjp_bwd)
@@ -255,9 +277,12 @@ def gather_segment_sum(x, senders, receivers, sender_perm, mask=None):
     """``out[n] = sum_{e: recv[e]=n} mask[e] * x[send[e]]`` — the w-less
     variant (GIN/MFC-style neighbor sum) with the same invariants as
     :func:`gather_mul_segment_sum`; ``mask`` is the [E] edge mask (padding
-    edges contribute nothing).  Differentiable wrt ``x`` only."""
+    edges contribute nothing — and their blocks are schedule-skipped, so
+    mask == 0 edges must sort after all real edges, which collate
+    guarantees).  Differentiable wrt ``x`` only."""
     interpret = jax.default_backend() != "tpu"
-    return _fused_impl(x, None, senders, receivers, interpret, mask=mask)
+    return _fused_impl(x, None, senders, receivers, interpret, mask=mask,
+                       edge_valid=mask)
 
 
 def _gss_fwd(x, senders, receivers, sender_perm, mask=None):
@@ -270,10 +295,10 @@ def _gss_bwd(res, g):
     if sender_perm is None:
         sender_perm = jnp.argsort(senders, stable=True)
     interpret = jax.default_backend() != "tpu"
+    mp = None if mask is None else mask[sender_perm]
     dx = _fused_impl(
         g.astype(jnp.float32), None, receivers[sender_perm],
-        senders[sender_perm], interpret,
-        mask=None if mask is None else mask[sender_perm])
+        senders[sender_perm], interpret, mask=mp, edge_valid=mp)
     return dx.astype(g.dtype), None, None, None, None
 
 
@@ -351,20 +376,27 @@ def _scatter_impl(data2d, sorted_ids, num_segments, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def segment_sum_dense(data, sorted_ids, num_segments):
+def segment_sum_dense(data, sorted_ids, num_segments, valid=None):
     """Exact segment sum REQUIRING nondecreasing ``sorted_ids`` (collate's
     receivers / node_gid invariant) — one dense-schedule Pallas pass
     instead of XLA's sort-based scatter.  Any id distribution is processed
     exactly (no degree bound); out-of-range ids contribute nothing.
+    ``valid`` (optional int mask, 1 = real) parks masked rows out of
+    range so the schedule skips their blocks; masked rows must carry zero
+    ``data`` and sort last (collate guarantees both for padding edges).
     Differentiable wrt ``data``."""
     shape = data.shape
     interpret = jax.default_backend() != "tpu"
+    if valid is not None:
+        sorted_ids = jnp.where(valid != 0, sorted_ids, num_segments)
     out = _scatter_impl(
         data.reshape(shape[0], -1), sorted_ids, num_segments, interpret)
     return out.reshape((num_segments,) + shape[1:])
 
 
-def _ssd_fwd(data, sorted_ids, num_segments):
+def _ssd_fwd(data, sorted_ids, num_segments, valid=None):
+    if valid is not None:
+        sorted_ids = jnp.where(valid != 0, sorted_ids, num_segments)
     return segment_sum_dense(data, sorted_ids, num_segments), (
         sorted_ids, data.shape)
 
@@ -372,10 +404,10 @@ def _ssd_fwd(data, sorted_ids, num_segments):
 def _ssd_bwd(num_segments, res, g):
     sorted_ids, shape = res
     g2 = g.reshape(num_segments, -1)
-    valid = (sorted_ids >= 0) & (sorted_ids < num_segments)
+    ok = (sorted_ids >= 0) & (sorted_ids < num_segments)
     safe = jnp.clip(sorted_ids, 0, num_segments - 1)
-    d = jnp.where(valid[:, None], g2[safe], 0.0)
-    return d.reshape(shape), None
+    d = jnp.where(ok[:, None], g2[safe], 0.0)
+    return d.reshape(shape), None, None
 
 
 segment_sum_dense.defvjp(_ssd_fwd, _ssd_bwd)

@@ -84,18 +84,33 @@ def _window_maps(n_blocks):
     return eix, xoff, const, outx
 
 
-def _pack_edges(rbf, cm, senders, receivers, e_pad, n_pad):
-    """Pad edge arrays; bias lane (_GP - 1) of rbf is constant 1.0."""
+def _pack_edges(rbf, cm, em, senders, receivers, e_pad, n_pad):
+    """Pad edge arrays; bias lane (_GP - 1) of rbf is constant 1.0.
+
+    MASKED edges (em == 0) are parked on the out-of-range sentinel node
+    ``n_pad`` alongside the shape-padding slots, so the dense schedule
+    assigns their edge blocks to NO node block and never visits them —
+    at flagship collate shapes HALF the edge slots are batch padding, so
+    this halves the kernel's scheduled MXU work.  Exactness: an em == 0
+    edge must carry cm == 0 (callers derive em from the same mask that
+    zeroes cm), so it contributes nothing forward (filt = f2 * cm) and
+    all its grads except dcm are proportional to cm; the caller-facing
+    contract is that dcm is ZERO for masked edges (scf_edge_pipeline
+    docstring).  Requires masked edges to sort AFTER all real edges in
+    both edge orderings (collate parks them on node N-1, the maximum
+    id — the invariant holds for the receiver sort and the stable
+    sender argsort)."""
     e, g = rbf.shape
     rbf_p = jnp.zeros((e_pad, _GP), jnp.float32)
     rbf_p = rbf_p.at[:e, :g].set(rbf.astype(jnp.float32))
     rbf_p = rbf_p.at[:, _GP - 1].set(1.0)
     cm_p = jnp.zeros((e_pad, 1), jnp.float32).at[:e, 0].set(
         cm.astype(jnp.float32))
+    valid = em != 0
     send_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
-        senders.astype(jnp.int32))
+        jnp.where(valid, senders, n_pad).astype(jnp.int32))
     recv_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
-        receivers.astype(jnp.int32))
+        jnp.where(valid, receivers, n_pad).astype(jnp.int32))
     return rbf_p, cm_p, send_p, recv_p
 
 
@@ -188,7 +203,7 @@ def _fwd_kernel(si_ref, se_ref, av_ref, fi_ref,
         out_ref[:] += _dot(onehot_r, msg, ((0,), (0,)), w1_ref.dtype)
 
 
-def _fwd_impl(h, rbf, cm, senders, receivers, interpret):
+def _fwd_impl(h, rbf, cm, em, senders, receivers, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -205,7 +220,7 @@ def _fwd_impl(h, rbf, cm, senders, receivers, interpret):
     # upcast per block); under bf16 this halves the dominant window traffic
     h_p = jnp.zeros((n_pad, f_pad), h.dtype).at[:n, :f].set(h)
     rbf_p, cm_p, send_p, recv_p = _pack_edges(
-        rbf, cm, senders, receivers, e_pad, n_pad)
+        rbf, cm, em, senders, receivers, e_pad, n_pad)
 
     step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
         recv_p[:, 0], n_blocks, bn, be, n_eblocks)
@@ -343,21 +358,31 @@ def _bwd_s_kernel(si_ref, se_ref, av_ref, fi_ref,
 
 
 @jax.custom_vjp
-def scf_edge_pipeline(h, rbf, cm, w0, b0, w1, b1, senders, receivers,
+def scf_edge_pipeline(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
                       sender_perm):
     """``out[n] = sum_{e: recv[e]=n} h[send[e]] * filt_e`` with
     ``filt_e = (ssp(rbf_e @ w0 + b0) @ w1 + b1) * cm_e`` computed in-VMEM.
 
     Differentiable wrt h, rbf, cm, w0, b0, w1, b1.  Requires fused_mp's
     collate invariants plus G <= 127 and F <= SCF_F_LIMIT (callers gate).
-    ``cm`` must be zero on padding edges (it carries the edge mask)."""
-    out, _ = _scf_fwd_res(h, rbf, cm, w0, b0, w1, b1, senders, receivers)
+    ``cm`` must be zero on padding edges (it carries the edge mask).
+    ``em`` is the int32 edge-validity mask (1 = real): em == 0 edges are
+    skipped by the block schedule entirely, halving the scheduled MXU
+    work at flagship padding ratios.  Contract: em == 0 edges carry
+    cm == 0, sort after all real edges in both edge orderings (collate
+    guarantees this), and get EXACTLY ZERO for every grad — including
+    dcm, whose true value at cm == 0 need not be zero; callers must not
+    consume dcm on masked edges (SchNet's hard-zeroed cutoff `where`
+    satisfies this)."""
+    out, _ = _scf_fwd_res(h, rbf, cm, em, w0, b0, w1, b1, senders,
+                          receivers)
     return out
 
 
-def _scf_fwd_res(h, rbf, cm, w0, b0, w1, b1, senders, receivers):
+def _scf_fwd_res(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers):
     interpret = jax.default_backend() != "tpu"
-    run, (f_pad, n, f) = _fwd_impl(h, rbf, cm, senders, receivers, interpret)
+    run, (f_pad, n, f) = _fwd_impl(h, rbf, cm, em, senders, receivers,
+                                   interpret)
     w0_p, w1_p, b1_p = _pack_weights(w0, b0, w1, b1, f_pad)
     if h.dtype == jnp.bfloat16:
         # halves the constant weight blocks' VMEM and skips the per-step
@@ -368,17 +393,19 @@ def _scf_fwd_res(h, rbf, cm, w0, b0, w1, b1, senders, receivers):
     return out[:n, :f].astype(h.dtype), f_pad
 
 
-def _scf_vjp_fwd(h, rbf, cm, w0, b0, w1, b1, senders, receivers,
+def _scf_vjp_fwd(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
                  sender_perm):
-    out, _ = _scf_fwd_res(h, rbf, cm, w0, b0, w1, b1, senders, receivers)
-    return out, (h, rbf, cm, w0, b0, w1, b1, senders, receivers, sender_perm)
+    out, _ = _scf_fwd_res(h, rbf, cm, em, w0, b0, w1, b1, senders,
+                          receivers)
+    return out, (h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
+                 sender_perm)
 
 
 def _scf_vjp_bwd(res, ga):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    h, rbf, cm, w0, b0, w1, b1, senders, receivers, sender_perm = res
+    h, rbf, cm, em, w0, b0, w1, b1, senders, receivers, sender_perm = res
     interpret = jax.default_backend() != "tpu"
     n, f = h.shape
     e, g = rbf.shape
@@ -400,7 +427,7 @@ def _scf_vjp_bwd(res, ga):
         w0_p = w0_p.astype(jnp.bfloat16)
         w1_p = w1_p.astype(jnp.bfloat16)
     rbf_p, cm_p, send_p, recv_p = _pack_edges(
-        rbf, cm, senders, receivers, e_pad, n_pad)
+        rbf, cm, em, senders, receivers, e_pad, n_pad)
 
     eix, xoff, const, outx = _window_maps(n_blocks)
 
@@ -454,8 +481,8 @@ def _scf_vjp_bwd(res, ga):
     e_pad_s = _round_up(max(e, 1), be_s)
     n_eblocks_s = e_pad_s // be_s
     rbf_s, cm_s, send_s, recv_s = _pack_edges(
-        rbf[sender_perm], cm[sender_perm], senders[sender_perm],
-        receivers[sender_perm], e_pad_s, n_pad)
+        rbf[sender_perm], cm[sender_perm], em[sender_perm],
+        senders[sender_perm], receivers[sender_perm], e_pad_s, n_pad)
     step_i2, step_eb2, acc_valid2, is_first2, s_max2 = _dense_schedule(
         send_s[:, 0], n_blocks, bn, be_s, n_eblocks_s)
     grid_s = pltpu.PrefetchScalarGridSpec(
@@ -485,15 +512,21 @@ def _scf_vjp_bwd(res, ga):
       ga_p, ga_p, ga_p)
 
     dh = dh_p[:n, :f].astype(h.dtype)
-    drbf = drbf_p[:e, :g].astype(rbf.dtype)
-    dcm = drbf_p[:e, _GP - 1].astype(cm.dtype)
+    # masked-edge blocks are never visited (schedule skip — _pack_edges),
+    # so their drbf output rows are uninitialized memory: select them to
+    # zero with `where` — a multiply would propagate NaN/Inf garbage bits
+    # (0 * NaN = NaN).  Their true grads are 0 except dcm, which the
+    # contract defines as 0 too.
+    valid = (em != 0)[:, None]
+    drbf = jnp.where(valid, drbf_p[:e, :g], 0.0).astype(rbf.dtype)
+    dcm = jnp.where(valid[:, 0], drbf_p[:e, _GP - 1], 0.0).astype(cm.dtype)
     # weight grads: slice the pads; b0 rides W0's bias lane; db1's rows
     # were pre-divided by the row count so their sum is the true grad
     dw0 = dw0_p[:g, :f].astype(w0.dtype)
     db0 = dw0_p[_GP - 1, :f].astype(b0.dtype)
     dw1 = dw1_p[:f, :f].astype(w1.dtype)
     db1 = jnp.sum(db1_p[:, :f], axis=0).astype(b1.dtype)
-    return (dh, drbf, dcm, dw0, db0, dw1, db1, None, None, None)
+    return (dh, drbf, dcm, None, dw0, db0, dw1, db1, None, None, None)
 
 
 scf_edge_pipeline.defvjp(_scf_vjp_fwd, _scf_vjp_bwd)

@@ -1,0 +1,49 @@
+"""In-situ per-layer cost of the fused CFConv pipeline: train-step time
+at num_conv_layers 1 vs 4 (delta = 3 x per-layer fwd+R+S + node matmuls),
+fused vs composed — the robust way to attribute the 174 ms dense step
+(standalone kernel timing on this tunneled runtime is distorted by
+per-dispatch constant re-materialization; see profile_scf_passes.py)."""
+import os
+import sys
+import dataclasses
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
+
+import jax
+import numpy as np
+
+import bench
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+from hydragnn_tpu.models.create import create_model
+
+
+def measure(layers, scf, hidden=1024, batch_size=2048):
+    os.environ["HYDRAGNN_SCF_FUSED"] = scf
+    state, batch, step, cfg, _s, _h = bench._build(
+        hidden=hidden, dtype="bfloat16", batch_size=batch_size)
+    if layers != cfg.num_conv_layers:
+        cfg = dataclasses.replace(cfg, num_conv_layers=layers)
+        model = create_model(cfg)
+        opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+        state = create_train_state(model, batch, opt_spec)
+        step = make_train_step(model, cfg, opt_spec)
+    s, _ = bench._chip_loop(state, batch, step, 10, 2)
+    bench._release_device()
+    return s * 1e3
+
+
+def main():
+    for scf in ("1", "0"):
+        t1 = measure(1, scf)
+        t4 = measure(4, scf)
+        per = (t4 - t1) / 3
+        print(f"scf_fused={scf}: layers1 {t1:.1f} ms, layers4 {t4:.1f} ms "
+              f"-> per-layer {per:.1f} ms, non-conv base "
+              f"{t1 - per:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

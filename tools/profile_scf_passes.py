@@ -1,0 +1,112 @@
+"""Pass-level timing of the fused CFConv edge pipeline at the dense
+flagship shape (h1024 b2048 bf16): forward kernel alone vs full vjp
+(fwd + pass R + pass S), against the whole train step — locates where
+the 174 ms goes before touching the kernel (round-4 VERDICT item 2).
+
+Measurement trap (cost the first attempt 50x): arrays CLOSED OVER by a
+jitted function become program constants, and on this tunneled axon
+runtime constants are re-materialized per dispatch (~1.4 s/call for the
+178 MB packed-edge constants).  EVERY input must be an explicit jit
+argument."""
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("HYDRAGNN_AGGR_BACKEND", "fused")
+os.environ["HYDRAGNN_SCF_FUSED"] = "1"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+
+
+def timeit(fn, args, iters=20, repeats=3):
+    """K calls per dispatch inside a fori_loop; all inputs are loop carry
+    so nothing becomes a program constant."""
+    from jax import lax
+
+    @jax.jit
+    def run_k(a):
+        def body(_, a):
+            outs = fn(*a)
+            lead = jax.tree_util.tree_leaves(outs)[0]
+            bump = (jnp.sum(lead) * 1e-30)
+            return tuple(
+                (x + bump.astype(x.dtype))
+                if x.dtype in (jnp.float32, jnp.bfloat16) and x.ndim > 0
+                else x
+                for x in a)
+        return lax.fori_loop(0, iters, body, a)
+
+    out = run_k(args)
+    bench._sync(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = run_k(args)
+        bench._sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
+
+
+def main():
+    hidden, batch_size = 1024, 2048
+    state, batch, step, cfg, _s, _h = bench._build(
+        hidden=hidden, dtype="bfloat16", batch_size=batch_size)
+
+    step_s, state = bench._chip_loop(state, batch, step, 10, 2)
+    print(f"full train step: {step_s*1e3:.1f} ms", flush=True)
+
+    n = batch.x.shape[0]
+    e = batch.senders.shape[0]
+    print(f"N={n} E={e} F={hidden} "
+          f"(real E={int(np.asarray(batch.edge_mask).sum())})")
+
+    from hydragnn_tpu.ops.scf_mp import scf_edge_pipeline
+
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(n, hidden), jnp.bfloat16)
+    rbf = jnp.asarray(rng.rand(e, 50), jnp.float32)
+    cm = jnp.asarray(np.asarray(batch.edge_mask), jnp.float32)
+    w0 = jnp.asarray(rng.randn(50, hidden) * 0.1, jnp.float32)
+    b0 = jnp.zeros((hidden,), jnp.float32)
+    w1 = jnp.asarray(rng.randn(hidden, hidden) * 0.03, jnp.float32)
+    b1 = jnp.zeros((hidden,), jnp.float32)
+    se = jnp.asarray(batch.senders)
+    re = jnp.asarray(batch.receivers)
+    pm = jnp.asarray(batch.extras["edge_perm_sender"])
+    em = jnp.asarray(batch.edge_mask).astype(jnp.int32)
+
+    t_fwd = timeit(
+        lambda h_, rbf_, cm_, em_, w0_, b0_, w1_, b1_, se_, re_, pm_:
+            scf_edge_pipeline(h_, rbf_, cm_, em_, w0_, b0_, w1_, b1_,
+                              se_, re_, pm_),
+        (h, rbf, cm, em, w0, b0, w1, b1, se, re, pm))
+    print(f"scf fwd alone:  {t_fwd*1e3:.2f} ms/call", flush=True)
+
+    def loss(h_, rbf_, cm_, em_, w0_, b0_, w1_, b1_, se_, re_, pm_):
+        out = scf_edge_pipeline(h_, rbf_, cm_, em_, w0_, b0_, w1_, b1_,
+                                se_, re_, pm_)
+        return jnp.sum(out.astype(jnp.float32))
+
+    g = jax.grad(loss, argnums=(0, 1, 4, 6))
+
+    def gfn(h_, rbf_, cm_, em_, w0_, b0_, w1_, b1_, se_, re_, pm_):
+        return g(h_, rbf_, cm_, em_, w0_, b0_, w1_, b1_, se_, re_, pm_)
+
+    t_full = timeit(gfn, (h, rbf, cm, em, w0, b0, w1, b1, se, re, pm))
+    print(f"scf fwd+R+S:    {t_full*1e3:.2f} ms/call "
+          f"(bwd R+S = {1e3*(t_full - t_fwd):.2f})", flush=True)
+
+    layers = cfg.num_conv_layers
+    print(f"x{layers} layers: pipeline total {t_full*layers*1e3:.1f} ms "
+          f"of {step_s*1e3:.1f} ms step "
+          f"({t_full*layers/step_s*100:.0f}%)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
