@@ -123,9 +123,16 @@ def _sync(tree):
 
 
 def _build(model_type="SchNet", hidden=64, dtype="float32", batch_size=512,
-           nodes_per_graph=20):
+           nodes_per_graph=20, tight_edges=False):
     """Flagship-shaped synthetic setup for one arch: QM9-scale graphs
-    (~20 atoms), radius graph, single graph head."""
+    (~20 atoms), radius graph, single graph head.
+
+    ``tight_edges`` pads the edge array to the batch's REAL edge total
+    (rounded up) instead of batch * per-graph-max — the layout a bucketed
+    loader achieves (~1.05x real vs ~2x).  Used by the dense phase both
+    to measure the deployment-realistic rung and to compute the
+    honest useful-flops basis (a composed twin at loose padding spends
+    flops on padding edges that no ideal implementation needs)."""
     import jax
     import numpy as np
 
@@ -153,6 +160,12 @@ def _build(model_type="SchNet", hidden=64, dtype="float32", batch_size=512,
     heads = [HeadSpec("energy", "graph", 1)]
     pad = PadSpec.for_batch(batch_size, nodes_per_graph,
                             max(s.num_edges for s in samples))
+    if tight_edges:
+        import dataclasses
+
+        tot = sum(s.num_edges for s in samples)
+        pad = dataclasses.replace(
+            pad, num_edges=-(-(tot + 1) // 256) * 256)
     batch = collate(samples, pad, heads)
     if model_type == "DimeNet":
         from hydragnn_tpu.models.dimenet import (
@@ -615,25 +628,34 @@ def _child(platform: str) -> None:
     _release_device()
 
     if "dense" in phases:
-        # compute-dense flagship ladder: MFU scales with width (with the
-        # fused CFConv edge pipeline active the measured ladder is
-        # 8.3% -> 18.5% -> 29.7% at h256/h512/h1024-b2048 bf16; the
-        # composed path's was 6.4/14.0/24.2 — full history in
-        # docs/PERF.md) — the bench records the realistic points plus the
-        # best-MFU corner, the doc records the full ladder
+        # compute-dense flagship ladder: MFU scales with width.  Rungs:
+        # three loose-padding points (round-over-round comparable with the
+        # r03/r04 ladder) plus a TIGHT-padding h1024 rung — the edge array
+        # padded to the real edge total, i.e. what a bucketed loader ships
+        # (graph/batch.py pads to batch x per-graph-max = ~2x real edges
+        # at QM9 shapes; the fused kernels schedule-skip the padding but
+        # the composed ops and HBM streams outside the kernels cannot).
+        # MFU accounting: the useful-flops basis is ALWAYS the composed
+        # twin at TIGHT padding — padding-edge flops are not useful work,
+        # so a loose twin would inflate the fused rungs' MFU now that the
+        # kernels skip that work.  The loose-twin figure is kept as
+        # mfu_pct_loose_twin for r04 comparability.
         dense = {}
         dense_c = {}
-        for hidden, dense_batch in ((256, 512), (512, 512), (1024, 2048)):
+        for hidden, dense_batch, tight in (
+                (256, 512, False), (512, 512, False),
+                (1024, 2048, False), (1024, 2048, True)):
             est = _EST[f"dense_{hidden}"]
             if _deadline_remaining() < est:
-                skipped.append(f"dense_{hidden}")
+                skipped.append(f"dense_{hidden}{'t' if tight else ''}")
                 print(f"bench: skipping dense h{hidden} (needs ~{est}s, "
                       f"{_deadline_remaining():.0f}s left)", file=sys.stderr)
                 continue
             try:
                 t0 = time.perf_counter()
                 dstate, dbatch, dstep, dcfg, _s, _h = _build(
-                    hidden=hidden, dtype="bfloat16", batch_size=dense_batch)
+                    hidden=hidden, dtype="bfloat16", batch_size=dense_batch,
+                    tight_edges=tight)
                 dstep_s, dstate = _chip_loop(
                     dstate, dbatch, dstep,
                     max(n_iters // (8 if hidden < 1024 else 40), 2),
@@ -645,10 +667,11 @@ def _child(platform: str) -> None:
                 # models/schnet.py) hides the filter MLP's E*F^2 flops
                 # inside a Pallas call that XLA's cost model cannot see —
                 # take the useful-flops basis from the composed-twin
-                # program (identical math/params) so MFU stays comparable.
-                # Own try: a transient twin-compile failure must not throw
-                # away the rung's already-measured numbers (the fused-
-                # program flops simply remain the — undercounting — basis).
+                # program (identical math/params) at TIGHT edge padding
+                # (real-edge work only).  Own try: a transient twin-compile
+                # failure must not throw away the rung's already-measured
+                # numbers (the fused-program flops simply remain the —
+                # undercounting — basis).
                 from hydragnn_tpu.models.schnet import _scf_pipeline_enabled
 
                 dres["flops_method"] = "XLA cost model of the timed program"
@@ -658,7 +681,7 @@ def _child(platform: str) -> None:
                     try:
                         cstate, cbatch, cstep, _c, _s2, _h2 = _build(
                             hidden=hidden, dtype="bfloat16",
-                            batch_size=dense_batch)
+                            batch_size=dense_batch, tight_edges=True)
                         fl = _cost_flops(cstep, cstate, cbatch)
                         dres["flops_per_step"] = round(fl)
                         dres["achieved_tflops"] = round(
@@ -667,8 +690,19 @@ def _child(platform: str) -> None:
                             fl / dstep_s / MXU_PEAK * 100, 2)
                         dres["flops_method"] = (
                             "useful-flops basis from the composed-twin "
-                            "program (the fused CFConv pipeline's Pallas "
-                            "call is opaque to the XLA cost model)")
+                            "program at TIGHT edge padding (real-edge "
+                            "work only; the fused CFConv pipeline's "
+                            "Pallas call is opaque to the XLA cost "
+                            "model, and padding-edge flops are not "
+                            "useful work)")
+                        if not tight:
+                            # r03/r04-comparable basis: loose twin
+                            cstate2, cbatch2, cstep2, _c2, _s3, _h3 = \
+                                _build(hidden=hidden, dtype="bfloat16",
+                                       batch_size=dense_batch)
+                            fl2 = _cost_flops(cstep2, cstate2, cbatch2)
+                            dres["mfu_pct_loose_twin"] = round(
+                                fl2 / dstep_s / MXU_PEAK * 100, 2)
                     except Exception as fe:  # noqa: BLE001
                         dres["flops_method"] = (
                             "fused-program cost model (twin compile "
@@ -681,12 +715,14 @@ def _child(platform: str) -> None:
                             os.environ.pop("HYDRAGNN_SCF_FUSED", None)
                         else:
                             os.environ["HYDRAGNN_SCF_FUSED"] = prior
-                name = f"SchNet-h{hidden}-bf16-b{dense_batch}"
+                name = (f"SchNet-h{hidden}-bf16-b{dense_batch}"
+                        + ("-tight" if tight else ""))
                 dense[name] = dres
-                dense_c[f"h{hidden}"] = {
+                dense_c[f"h{hidden}" + ("t" if tight else "")] = {
                     "gps": round(dres["graphs_per_sec"]),
                     "mfu": dres["mfu_pct"]}
-                print(f"bench: dense h{hidden} b{dense_batch} "
+                print(f"bench: dense h{hidden} b{dense_batch}"
+                      f"{' tight' if tight else ''} "
                       f"{dres['achieved_tflops']} TF ({dres['mfu_pct']}% "
                       f"MFU) {time.perf_counter() - t0:.1f}s",
                       file=sys.stderr)

@@ -166,7 +166,10 @@ def sorted_segment_sum(data, segment_ids, num_segments, mask=None,
 
         if mask is not None:
             data = data * _bcast(mask, data)
-        return segment_sum_dense(data, segment_ids, num_segments)
+        # masked rows park out of range -> their blocks are schedule-
+        # skipped (collate/add_dimenet_extras keep padding tail-sorted)
+        return segment_sum_dense(data, segment_ids, num_segments,
+                                 valid=mask)
     return segment_sum(data, segment_ids, num_segments, mask)
 
 

@@ -396,9 +396,11 @@ class InteractionPPBlock(nn.Module):
             msg = x_kj[idx_kj] * sbf_emb * triplet_mask[:, None]
             # build_triplets emits idx_ji in nondecreasing order (outer
             # loop over edge ids) — the dense-schedule sorted scatter
-            # applies
+            # applies; passing the mask also schedule-skips padded-triplet
+            # blocks (add_dimenet_extras parks them zero-valued at the
+            # tail)
             x_kj = segment.sorted_segment_sum(
-                msg, idx_ji, e, sorted_hint=self.sorted_hint)
+                msg, idx_ji, e, triplet_mask, sorted_hint=self.sorted_hint)
         x_kj = _silu(nn.Dense(self.hidden, use_bias=False, name="lin_up")(x_kj))
 
         h = x_ji + x_kj

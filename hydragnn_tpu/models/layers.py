@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Sequence
 
+import os
+
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
@@ -147,6 +149,12 @@ class MaskedBatchNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (self.features,))
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
 
+        # experimental recipe knob (wide-GAT eval-divergence studies,
+        # docs/PERF.md): override the running-stats momentum without
+        # touching the checkpointed module tree
+        momentum = float(
+            os.environ.get("HYDRAGNN_BN_MOMENTUM") or self.momentum)
+
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
@@ -158,11 +166,11 @@ class MaskedBatchNorm(nn.Module):
                 # torch tracks the *unbiased* variance in running stats
                 unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
                 ra_mean.value = (
-                    1.0 - self.momentum
-                ) * ra_mean.value + self.momentum * mean
+                    1.0 - momentum
+                ) * ra_mean.value + momentum * mean
                 ra_var.value = (
-                    1.0 - self.momentum
-                ) * ra_var.value + self.momentum * unbiased
+                    1.0 - momentum
+                ) * ra_var.value + momentum * unbiased
         return scale * (x - mean) * jax.lax.rsqrt(var + self.eps) + bias
 
 

@@ -58,7 +58,17 @@ def _scf_pipeline_enabled(num_filters: int, num_gaussians: int) -> bool:
     (measured BOTH sides of the crossover on the v5e: h64 f32 7.62 ->
     8.19 ms = pipeline loses; h512/h1024 bf16 +27% = pipeline wins —
     docs/PERF.md round 4).  Env override HYDRAGNN_SCF_FUSED=1/0 forces
-    it either way."""
+    it either way.
+
+    Numerics note (bf16 models): the pipeline evaluates the filter MLP
+    and its backward matmuls — including the dW0/dW1 weight grads and
+    drbf, which feed distance/position grads — with bf16 operands (f32
+    accumulation), whereas the composed path's filter chain runs in f32
+    (f32 params x f32 rbf).  Crossing the F >= 256 default therefore
+    changes filter numerics beyond the stream dtype; drift is pinned to
+    <4% of grad scale by tests/test_scf_fused.py::
+    test_bf16_gradients_within_tolerance.  A/B against the composed path
+    with HYDRAGNN_SCF_FUSED=0 if exact f32 filters are needed."""
     from hydragnn_tpu.ops.scf_mp import SCF_F_LIMIT
 
     if num_gaussians > 127 or num_filters > SCF_F_LIMIT:

@@ -39,6 +39,7 @@ f32 blocks).  Callers gate on both and fall back to the composed path.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,18 @@ def _edge_block_fwd(f_pad: int, bf16: bool) -> int:
     temporaries).  Pass R keeps 128 — its dW1 accumulator block doubles
     the resident [F, F] footprint."""
     return 256 if (f_pad <= 512 or bf16) else _EDGE_BLOCK
+
+
+def _edge_block_r(f_pad: int, bf16: bool) -> int:
+    """Pass R edge block.  192 measured best at wide-F bf16 on the v5e
+    (sweep via HYDRAGNN_SCF_BE_R: 128 -> default; 192/256 trade per-step
+    overhead against the resident dW1 [F, F] f32 accumulator + ~8 [BE, F]
+    f32 temporaries, which exceed scoped VMEM at 256 wide-F).  Env
+    override HYDRAGNN_SCF_BE_R for experiments."""
+    v = os.environ.get("HYDRAGNN_SCF_BE_R")
+    if v:
+        return int(v)
+    return _EDGE_BLOCK
 
 
 def _ssp(x):
@@ -411,9 +424,9 @@ def _scf_vjp_bwd(res, ga):
     e, g = rbf.shape
     bf16 = h.dtype == jnp.bfloat16
     f_pad = _round_up(max(f, 1), 128)
-    # pass R keeps the narrow edge block (its dW1 accumulator doubles the
+    # pass R keeps a narrow edge block (its dW1 accumulator doubles the
     # resident [F, F] VMEM footprint); pass S uses the forward's
-    bn, be = _NODE_BLOCK, _EDGE_BLOCK
+    bn, be = _NODE_BLOCK, _edge_block_r(f_pad, bf16)
     be_s = _edge_block_fwd(f_pad, bf16)
     n_pad = _round_up(n, bn)
     e_pad = _round_up(max(e, 1), be)

@@ -149,18 +149,61 @@ def test_model_level_fused_equals_composed(monkeypatch):
             err_msg=str(path))
 
 
-def test_pipeline_gate_defaults():
+def test_pipeline_gate_defaults(monkeypatch):
     from hydragnn_tpu.models.schnet import _scf_pipeline_enabled
 
+    # the defaults must be judged with the env override ABSENT — a
+    # developer's ambient HYDRAGNN_SCF_FUSED=1 would flip the first assert
+    monkeypatch.delenv("HYDRAGNN_SCF_FUSED", raising=False)
     assert not _scf_pipeline_enabled(64, 50)       # narrow: composed wins
     assert _scf_pipeline_enabled(256, 50)          # wide: pipeline on
     assert not _scf_pipeline_enabled(2048, 50)     # beyond VMEM limit
     assert not _scf_pipeline_enabled(512, 200)     # basis exceeds lanes
-    os.environ["HYDRAGNN_SCF_FUSED"] = "1"
-    try:
-        assert _scf_pipeline_enabled(64, 50)       # forced on
-    finally:
-        del os.environ["HYDRAGNN_SCF_FUSED"]
+    monkeypatch.setenv("HYDRAGNN_SCF_FUSED", "1")
+    assert _scf_pipeline_enabled(64, 50)           # forced on
+    monkeypatch.setenv("HYDRAGNN_SCF_FUSED", "0")
+    assert not _scf_pipeline_enabled(1024, 50)     # forced off
+
+
+def test_bf16_gradients_within_tolerance():
+    """bf16 models run the fused filter MLP and ALL backward matmuls
+    (incl. dW0/dW1 weight grads and drbf) with bf16 operands, while the
+    composed path they replace evaluates the filter chain in f32 — the
+    pipeline is default-on at num_filters >= 256, so switching widths
+    silently changes filter numerics.  This pins the bf16 gradient drift
+    against the f32 composed reference (round-4 advisor finding 1)."""
+    g = _batch(seed=9)
+    h, rbf, cm, w0, b0, w1, b1 = _inputs(g, seed=10)
+    perm = jnp.asarray(g.extras["edge_perm_sender"])
+    em = jnp.asarray(g.edge_mask).astype(jnp.int32)
+    n = h.shape[0]
+    rng = np.random.RandomState(11)
+    wmat = jnp.asarray(rng.randn(n, F), jnp.float32)
+
+    def loss_fused(args):
+        h_, rbf_, cm_ = args[:3]
+        out = scf_edge_pipeline(h_.astype(jnp.bfloat16), rbf_, cm_, em,
+                                *args[3:], g.senders, g.receivers, perm)
+        return jnp.sum(out.astype(jnp.float32) * wmat)
+
+    def loss_ref(args):
+        out = _composed(*args, g.senders, g.receivers, n)
+        return jnp.sum(out * wmat)
+
+    inputs = (h, rbf, cm, w0, b0, w1, b1)
+    gf = jax.grad(loss_fused)(inputs)
+    gr = jax.grad(loss_ref)(inputs)
+    emask = np.asarray(g.edge_mask).astype(bool)
+    for name, a, b in zip(("h", "rbf", "cm", "w0", "b0", "w1", "b1"),
+                          gf, gr):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        if name in ("rbf", "cm"):
+            a, b = a[emask], b[emask]
+        # bf16 operands: ~8 mantissa bits through two matmul layers
+        scale = np.abs(b).max() + 1e-6
+        err = np.abs(a - b).max() / scale
+        assert err < 0.04, (name, err)
 
 
 def test_bf16_forward_within_tolerance():

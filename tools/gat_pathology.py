@@ -1,0 +1,155 @@
+"""Wide-GAT train/eval divergence study (round-4 VERDICT item 4).
+
+ACCURACY_r04 established that GATv2 h64 x 6 heads + BN + attention-dropout
+0.25 (the reference's default GAT protocol, create.py:148-150) diverges in
+EVAL mode in BOTH frameworks on the Morse-QM9 corpus, with the flax side
+worse at lr 1e-3 (test energy MAE 3.08 vs the torch twin's 2.21).  This
+tool trains the flagship protocol once per RECIPE variant and reports the
+test MAE, plus a diagnostic that re-evaluates the SAME trained state with
+batch statistics instead of running statistics (dropout off) — separating
+"the running stats are stale/mismatched" from "the function itself is bad".
+
+Variants:
+  base           as shipped (reproduces the ACCURACY_r04 flax row)
+  mom03          HYDRAGNN_BN_MOMENTUM=0.3 (faster stats adaptation)
+  nodrop         attention dropout 0 (isolates the dropout interaction)
+  drop_nodenom   (diagnostic via nodrop+base comparison)
+
+Usage: python tools/gat_pathology.py [--mols 8000] [--epochs 40]
+       [--variants base,mom03,nodrop] [--out FILE]
+"""
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "examples/qm9")
+
+import numpy as np
+
+
+def run_variant(name, mols, epochs, lr):
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.config.config import (
+        DatasetStats, finalize, head_specs_from_config,
+        label_slices_from_config)
+    from hydragnn_tpu.data.dataloader import create_dataloaders
+    from hydragnn_tpu.data.splitting import split_dataset
+    from hydragnn_tpu.models.base import ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import (
+        create_train_state, make_eval_step, test, train_validate_test)
+    from train import synthesize_molecules  # examples/qm9
+
+    if name == "mom03":
+        os.environ["HYDRAGNN_BN_MOMENTUM"] = "0.3"
+    else:
+        os.environ.pop("HYDRAGNN_BN_MOMENTUM", None)
+
+    with open("examples/qm9/qm9.json") as f:
+        config = json.load(f)
+    training = config["NeuralNetwork"]["Training"]
+    training["num_epoch"] = epochs
+    training["Optimizer"]["learning_rate"] = lr
+    arch = config["NeuralNetwork"]["Architecture"]
+    arch["model_type"] = "GAT"
+    radius = float(arch.get("radius", 2.0))
+
+    samples = synthesize_molecules(mols, radius=radius)
+    trainset, valset, testset = split_dataset(
+        samples, training["perc_train"])
+    config = finalize(config, DatasetStats.from_samples(samples))
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    if name == "nodrop":
+        cfg = dataclasses.replace(cfg, dropout=0.0)
+    model = create_model(cfg)
+
+    head_specs = head_specs_from_config(config)
+    gslices, nslices = label_slices_from_config(config)
+    bs = int(training["batch_size"])
+    train_l, val_l, test_l = create_dataloaders(
+        trainset, valset, testset, bs, head_specs,
+        graph_feature_slices=gslices, node_feature_slices=nslices)
+
+    opt_spec = select_optimizer(training["Optimizer"])
+    state = create_train_state(model, next(iter(train_l)), opt_spec)
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, train_l, val_l, test_l,
+        config["NeuralNetwork"], f"gat_pathology_{name}", verbosity=0)
+
+    def mae_with(model_eval):
+        eval_step = jax.jit(make_eval_step(model_eval, cfg))
+        err, _tasks, tv, pv = test(
+            eval_step, state, test_l, cfg.num_heads,
+            output_types=cfg.output_type)
+        mae = float(np.abs(np.asarray(tv[0]) - np.asarray(pv[0])).mean())
+        return float(err), mae
+
+    res = {"variant": name, "epochs": epochs, "lr": lr,
+           "train_loss_final": float(history["train"][-1])
+           if history.get("train") else None}
+    res["test_mse"], res["test_energy_mae"] = mae_with(model)
+
+    # diagnostic: same trained params, BN batch statistics (train-mode BN,
+    # dropout structurally off) — if this recovers the train-loss quality,
+    # the pathology is running-stats staleness, not the learned function
+    from hydragnn_tpu.train.trainer import _loss_and_metrics
+
+    model_diag = create_model(dataclasses.replace(cfg, dropout=0.0))
+
+    def diag_eval_step(state, g):
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        out, _ = model_diag.apply(
+            variables, g, train=True, mutable=["batch_stats"],
+            rngs={"dropout": jax.random.PRNGKey(0)})
+        return out
+
+    # run the plain test loop manually with batch-stats forward
+    import hydragnn_tpu.graph.batch as gb  # noqa: F401
+    tv, pv = [], []
+    mse_sum = cnt = 0.0
+    jstep = jax.jit(diag_eval_step)
+    for batch in test_l:
+        outs = jstep(state, batch)
+        pred = np.asarray(outs[0]).reshape(-1)
+        true = np.asarray(batch.labels[0]).reshape(-1)
+        gm = np.asarray(batch.graph_mask) > 0
+        tv.append(true[gm]); pv.append(pred[gm])
+        mse_sum += float(((pred[gm] - true[gm]) ** 2).sum())
+        cnt += float(gm.sum())
+    tvc, pvc = np.concatenate(tv), np.concatenate(pv)
+    res["diag_batchstats_mse"] = mse_sum / max(cnt, 1)
+    res["diag_batchstats_mae"] = float(np.abs(tvc - pvc).mean())
+    os.environ.pop("HYDRAGNN_BN_MOMENTUM", None)
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mols", type=int, default=8000)
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--variants", default="base,mom03,nodrop")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    results = []
+    for v in args.variants.split(","):
+        v = v.strip()
+        if not v:
+            continue
+        r = run_variant(v, args.mols, args.epochs, args.lr)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,5 @@
-"""Multi-host dispatch-overhead characterization (round-4 VERDICT item 8).
+"""Multi-host dispatch-overhead characterization (round-4 VERDICT item 8;
+fabric/host separation round-5 VERDICT item 6).
 
 Launches N jax.distributed CPU processes (1/2/4/8), each holding one
 virtual device of a global DP mesh, and times the scan-chunked global-mesh
@@ -7,6 +8,21 @@ per-STEP wall cost as a function of process count and K — the number that
 predicts whether the single-chip sustained throughput survives a real pod
 (every per-dispatch host cost is paid once per K steps; cross-host psum
 happens every step inside the scan).
+
+Round-5 addition — the r04 matrix at world >= 2 was dominated by the gloo
+CPU allreduce inside every step, so the HOST-side per-dispatch component
+(the number a pod prediction needs: on TPU the psum rides ICI at
+hardware speed, not gloo) was never isolated.  Two separations:
+
+  --mode local   same N processes, same jax.distributed coordination
+                 plane, but each process runs an INDEPENDENT local-mesh
+                 step (zero cross-host collectives) — isolates host-side
+                 dispatch cost at world > 1 from the fabric.
+  --sweep-bytes  at world 4, K 8: sweep hidden 32/128/512 (psum bytes
+                 ~x1/x16/x256) and fit per-step cost = a + b * bytes —
+                 `a` is the fixed fabric+host latency, `b` the gloo
+                 bandwidth term; on a TPU pod only `a`'s host share
+                 survives (ICI replaces gloo for `b`).
 
 Writes docs-ready JSON to stdout; drive with:
     python tools/measure_dispatch_overhead.py [--out file.json]
@@ -27,6 +43,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = r'''
 import json, os, sys, time
 rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
+hidden = int(sys.argv[5]) if len(sys.argv) > 5 else 32
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -58,13 +76,21 @@ pad = PadSpec.for_batch(32, 12, max(s.num_edges for s in samples))
 batch = collate(samples, pad, [HeadSpec("e", "graph", 1)])
 
 cfg = ModelConfig(
-    model_type="SAGE", input_dim=1, hidden_dim=32, output_dim=(1,),
-    output_type=("graph",), graph_head=GraphHeadCfg(1, 32, 1, (32,)),
+    model_type="SAGE", input_dim=1, hidden_dim=hidden, output_dim=(1,),
+    output_type=("graph",),
+    graph_head=GraphHeadCfg(1, hidden, 1, (hidden,)),
     node_head=None, task_weights=(1.0,), num_conv_layers=2)
 model = create_model(cfg)
 opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
 
-mesh = make_mesh()
+if mode == "local":
+    # fabric-free control: same process count, same coordination plane,
+    # ZERO cross-host collectives — a single-device local mesh per
+    # process.  The measured cost is the host-side dispatch component.
+    from jax.sharding import Mesh
+    mesh = Mesh([jax.local_devices()[0]], ("dp",))
+else:
+    mesh = make_mesh()
 axes = mesh_dp_axes(mesh)
 
 results = {}
@@ -93,7 +119,8 @@ for K in (1, 8, 32):
     np.asarray(jax.device_get(m["loss"]))
     # cross-host CPU psum makes big-K dispatches seconds long on the gloo
     # fabric; fewer repeats keep the matrix tractable at larger worlds
-    n_disp = 30 if K == 1 else (10 if world <= 2 else 4)
+    n_disp = 30 if K == 1 else (10 if (world <= 2 or mode == "local")
+                                else 4)
     t0 = time.perf_counter()
     for _ in range(n_disp):
         st, m = step(st, gbatch)
@@ -105,6 +132,10 @@ for K in (1, 8, 32):
     }
 
 if rank == 0:
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(st.params))
+    results["grad_bytes"] = 4 * n_params
     print("RESULT " + json.dumps(results), flush=True)
 '''
 
@@ -117,7 +148,7 @@ def _free_port():
     return p
 
 
-def run_world(world: int):
+def run_world(world: int, mode: str = "dp", hidden: int = 32):
     port = _free_port()
     with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
         f.write(_WORKER % {"repo": _REPO})
@@ -127,7 +158,8 @@ def run_world(world: int):
     env["PALLAS_AXON_POOL_IPS"] = ""
     procs = [
         subprocess.Popen(
-            [sys.executable, path, str(r), str(world), str(port)],
+            [sys.executable, path, str(r), str(world), str(port),
+             mode, str(hidden)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for r in range(world)
@@ -145,18 +177,39 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="")
     ap.add_argument("--worlds", default="1,2,4,8")
+    ap.add_argument("--mode", default="dp", choices=["dp", "local"])
+    ap.add_argument("--sweep-bytes", action="store_true",
+                    help="world-4 K-8 psum-bytes sweep (hidden 32/128/512)")
     args = ap.parse_args()
     res = {}
-    for w in [int(v) for v in args.worlds.split(",")]:
-        res[str(w)] = run_world(w)
-        print(f"world {w}: {res[str(w)]}", flush=True)
-    doc = {
-        "method": "N jax.distributed CPU processes, one virtual device "
-                  "each, global DP mesh; shard_map train step (SAGE h32, "
-                  "32-graph local batch) timed over 30 dispatches after "
-                  "compile; per_step_ms = dispatch cost / K",
-        "results": res,
-    }
+    if args.sweep_bytes:
+        for hidden in (32, 128, 512):
+            r = run_world(4, "dp", hidden)
+            res[f"h{hidden}"] = r
+            print(f"h{hidden}: {r}", flush=True)
+        doc = {
+            "method": "world 4, DP mesh, K in (1,8,32); hidden swept to "
+                      "scale psum bytes; fit per-step = a + b*grad_bytes "
+                      "to split fixed (host+fabric latency) from gloo "
+                      "bandwidth",
+            "results": res,
+        }
+    else:
+        for w in [int(v) for v in args.worlds.split(",")]:
+            res[str(w)] = run_world(w, args.mode)
+            print(f"world {w}: {res[str(w)]}", flush=True)
+        doc = {
+            "method": "N jax.distributed CPU processes, one virtual device "
+                      "each; mode=dp: global DP mesh shard_map step (SAGE "
+                      "h32, 32-graph local batch); mode=local: identical "
+                      "processes/coordination but an independent LOCAL "
+                      "mesh step per process — zero cross-host "
+                      "collectives, isolating host-side dispatch cost; "
+                      "timed over 30 dispatches after compile; "
+                      "per_step_ms = dispatch cost / K",
+            "mode": args.mode,
+            "results": res,
+        }
     print(json.dumps(doc))
     if args.out:
         with open(args.out, "w") as f:
