@@ -642,6 +642,9 @@ def _child(platform: str) -> None:
         # mfu_pct_loose_twin for r04 comparability.
         dense = {}
         dense_c = {}
+        # tight-twin flops cache: the loose and tight rungs at the same
+        # (hidden, batch) share one twin program — one compile, not two
+        twin_flops = {}
         for hidden, dense_batch, tight in (
                 (256, 512, False), (512, 512, False),
                 (1024, 2048, False), (1024, 2048, True)):
@@ -679,10 +682,14 @@ def _child(platform: str) -> None:
                     prior = os.environ.get("HYDRAGNN_SCF_FUSED")
                     os.environ["HYDRAGNN_SCF_FUSED"] = "0"
                     try:
-                        cstate, cbatch, cstep, _c, _s2, _h2 = _build(
-                            hidden=hidden, dtype="bfloat16",
-                            batch_size=dense_batch, tight_edges=True)
-                        fl = _cost_flops(cstep, cstate, cbatch)
+                        key = (hidden, dense_batch)
+                        if key not in twin_flops:
+                            cstate, cbatch, cstep, _c, _s2, _h2 = _build(
+                                hidden=hidden, dtype="bfloat16",
+                                batch_size=dense_batch, tight_edges=True)
+                            twin_flops[key] = _cost_flops(
+                                cstep, cstate, cbatch)
+                        fl = twin_flops[key]
                         dres["flops_per_step"] = round(fl)
                         dres["achieved_tflops"] = round(
                             fl / dstep_s / 1e12, 3)

@@ -62,14 +62,15 @@ def _edge_block_fwd(f_pad: int, bf16: bool) -> int:
 
 
 def _edge_block_r(f_pad: int, bf16: bool) -> int:
-    """Pass R edge block.  192 measured best at wide-F bf16 on the v5e
-    (sweep via HYDRAGNN_SCF_BE_R: 128 -> default; 192/256 trade per-step
-    overhead against the resident dW1 [F, F] f32 accumulator + ~8 [BE, F]
-    f32 temporaries, which exceed scoped VMEM at 256 wide-F).  Env
-    override HYDRAGNN_SCF_BE_R for experiments."""
+    """Pass R edge block: 128 everywhere (the resident dW1 [F, F] f32
+    accumulator plus ~8 [BE, F] f32 temporaries cap the block well below
+    fwd/pass-S's).  HYDRAGNN_SCF_BE_R overrides for sweeps; the sweep
+    result (if a larger block wins at some width) gets baked here with
+    the measurement.  f_pad/bf16 are the future conditioning inputs."""
     v = os.environ.get("HYDRAGNN_SCF_BE_R")
     if v:
         return int(v)
+    del f_pad, bf16
     return _EDGE_BLOCK
 
 

@@ -13,7 +13,6 @@ Variants:
   base           as shipped (reproduces the ACCURACY_r04 flax row)
   mom03          HYDRAGNN_BN_MOMENTUM=0.3 (faster stats adaptation)
   nodrop         attention dropout 0 (isolates the dropout interaction)
-  drop_nodenom   (diagnostic via nodrop+base comparison)
 
 Usage: python tools/gat_pathology.py [--mols 8000] [--epochs 40]
        [--variants base,mom03,nodrop] [--out FILE]
@@ -30,7 +29,12 @@ sys.path.insert(0, "examples/qm9")
 import numpy as np
 
 
+VARIANTS = ("base", "mom03", "nodrop")
+
+
 def run_variant(name, mols, epochs, lr):
+    if name not in VARIANTS:
+        raise ValueError(f"unknown variant {name!r}; pick from {VARIANTS}")
     import jax
     import jax.numpy as jnp
 
@@ -98,8 +102,6 @@ def run_variant(name, mols, epochs, lr):
     # diagnostic: same trained params, BN batch statistics (train-mode BN,
     # dropout structurally off) — if this recovers the train-loss quality,
     # the pathology is running-stats staleness, not the learned function
-    from hydragnn_tpu.train.trainer import _loss_and_metrics
-
     model_diag = create_model(dataclasses.replace(cfg, dropout=0.0))
 
     def diag_eval_step(state, g):
@@ -111,7 +113,6 @@ def run_variant(name, mols, epochs, lr):
         return out
 
     # run the plain test loop manually with batch-stats forward
-    import hydragnn_tpu.graph.batch as gb  # noqa: F401
     tv, pv = [], []
     mse_sum = cnt = 0.0
     jstep = jax.jit(diag_eval_step)

@@ -1,0 +1,107 @@
+"""Convergence A/B for frozen-composition resident replay (round-4
+VERDICT item 5).
+
+The auto fast pipeline stages the epoch-0 batches on device and replays
+them every epoch with reshuffled batch ORDER but frozen batch
+COMPOSITION (data/prefetch.py ResidentDeviceLoader) — a real
+training-semantics change vs the reference's per-epoch reshuffled
+DistributedSampler (load_data.py:237-245).  This runs the flagship
+Morse-QM9 SchNet protocol twice with identical seeds — resident replay
+forced ON vs forced OFF (full per-epoch recomposition through the
+shuffling loader) — and records the val/test gap.
+
+Usage: python tools/resident_ab.py [--mols 8000] [--epochs 40] [--out F]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "examples/qm9")
+
+import numpy as np
+
+
+def run(resident, mols, epochs):
+    import jax
+
+    from hydragnn_tpu.config.config import (
+        DatasetStats, finalize, head_specs_from_config,
+        label_slices_from_config)
+    from hydragnn_tpu.data.dataloader import create_dataloaders
+    from hydragnn_tpu.data.splitting import split_dataset
+    from hydragnn_tpu.models.base import ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import (
+        create_train_state, make_eval_step, test, train_validate_test)
+    from train import synthesize_molecules  # examples/qm9
+
+    os.environ["HYDRAGNN_RESIDENT_DATASET"] = "1" if resident else "0"
+
+    with open("examples/qm9/qm9.json") as f:
+        config = json.load(f)
+    training = config["NeuralNetwork"]["Training"]
+    training["num_epoch"] = epochs
+
+    samples = synthesize_molecules(mols, radius=float(
+        config["NeuralNetwork"]["Architecture"].get("radius", 2.0)))
+    trainset, valset, testset = split_dataset(
+        samples, training["perc_train"])
+    config = finalize(config, DatasetStats.from_samples(samples))
+    cfg = ModelConfig.from_config(config["NeuralNetwork"])
+    model = create_model(cfg)
+
+    head_specs = head_specs_from_config(config)
+    gslices, nslices = label_slices_from_config(config)
+    train_l, val_l, test_l = create_dataloaders(
+        trainset, valset, testset, int(training["batch_size"]), head_specs,
+        graph_feature_slices=gslices, node_feature_slices=nslices)
+
+    opt_spec = select_optimizer(training["Optimizer"])
+    state = create_train_state(model, next(iter(train_l)), opt_spec)
+    state, history = train_validate_test(
+        model, cfg, state, opt_spec, train_l, val_l, test_l,
+        config["NeuralNetwork"], f"resident_ab_{int(resident)}",
+        verbosity=0)
+
+    eval_step = jax.jit(make_eval_step(model, cfg))
+    err, _tasks, tv, pv = test(eval_step, state, test_l, cfg.num_heads,
+                               output_types=cfg.output_type)
+    mae = float(np.abs(np.asarray(tv[0]) - np.asarray(pv[0])).mean())
+    out = {
+        "resident": bool(resident),
+        "pipeline": history.get("pipeline", {}),
+        "val_mse_final": float(history["val"][-1]),
+        "val_mse_best": float(min(history["val"])),
+        "test_mse": float(err),
+        "test_energy_mae": mae,
+    }
+    os.environ.pop("HYDRAGNN_RESIDENT_DATASET", None)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mols", type=int, default=8000)
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    res = {}
+    for resident in (True, False):
+        key = "resident" if resident else "recomposed"
+        res[key] = run(resident, args.mols, args.epochs)
+        print(json.dumps({key: res[key]}), flush=True)
+    a, b = res["resident"], res["recomposed"]
+    res["val_mae_rel_delta_pct"] = round(
+        100.0 * (a["val_mse_best"] - b["val_mse_best"])
+        / max(b["val_mse_best"], 1e-12), 2)
+    print(json.dumps(res, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
