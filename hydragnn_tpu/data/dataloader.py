@@ -278,7 +278,16 @@ def create_dataloaders(
         if n_buckets < 1:
             from hydragnn_tpu.utils.env import env_flag
 
-            n_buckets = 4 if env_flag("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE") else 1
+            # DEFAULT-ON bucketing (round 5): the worst-case single spec
+            # pads the edge array to batch x per-graph-max ~ 2x the real
+            # edge count at molecular shapes, and HALF of every edge-space
+            # stream/kernel is padding work — measured 59.6 -> 32.2 ms on
+            # the DimeNet sweep config just from tight padding.  Batch-sum
+            # quantile buckets (bucket_pad_specs) recover it for 2-3
+            # compiles; tiny datasets (<= batch_size) keep one spec.
+            # HYDRAGNN_NUM_BUCKETS=1 restores the old behavior.
+            n_buckets = 4 if env_flag("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE") \
+                else 3
     if world_size > 1:
         # multi-process: every rank must assemble the same global array
         # shape each step, but bucket choice depends on rank-local samples —

@@ -45,6 +45,24 @@ def create_model_config(config: Dict[str, Any]) -> Base:
 def create_model(cfg: ModelConfig) -> Base:
     if cfg.model_type not in _STACKS:
         raise ValueError(f"Unknown model_type: {cfg.model_type}")
+    if (cfg.model_type == "GAT" and cfg.dropout > 0
+            and cfg.hidden_dim * cfg.gat_heads >= 256):
+        import warnings
+
+        # measured pathology (tools/gat_pathology.py, docs/PERF.md round
+        # 5): at this width, attention dropout makes the BN running
+        # statistics track a train-time distribution that mismatches
+        # eval mode — train loss converges while EVAL error grows past
+        # predict-the-mean, in BOTH this framework and the torch
+        # reference (ACCURACY_r04/r05).  Dropout 0 measured test MAE
+        # 0.40 vs 2.46 (flagship Morse-QM9 protocol, lr 1e-3).
+        warnings.warn(
+            f"GAT with attention dropout {cfg.dropout} at width "
+            f"{cfg.hidden_dim}x{cfg.gat_heads} heads diverges in eval "
+            "mode (BN running-stats mismatch; see docs/PERF.md round 5)."
+            ' Set "Architecture": {"dropout": 0.0} — measured test MAE '
+            "0.40 vs 2.46 on the flagship protocol.",
+            stacklevel=2)
     if cfg.model_type == "PNA":
         assert cfg.pna_avg_deg_log is not None, "PNA requires degree input."
     if cfg.model_type == "MFC":

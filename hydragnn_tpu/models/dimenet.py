@@ -134,30 +134,42 @@ def add_dimenet_extras(batch, max_triplets: int):
     # capability for shapes with denser triplet fan-in.
     from hydragnn_tpu.utils.env import env_flag
 
-    if (aggr_backend() == "fused" and t
-            and env_flag("HYDRAGNN_DIMENET_FUSED_TRI")):
+    if aggr_backend() == "fused":
         from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK
 
-        gid_of_edge = np.asarray(batch.node_gid)[
-            np.asarray(batch.receivers)[real]].astype(np.int64)
-        blocks = (real_ids // _NODE_BLOCK).astype(np.int64)
-        ng = int(gid_of_edge.max()) + 1
-        lo = np.full(ng, np.iinfo(np.int64).max)
-        hi = np.full(ng, -1)
-        np.minimum.at(lo, gid_of_edge, blocks)
-        np.maximum.at(hi, gid_of_edge, blocks)
-        occ = hi >= 0
-        span = int((hi[occ] - lo[occ]).max()) if occ.any() else 0
-        # FIXED (5,) marker shape: per-batch-varying extras shapes (or
-        # presence) would break DeviceStackLoader's tree-map np.stack and
-        # force a retrace per distinct window — the user opted in, so a
-        # batch whose graphs exceed the window is an error, not a fallback
-        if span > 2:
-            raise ValueError(
-                f"HYDRAGNN_DIMENET_FUSED_TRI: a graph spans {span} edge "
-                f"blocks (> 2); the 5-block window cannot cover it — "
-                f"unset the knob for this dataset")
-        extras["dn_tri_window"] = np.zeros((5,), np.float32)
+        span = 0  # a triplet-free batch trivially fits any window; the
+        # marker must still be attached so every batch of a dataset
+        # carries the same extras tree (DeviceStackLoader np.stack)
+        if t:
+            gid_of_edge = np.asarray(batch.node_gid)[
+                np.asarray(batch.receivers)[real]].astype(np.int64)
+            blocks = (real_ids // _NODE_BLOCK).astype(np.int64)
+            ng = int(gid_of_edge.max()) + 1
+            lo = np.full(ng, np.iinfo(np.int64).max)
+            hi = np.full(ng, -1)
+            np.minimum.at(lo, gid_of_edge, blocks)
+            np.maximum.at(hi, gid_of_edge, blocks)
+            occ = hi >= 0
+            span = int((hi[occ] - lo[occ]).max()) if occ.any() else 0
+        # factored-basis triplet kernel marker (ops/dn_tri.py, default-on
+        # when applicable): every graph's edge-id span fits the 5-block
+        # window.  Marker PRESENCE is the static gate — datasets whose
+        # batches straddle the span threshold would produce inconsistent
+        # extras trees (DeviceStackLoader np.stack), but a span this
+        # close to the window limit means the kernel is inapplicable
+        # anyway; molecular batches sit far below it.
+        if span <= 2 and not env_flag("HYDRAGNN_DN_TRI_OFF"):
+            extras["dn_tri_ok"] = np.zeros((1,), np.float32)
+        if env_flag("HYDRAGNN_DIMENET_FUSED_TRI"):
+            # legacy opt-in T->E fused path (measured slower; kept as a
+            # tested capability) — the user opted in, so a batch whose
+            # graphs exceed the window is an error, not a fallback
+            if span > 2:
+                raise ValueError(
+                    f"HYDRAGNN_DIMENET_FUSED_TRI: a graph spans {span} "
+                    f"edge blocks (> 2); the 5-block window cannot cover "
+                    f"it — unset the knob for this dataset")
+            extras["dn_tri_window"] = np.zeros((5,), np.float32)
     return batch.replace(extras=extras)
 
 
@@ -310,6 +322,21 @@ def angular_cbf(angle, num_spherical: int):
     )
 
 
+def spherical_basis_factors(dist_norm, angle, num_spherical: int,
+                            num_radial: int, envelope_exponent: int):
+    """The spherical basis FACTORED: sbf[t] = radial[idx_kj[t]] *
+    expand(cbf[t]) with radial EDGE-space [E, S*R] and cbf TRIPLET-space
+    [T, S] (the fused triplet kernel lane-expands the angular columns
+    over their radial slots in-VMEM — the [T, S*R] stream never
+    exists)."""
+    radial = radial_sbf(
+        dist_norm, num_spherical, num_radial, envelope_exponent)
+    radial2 = radial.reshape(dist_norm.shape[0],
+                             num_spherical * num_radial)
+    cbf = angular_cbf(angle, num_spherical)       # [T, S]
+    return radial2, cbf
+
+
 def spherical_basis(
     dist_norm, angle, idx_kj, num_spherical: int, num_radial: int,
     envelope_exponent: int, perm_kj=None
@@ -319,10 +346,8 @@ def spherical_basis(
     ``perm_kj`` (host-precomputed stable argsort of ``idx_kj``) routes the
     edge->triplet gather's backward through the dense sorted scatter.
     """
-    rbf = radial_sbf(dist_norm, num_spherical, num_radial, envelope_exponent)
-    cbf = angular_cbf(angle, num_spherical)
-    e = dist_norm.shape[0]
-    rbf2 = rbf.reshape(e, num_spherical * num_radial)
+    rbf2, cbf = spherical_basis_factors(
+        dist_norm, angle, num_spherical, num_radial, envelope_exponent)
     if perm_kj is not None:
         rbf_t = segment.gather_perm(rbf2, idx_kj, perm_kj)
     else:
@@ -356,10 +381,12 @@ class InteractionPPBlock(nn.Module):
     num_after_skip: int
     sorted_hint: bool = False  # idx_ji is nondecreasing (builder order)
     tri_window: int = 0  # >0: fused edge-space kernel window (collate-vouched)
+    tri_kernel: bool = False  # fused factored-basis kernel (ops/dn_tri.py)
+    num_radial: int = 6  # static R for the kernel's lane expansion
 
     @nn.compact
     def __call__(self, x_edge, rbf, sbf, idx_kj, idx_ji, triplet_mask,
-                 perm_kj=None):
+                 perm_kj=None, radial=None, cbf_exp=None):
         e = x_edge.shape[0]
         # 0/1 mask: exact in any dtype; keeps the [T, *] streams in the
         # compute dtype instead of promoting them back to f32
@@ -372,9 +399,29 @@ class InteractionPPBlock(nn.Module):
         x_kj = x_kj * rbf_emb
         x_kj = _silu(nn.Dense(self.int_emb_size, use_bias=False, name="lin_down")(x_kj))
 
-        sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
-        sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
-        if self.tri_window:
+        if self.tri_kernel:
+            # factored-basis fused pass (ops/dn_tri.py): the sbf-embedding
+            # MLP, the x_kj gather and the ji-scatter all run in VMEM —
+            # the only [T, *] HBM streams left are cbf_exp and the index
+            # tables.  Matmul-free param declarations keep the tree
+            # identical to the nn.Dense layers they replace (checkpoint
+            # path-independence, as in models/schnet._DenseParams).
+            from hydragnn_tpu.models.schnet import _DenseParams
+            from hydragnn_tpu.ops.dn_tri import dimenet_triplet_mp
+
+            sr = radial.shape[1]
+            k1, _ = _DenseParams(sr, self.basis_emb_size, use_bias=False,
+                                 name="lin_sbf1")()
+            k2, _ = _DenseParams(self.basis_emb_size, self.int_emb_size,
+                                 use_bias=False, name="lin_sbf2")()
+            x_kj = dimenet_triplet_mp(
+                radial.astype(x_edge.dtype), x_kj,
+                cbf_exp.astype(x_edge.dtype), k1, k2, idx_kj, idx_ji,
+                triplet_mask.astype(jnp.int32), perm_kj,
+                self.num_radial)
+        elif self.tri_window:
+            sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
+            sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
             # the triplet contraction IS message passing in EDGE space:
             # out[e'] = sum_{t: ji(t)=e'} x_kj[kj(t)] * sbf_emb[t] — one
             # fused W-window pass (fwd AND its dx backward via perm_kj)
@@ -385,6 +432,8 @@ class InteractionPPBlock(nn.Module):
                 x_kj, sbf_emb * triplet_mask[:, None], idx_kj, idx_ji,
                 perm_kj, self.tri_window)
         else:
+            sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
+            sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
             # NOTE: this gather deliberately does NOT use gather_perm — its
             # backward (scatter-add over idx_kj) fuses into the surrounding
             # elementwise cotangent under XLA, and routing it through the
@@ -475,15 +524,34 @@ class DimeNetConv(nn.Module):
         rbf = BesselBasis(
             self.num_radial, self.cutoff, self.envelope_exponent, name="rbf"
         )(dist)
-        sbf = spherical_basis(
-            dist / self.cutoff,
-            angle,
-            idx_kj,
-            self.num_spherical,
-            self.num_radial,
-            self.envelope_exponent,
-            perm_kj=perm_kj,
-        )
+        # factored-basis fused triplet kernel gate: collate vouches the
+        # window invariant ("dn_tri_ok"), the dims must fit the padded
+        # lanes, and the sort invariants must hold (sorted_hint/perm)
+        sr = self.num_spherical * self.num_radial
+        tri_w = ex.get("dn_tri_window")
+        tri_kernel = (
+            ex.get("dn_tri_ok") is not None and perm_kj is not None
+            and self.num_spherical <= 8 and sr <= 64
+            and self.int_emb_size <= 64 and self.basis_emb_size <= 64
+            # an explicit HYDRAGNN_DIMENET_FUSED_TRI opt-in wins: the
+            # legacy T->E path stays reachable (and testable)
+            and tri_w is None)
+        radial2 = cbf_exp = None
+        if tri_kernel:
+            radial2, cbf_exp = spherical_basis_factors(
+                dist / self.cutoff, angle, self.num_spherical,
+                self.num_radial, self.envelope_exponent)
+            sbf = None
+        else:
+            sbf = spherical_basis(
+                dist / self.cutoff,
+                angle,
+                idx_kj,
+                self.num_spherical,
+                self.num_radial,
+                self.envelope_exponent,
+                perm_kj=perm_kj,
+            )
         # Mixed precision: the Bessel/Legendre recurrences are evaluated in
         # f32 (pos/dist/angle stay f32 for force grads and recurrence
         # stability), but the [T, S*R] / [E, R] basis STREAMS are cast to
@@ -493,7 +561,8 @@ class DimeNetConv(nn.Module):
         # when the model does.  x carries the trainer's compute dtype;
         # under f32 training these casts are no-ops.
         rbf = rbf.astype(x.dtype)
-        sbf = sbf.astype(x.dtype)
+        if sbf is not None:
+            sbf = sbf.astype(x.dtype)
 
         h = nn.Dense(hidden, name="lin_in")(x)
         # embedding block (no atomic embedding; reference HydraEmbeddingBlock)
@@ -505,7 +574,6 @@ class DimeNetConv(nn.Module):
         )
         sorted_hint = bool(g.extras and "edge_perm_sender" in g.extras)
         # window encoded in the marker array's SHAPE (static under jit)
-        tri_w = ex.get("dn_tri_window")
         tri_window = int(tri_w.shape[0]) if tri_w is not None else 0
         x_edge = InteractionPPBlock(
             hidden,
@@ -515,8 +583,11 @@ class DimeNetConv(nn.Module):
             self.num_after_skip,
             sorted_hint=sorted_hint,
             tri_window=tri_window,
+            tri_kernel=tri_kernel,
+            num_radial=self.num_radial,
             name="interaction",
-        )(x_edge, rbf, sbf, idx_kj, idx_ji, tmask, perm_kj=perm_kj)
+        )(x_edge, rbf, sbf, idx_kj, idx_ji, tmask, perm_kj=perm_kj,
+          radial=radial2, cbf_exp=cbf_exp)
         out = OutputPPBlock(
             hidden, self.out_emb_size, self.out_dim, num_layers=1,
             sorted_hint=sorted_hint, name="output"

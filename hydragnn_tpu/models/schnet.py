@@ -36,15 +36,19 @@ def gaussian_smearing(dist, radius, num_gaussians):
 class _DenseParams(nn.Module):
     """Parameters of an ``nn.Dense`` WITHOUT its matmul: same names
     (kernel/bias), same default inits, same param tree — so the fused
-    edge-pipeline path below and the composed path share checkpoints."""
+    edge-pipeline path below (and DimeNet's fused triplet path) and the
+    composed paths share checkpoints."""
 
     in_dim: int
     features: int
+    use_bias: bool = True
 
     @nn.compact
     def __call__(self):
         k = self.param("kernel", nn.linear.default_kernel_init,
                        (self.in_dim, self.features))
+        if not self.use_bias:
+            return k, None
         b = self.param("bias", nn.initializers.zeros_init(),
                        (self.features,))
         return k, b
