@@ -69,7 +69,7 @@ Env knobs: HYDRAGNN_BENCH_PLATFORM=tpu|cpu|auto (default auto),
 HYDRAGNN_BENCH_TOTAL_BUDGET (parent wall-clock seconds, default 1500 —
 sized to sit under the driver's observed ~30 min kill with headroom),
 HYDRAGNN_BENCH_TIMEOUT (seconds for the first TPU attempt, default
-1260), HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,
+1380), HYDRAGNN_BENCH_PHASES (comma list of ceiling,roofline,
 sustained_default,sustained,dense,archs; default all-but-`sustained`
 on TPU — the knobbed sustained variant duplicates sustained_default's
 path and is opt-in — ceiling-only on CPU), HYDRAGNN_BENCH_DTYPE
@@ -491,10 +491,24 @@ _EVIDENCE_PATH = os.path.join(
 # costs are several times smaller, so the guard only bites when the cache
 # is cold AND the outer budget is tight, which is exactly when skipping
 # the tail phases is the right call.
+# measured costs (cold / warm-cache): the DimeNet programs' Pallas-heavy
+# modules are NOT covered by the persistent cache on this runtime
+# (~310 s every run) — their estimates stay at the cold figure
 _EST = {
     "roofline": 60, "dense_256": 100, "dense_512": 150, "dense_1024": 340,
-    "arch": 50, "arch_slow": 100, "sustained_default": 180, "sustained": 160,
+    "arch": 40, "arch_gat": 80, "arch_dimenet": 330, "arch_dimenet_bf16": 150,
+    "sustained_default": 180, "sustained": 160,
 }
+
+
+def _arch_est(arch: str) -> float:
+    if arch.startswith("DimeNet-bf16"):
+        return _EST["arch_dimenet_bf16"]
+    if arch.startswith("DimeNet"):
+        return _EST["arch_dimenet"]
+    if arch.startswith("GAT"):
+        return _EST["arch_gat"]
+    return _EST["arch"]
 
 
 def _deadline_remaining() -> float:
@@ -743,24 +757,42 @@ def _child(platform: str) -> None:
                       file=sys.stderr)
             _release_device()
 
+    if want("sustained_default", _EST["sustained_default"]):
+        # out-of-the-box run_training: NO env knobs; _auto_pipeline picks
+        # scan/residency, val/test epochs run (round-4 default-path number)
+        try:
+            t0 = time.perf_counter()
+            sd = _sustained(samples, heads, default_path=True)
+            evidence["sustained_default"] = sd
+            compact["sustained_gps"] = round(sd["graphs_per_sec"])
+            print(f"bench: sustained_default {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            emit()
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: sustained_default failed: {e!r}", file=sys.stderr)
+        _release_device()
+
     if "archs" in phases:
         sweep = {}
         sweep_c = {}
         # From round 5 the sweep runs at TIGHT edge padding — the layout
         # the (now default-on) bucketed loader ships; the old worst-case
-        # padding spent ~half of every edge-space stream on padding.
-        # Three `-loose` bridge rows (evidence only) anchor comparability
-        # with the r03/r04 sweeps.
-        # DimeNet-bf16: user-selectable mixed_precision run of the slow-tail
-        # arch — the basis-stream cast (models/dimenet.py) keeps the
-        # triplet chain in bf16.  GAT-h128: the at-width zoo row (round-4
+        # padding spent ~half of every edge-space stream on padding (the
+        # loose-vs-tight bridge table lives in docs/PERF.md round 5,
+        # measured from the full loose sweep of the same session).
+        # ORDER: expensive uncacheable-compile rows (DimeNet) and the
+        # VERDICT-gated rows come FIRST so a deadline squeeze skips the
+        # cheap cache-hit tail, not the adjudicated numbers.
+        # DimeNet-bf16: user-selectable mixed_precision run of the
+        # slow-tail arch.  GAT-h128: the at-width zoo row (round-4
         # VERDICT item 8) — the fused GATv2 kernel's width win.
-        extra = [] if dtype == "bfloat16" else ["DimeNet-bf16"]
-        extra.append("GAT-h128")
-        bridge = ["SAGE-loose", "SchNet-loose", "DimeNet-loose"]
-        for arch in ARCHS + extra + bridge:
-            est = (_EST["arch_slow"] if arch.startswith(("DimeNet", "GAT"))
-                   else _EST["arch"])
+        order = ["DimeNet"]
+        if dtype != "bfloat16":
+            order.append("DimeNet-bf16")
+        order += ["GAT", "GAT-h128"] + [
+            a for a in ARCHS if a not in ("DimeNet", "GAT")]
+        for arch in order:
+            est = _arch_est(arch)
             if _deadline_remaining() < est:
                 skipped.append(f"arch_{arch}")
                 continue
@@ -770,9 +802,7 @@ def _child(platform: str) -> None:
                 hidden = 64
                 tight = True
                 arch_model = arch
-                if arch.endswith("-loose"):
-                    arch_model, tight = arch[:-6], False
-                elif arch.endswith("-bf16"):
+                if arch.endswith("-bf16"):
                     arch_model, adtype = arch[:-5], "bfloat16"
                 elif arch.endswith("-h128"):
                     arch_model, hidden = arch[:-5], 128
@@ -799,21 +829,6 @@ def _child(platform: str) -> None:
             evidence["archs"] = dict(sweep)
             compact["archs"] = dict(sweep_c)
             emit()
-
-    if want("sustained_default", _EST["sustained_default"]):
-        # out-of-the-box run_training: NO env knobs; _auto_pipeline picks
-        # scan/residency, val/test epochs run (round-4 default-path number)
-        try:
-            t0 = time.perf_counter()
-            sd = _sustained(samples, heads, default_path=True)
-            evidence["sustained_default"] = sd
-            compact["sustained_gps"] = round(sd["graphs_per_sec"])
-            print(f"bench: sustained_default {time.perf_counter() - t0:.1f}s",
-                  file=sys.stderr)
-            emit()
-        except Exception as e:  # noqa: BLE001
-            print(f"bench: sustained_default failed: {e!r}", file=sys.stderr)
-        _release_device()
 
     if want("sustained", _EST["sustained"]):
         try:
@@ -896,7 +911,7 @@ def main() -> None:
     start = time.time()
     total = float(os.getenv("HYDRAGNN_BENCH_TOTAL_BUDGET", "1500"))
     deadline = start + total
-    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "1260"))
+    tpu_timeout = float(os.getenv("HYDRAGNN_BENCH_TIMEOUT", "1380"))
     result = None
     if want in ("auto", "tpu"):
         result = _try_child("tpu", min(tpu_timeout, deadline - time.time()))
