@@ -373,6 +373,22 @@ class ResidualLayer(nn.Module):
         return x + h
 
 
+class _ResidualParams(nn.Module):
+    """Parameters of a ResidualLayer WITHOUT its matmuls (same names
+    lin1/lin2 with kernel/bias, same inits) — the fused row-MLP tail
+    consumes them raw while checkpoints stay path-independent."""
+
+    dim: int
+
+    @nn.compact
+    def __call__(self):
+        from hydragnn_tpu.models.schnet import _DenseParams
+
+        k1, b1 = _DenseParams(self.dim, self.dim, name="lin1")()
+        k2, b2 = _DenseParams(self.dim, self.dim, name="lin2")()
+        return (k1, b1, k2, b2)
+
+
 class InteractionPPBlock(nn.Module):
     hidden: int
     int_emb_size: int
@@ -419,6 +435,31 @@ class InteractionPPBlock(nn.Module):
                 cbf_exp.astype(x_edge.dtype), k1, k2, idx_kj, idx_ji,
                 triplet_mask.astype(jnp.int32), perm_kj,
                 self.num_radial)
+
+            from hydragnn_tpu.utils.env import env_flag
+
+            if (not env_flag("HYDRAGNN_DN_ROW_MLP_OFF")
+                    and self.hidden <= 128 and self.int_emb_size <= 128):
+                # fused row-local tail (ops/row_mlp.py): lin_up + skip
+                # structure in one Pallas pass — the ~10 narrow [E, H]
+                # Dense boundary streams collapse to 3 inputs + 1 output.
+                # Matmul-free param declarations mirror the nn.Dense /
+                # ResidualLayer tree (checkpoint path-independence).
+                from hydragnn_tpu.ops.row_mlp import dimenet_post_mlp
+
+                wb = list(_DenseParams(self.int_emb_size, self.hidden,
+                                       use_bias=False, name="lin_up")())
+                for i in range(self.num_before_skip):
+                    wb += list(_ResidualParams(
+                        self.hidden, name=f"before_skip_{i}")())
+                wb += list(_DenseParams(self.hidden, self.hidden,
+                                        name="lin")())
+                for i in range(self.num_after_skip):
+                    wb += list(_ResidualParams(
+                        self.hidden, name=f"after_skip_{i}")())
+                return dimenet_post_mlp(
+                    x_kj, x_ji, x_edge, self.num_before_skip,
+                    self.num_after_skip, *wb)
         elif self.tri_window:
             sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
             sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
