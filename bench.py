@@ -87,8 +87,19 @@ import time
 
 METRIC = "qm9_schnet_train_throughput"
 UNIT = "graphs/sec/chip"
-MXU_PEAK = 197e12  # v5e bf16 systolic peak; see module docstring for why
-                   # this is also the right basis for default-precision f32
+
+
+def _mxu_peak() -> float:
+    """MFU peak basis: the v5e bf16 systolic peak (also the right basis for
+    default-precision f32 — see module docstring), or the operator's
+    HYDRAGNN_PEAK_FLOPS override.  ONE definition shared with the in-run
+    telemetry subsystem (hydragnn_tpu/telemetry/flops.py) — including the
+    override — so bench and telemetry MFU cannot drift; imported lazily
+    because the parent process must not import the package (it pulls jax)
+    before choosing a platform."""
+    from hydragnn_tpu.telemetry.flops import peak_flops
+
+    return peak_flops()
 
 ARCHS = ["SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN", "SchNet", "DimeNet",
          "EGNN"]
@@ -293,8 +304,8 @@ def _roofline(step, state, batch, step_s):
     out = {
         "flops_per_step": round(flops),
         "achieved_tflops": round(flops / step_s / 1e12, 3),
-        "mfu_pct": round(flops / step_s / MXU_PEAK * 100, 2),
-        "mfu_peak_basis_tflops": 197,
+        "mfu_pct": round(flops / step_s / _mxu_peak() * 100, 2),
+        "mfu_peak_basis_tflops": round(_mxu_peak() / 1e12),
         "hbm_bytes_per_step": int(ba_bytes),
         "hbm_gbps": round(ba_bytes / step_s / 1e9, 1),
         "bytes_method": "XLA buffer assignment: args + outputs + 2*temps "
@@ -308,13 +319,12 @@ def _roofline(step, state, batch, step_s):
 
 
 def _cost_flops(step, state, batch):
-    """XLA cost-model flops of one compiled step."""
-    import jax
+    """XLA cost-model flops of one compiled step — the SHARED flops-basis
+    helper (telemetry/flops.py), so the in-run telemetry MFU estimate and
+    this bench's figures can never drift apart."""
+    from hydragnn_tpu.telemetry.flops import step_cost_flops
 
-    compiled = jax.jit(step).lower(state, batch).compile()
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    return float(ca.get("flops", 0.0))
+    return step_cost_flops(step, state, batch)
 
 
 def _membw_probe():
@@ -708,7 +718,7 @@ def _child(platform: str) -> None:
                         dres["achieved_tflops"] = round(
                             fl / dstep_s / 1e12, 3)
                         dres["mfu_pct"] = round(
-                            fl / dstep_s / MXU_PEAK * 100, 2)
+                            fl / dstep_s / _mxu_peak() * 100, 2)
                         dres["flops_method"] = (
                             "useful-flops basis from the composed-twin "
                             "program at TIGHT edge padding (real-edge "
@@ -723,7 +733,7 @@ def _child(platform: str) -> None:
                                        batch_size=dense_batch)
                             fl2 = _cost_flops(cstep2, cstate2, cbatch2)
                             dres["mfu_pct_loose_twin"] = round(
-                                fl2 / dstep_s / MXU_PEAK * 100, 2)
+                                fl2 / dstep_s / _mxu_peak() * 100, 2)
                     except Exception as fe:  # noqa: BLE001
                         dres["flops_method"] = (
                             "fused-program cost model (twin compile "

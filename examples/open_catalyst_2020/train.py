@@ -191,7 +191,8 @@ def dimenet_post_collate(samples, batch_size, arch):
     hydragnn_tpu/data/load_data.py's DimeNet block)."""
     if arch["model_type"] != "DimeNet":
         return None
-    from hydragnn_tpu.models.dimenet import add_dimenet_extras, count_triplets
+    from hydragnn_tpu.models.dimenet import (
+        DnTriGate, add_dimenet_extras, count_triplets)
 
     max_per_sample = 1
     for s in samples:
@@ -199,7 +200,11 @@ def dimenet_post_collate(samples, batch_size, arch):
             max_per_sample = max(
                 max_per_sample, count_triplets(s.edge_index, s.num_nodes))
     max_triplets = -(-(batch_size * max_per_sample + 1) // 8) * 8
-    return lambda b: add_dimenet_extras(b, max_triplets)
+    # fused-triplet gate decided once from the corpus-wide bound so every
+    # batch carries the same extras tree (see load_data.py's DimeNet block)
+    tri_gate = DnTriGate(max_edges_per_graph=max(
+        (s.num_edges for s in samples), default=1))
+    return lambda b: add_dimenet_extras(b, max_triplets, tri_gate=tri_gate)
 
 
 def main(log_name: str = "open_catalyst_2020", default_gpack: str = "",

@@ -36,6 +36,25 @@ _OPTIONAL_ARCH_KEYS = [
     "num_spherical",
 ]
 
+def _telemetry_defaults() -> Dict[str, Any]:
+    """Telemetry section defaults (docs/TELEMETRY.md), derived from the ONE
+    source of truth — the TelemetryConfig dataclass — so the saved
+    config.json can never document settings the run doesn't use.  Per-step
+    structured metrics are opt-in (enable=0 keeps the hot path sync-free
+    and file-free); the TensorBoard epoch scalars are unconditional."""
+    from hydragnn_tpu.telemetry import TelemetryConfig
+
+    d = TelemetryConfig()
+    return {
+        "enable": int(d.enable),
+        "sinks": ",".join(d.sinks),
+        "heartbeat": d.heartbeat,
+        "ring": d.ring,
+        "sync_steps": int(d.sync_steps),
+        "mfu": int(d.mfu),
+    }
+
+
 EDGE_MODELS = ["PNA", "CGCNN", "SchNet", "EGNN"]
 EQUIVARIANT_MODELS = ["EGNN", "SchNet"]
 ALL_MODEL_TYPES = [
@@ -143,6 +162,13 @@ def finalize(
     arch.setdefault("SyncBatchNorm", False)
     arch.setdefault("task_weights", [1.0] * len(output_type))
     var.setdefault("denormalize_output", False)
+    # top-level Telemetry section (sibling of Profile): defaults written
+    # back so the saved config.json documents the run's observability
+    # settings; env knobs overlay at MetricsLogger construction
+    # (telemetry/logger.py:TelemetryConfig.from_section)
+    config.setdefault("Telemetry", {})
+    for k, v in _telemetry_defaults().items():
+        config["Telemetry"].setdefault(k, v)
     return config
 
 

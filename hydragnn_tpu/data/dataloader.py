@@ -22,6 +22,7 @@ from hydragnn_tpu.graph.batch import (
     PadSpec,
     collate,
 )
+from hydragnn_tpu.telemetry import pipeline as tele_pipe
 
 
 class GraphDataLoader:
@@ -169,6 +170,11 @@ class GraphDataLoader:
         )
         if self.post_collate is not None:
             out = self.post_collate(out)
+        if tele_pipe.enabled():
+            # collate volume: how many bytes/batches the host side produced
+            # (telemetry epoch records relate this to H2D transfer bytes)
+            tele_pipe.add("collate_bytes", tele_pipe.batch_nbytes(out))
+            tele_pipe.add("collate_batches", 1)
         return out
 
     def __iter__(self) -> Iterator[GraphBatch]:

@@ -119,7 +119,11 @@ def dataset_loading_and_splitting(
     # DIMEStack.py:158-182); size it from the worst-case sample.
     post_collate = None
     if config["NeuralNetwork"]["Architecture"]["model_type"] == "DimeNet":
-        from hydragnn_tpu.models.dimenet import add_dimenet_extras, count_triplets
+        from hydragnn_tpu.models.dimenet import (
+            DnTriGate,
+            add_dimenet_extras,
+            count_triplets,
+        )
 
         max_per_sample = 1
         for s in trainset + valset + testset:
@@ -127,7 +131,13 @@ def dataset_loading_and_splitting(
                 max_per_sample = max(
                     max_per_sample, count_triplets(s.edge_index, s.num_nodes))
         max_triplets = -(-(batch_size * max_per_sample + 1) // 8) * 8
-        post_collate = lambda b: add_dimenet_extras(b, max_triplets)
+        # fused-triplet gate decided ONCE from the dataset-wide
+        # max-edges-per-graph bound (cross-host reduced in stats), so every
+        # batch of the run carries the same extras tree — no per-batch span
+        # measurement (ADVICE: dn_tri_ok marker instability)
+        tri_gate = DnTriGate(max_edges_per_graph=stats.max_edges)
+        post_collate = lambda b: add_dimenet_extras(
+            b, max_triplets, tri_gate=tri_gate)
 
     train_l, val_l, test_l = create_dataloaders(
         trainset,

@@ -19,6 +19,10 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
+# input-pipeline telemetry counters (no-ops unless a MetricsLogger enabled
+# them — see hydragnn_tpu/telemetry/pipeline.py)
+from hydragnn_tpu.telemetry import pipeline as tele_pipe
+
 
 def _make_stage(sharding=None):
     """Device-staging function shared by DevicePrefetcher and
@@ -45,6 +49,12 @@ def _make_stage(sharding=None):
             if sharding is None or all(
                     l.sharding == sharding for l in leaves):
                 return batch
+        if tele_pipe.enabled():
+            # host->device transfer accounting: only batches that actually
+            # dispatch a transfer count (already-staged passthroughs above
+            # moved nothing)
+            tele_pipe.add("h2d_bytes", tele_pipe.batch_nbytes(batch))
+            tele_pipe.add("h2d_batches", 1)
         return ident(batch)
 
     return stage
@@ -124,6 +134,11 @@ class DevicePrefetcher:
         t.start()
         try:
             while True:
+                if tele_pipe.enabled():
+                    # queue depth AT CONSUME time: 0 means the step is
+                    # about to stall on the transfer pipeline
+                    tele_pipe.add("device_prefetch_qdepth_sum", q.qsize())
+                    tele_pipe.add("device_prefetch_qdepth_gets", 1)
                 item = q.get()
                 if item is done:
                     break
@@ -293,6 +308,10 @@ class PrefetchLoader:
         t.start()
         try:
             while True:
+                if tele_pipe.enabled():
+                    # depth 0 at consume time = the trainer outran collation
+                    tele_pipe.add("prefetch_qdepth_sum", q.qsize())
+                    tele_pipe.add("prefetch_qdepth_gets", 1)
                 item = q.get()
                 if item is done:
                     break
@@ -411,19 +430,57 @@ def _shm_import(desc):
 
     _tag, name, specs, treedef = desc
     shm = shared_memory.SharedMemory(name=name)
-    leaves = []
-    for sp in specs:
-        if sp[0] == "a":
-            _t, shape, dtype, off = sp
-            v = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf,
-                           offset=off)
-            leaves.append(np.array(v, copy=True))
-            del v
-        else:
-            leaves.append(sp[1])
-    batch = jax.tree_util.tree_unflatten(treedef, leaves)
+    try:
+        # try/finally: a failure mid-reconstruction (e.g. a corrupt spec or
+        # OOM on a leaf copy) must still unlink the segment, or every such
+        # batch leaks its full size in /dev/shm for the process lifetime
+        leaves = []
+        for sp in specs:
+            if sp[0] == "a":
+                _t, shape, dtype, off = sp
+                v = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf,
+                               offset=off)
+                leaves.append(np.array(v, copy=True))
+                del v
+            else:
+                leaves.append(sp[1])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    finally:
+        _shm_release(shm)
+
+
+def _shm_discard(result):
+    """Release the segment behind a worker's shm descriptor WITHOUT
+    rebuilding the batch (abandoned-epoch / close() drain path — copying
+    bytes nobody will consume is pure waste)."""
+    if not (isinstance(result, tuple) and len(result) == 4
+            and result[0] == "__shm__"):
+        return
+    from multiprocessing import shared_memory
+
+    try:
+        shm = shared_memory.SharedMemory(name=result[1])
+    except FileNotFoundError:  # already released
+        return
     _shm_release(shm)
-    return batch
+
+
+def _drain_inflight(futures, use_shm: bool) -> None:
+    """Settle every in-flight collate future: cancel what hasn't started;
+    BLOCK on the rest (cancel() returned False — already running or done:
+    its segment exists or is about to) and release their segments.  Without
+    the block, a worker finishing after shutdown strands its segment in
+    /dev/shm for the host's lifetime (the ADVICE shm-leak on abandoned
+    epochs)."""
+    for f in futures:
+        if f.cancel():
+            continue
+        try:
+            result = f.result()
+        except Exception:  # noqa: BLE001 — worker died; nothing to release
+            continue
+        if use_shm:
+            _shm_discard(result)
 
 
 def _shm_release(shm):
@@ -477,6 +534,8 @@ class ProcessPrefetchLoader:
             pin_affinity = bool(int(os.getenv("HYDRAGNN_AFFINITY", "0")))
         self.pin_affinity = pin_affinity
         self._pool = None
+        self._inflight = None
+        self._use_shm = True
 
     def set_epoch(self, epoch: int) -> None:
         if hasattr(self.loader, "set_epoch"):
@@ -513,8 +572,12 @@ class ProcessPrefetchLoader:
         # pickle/pipe transport.
         use_shm = os.getenv("HYDRAGNN_COLLATE_SHM", "1") not in (
             "0", "false", "False")
+        self._use_shm = use_shm
         fn = _proc_collate_shm if use_shm else _proc_collate
+        # exposed on self so close() can settle an abandoned epoch's
+        # still-running collations before pool shutdown
         futures: deque = deque()
+        self._inflight = futures
         idx = 0
         try:
             while idx < len(plan) or futures:
@@ -523,22 +586,38 @@ class ProcessPrefetchLoader:
                         fn, self._token, plan[idx]))
                     idx += 1
                 out = futures.popleft().result()
-                yield _shm_import(out) if use_shm else out
-        except GeneratorExit:
-            # abandoned mid-epoch: cancel what hasn't started; drain and
-            # unlink finished segments so /dev/shm does not leak
-            for f in futures:
-                f.cancel()
-            for f in futures:
-                if f.done() and not f.cancelled() and use_shm:
-                    try:
-                        _shm_import(f.result())
-                    except Exception:  # noqa: BLE001
-                        pass
-            raise
+                batch = _shm_import(out) if use_shm else out
+                if tele_pipe.enabled():
+                    # collate accounting must happen in the PARENT: the
+                    # workers' module-global counters live in forked
+                    # copies the epoch snapshot never sees
+                    tele_pipe.add(
+                        "collate_bytes", tele_pipe.batch_nbytes(batch))
+                    tele_pipe.add("collate_batches", 1)
+                yield batch
+        finally:
+            # ANY abnormal exit leaves futures in flight — an abandoned
+            # epoch (GeneratorExit) or a worker error re-raised by
+            # .result() above.  Settle every one: cancel the unstarted,
+            # block on the running/done (their segments are real) and
+            # unlink, so /dev/shm does not leak on either path.
+            if futures:
+                _drain_inflight(futures, use_shm)
+                futures.clear()
+            if self._inflight is futures:
+                self._inflight = None
 
     def close(self):
         if self._pool is not None:
+            # an abandoned epoch may still have collations in flight:
+            # settle them (blocking on the uncancellable ones) and release
+            # their segments BEFORE shutdown — shutdown alone neither waits
+            # nor unlinks
+            inflight = getattr(self, "_inflight", None)
+            if inflight:
+                _drain_inflight(list(inflight), getattr(
+                    self, "_use_shm", True))
+                self._inflight = None
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
             # drop the registry's strong reference so the dataset can be

@@ -73,10 +73,56 @@ def build_triplets(edge_index: np.ndarray, num_nodes: int):
     )
 
 
-def add_dimenet_extras(batch, max_triplets: int):
+class DnTriGate:
+    """Per-dataset/loader gate for the factored-basis fused-triplet path.
+
+    Marker PRESENCE ("dn_tri_ok") is the static gate the model reads, so it
+    must be CONSISTENT across every batch of a run: DeviceStackLoader
+    np.stacks consecutive batches' extras trees, and a per-batch decision
+    that flips mid-epoch produces mismatched trees (the ADVICE
+    marker-instability item).  Two modes:
+
+    - static (``max_edges_per_graph`` given): the decision is made ONCE from
+      the dataset-wide bound.  A graph's real edges are contiguous in
+      edge-id space (collate invariant), so a graph with at most L edges
+      spans at most ceil((L-1)/_NODE_BLOCK) edge blocks at worst alignment —
+      no per-batch measurement at all.
+    - sticky (no bound — one-shot callers): per-batch measurement, but the
+      first over-span batch disables the marker for the REST OF THE RUN
+      (clean whole-run fallback instead of a mid-run tree flip; batches
+      already emitted keep their marker, so prefer the static mode for any
+      multi-batch pipeline).
+    """
+
+    def __init__(self, max_edges_per_graph=None):
+        from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK
+
+        self.static = max_edges_per_graph is not None
+        if self.static:
+            L = max(int(max_edges_per_graph), 1)
+            self.span_bound = -(-(L - 1) // _NODE_BLOCK)
+            self.ok = self.span_bound <= 2
+        else:
+            self.span_bound = None
+            self.ok = True
+
+    def allow(self, measure_span) -> bool:
+        """``measure_span`` is a thunk (only called when a measurement is
+        actually needed — the static mode never pays it)."""
+        if self.static or not self.ok:
+            return self.ok
+        if measure_span() > 2:
+            self.ok = False  # sticky: whole-run fallback from here on
+        return self.ok
+
+
+def add_dimenet_extras(batch, max_triplets: int, tri_gate=None):
     """Post-collate hook: attach padded triplet arrays to a numpy GraphBatch.
 
     Padded triplets point at the trailing padded node/edge and carry mask 0.
+    ``tri_gate`` (a :class:`DnTriGate`) decides the fused-triplet marker
+    once per dataset/loader; omitted, a transient per-batch gate preserves
+    the one-shot-caller behavior.
     """
     n, e = batch.x.shape[0], batch.senders.shape[0]
     ei = np.stack([np.asarray(batch.senders), np.asarray(batch.receivers)])
@@ -137,10 +183,11 @@ def add_dimenet_extras(batch, max_triplets: int):
     if aggr_backend() == "fused":
         from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK
 
-        span = 0  # a triplet-free batch trivially fits any window; the
-        # marker must still be attached so every batch of a dataset
-        # carries the same extras tree (DeviceStackLoader np.stack)
-        if t:
+        def measure_span() -> int:
+            # max edge-block span of any graph in THIS batch (a triplet-free
+            # batch trivially fits any window)
+            if not t:
+                return 0
             gid_of_edge = np.asarray(batch.node_gid)[
                 np.asarray(batch.receivers)[real]].astype(np.int64)
             blocks = (real_ids // _NODE_BLOCK).astype(np.int64)
@@ -150,20 +197,25 @@ def add_dimenet_extras(batch, max_triplets: int):
             np.minimum.at(lo, gid_of_edge, blocks)
             np.maximum.at(hi, gid_of_edge, blocks)
             occ = hi >= 0
-            span = int((hi[occ] - lo[occ]).max()) if occ.any() else 0
+            return int((hi[occ] - lo[occ]).max()) if occ.any() else 0
+
         # factored-basis triplet kernel marker (ops/dn_tri.py, default-on
         # when applicable): every graph's edge-id span fits the 5-block
-        # window.  Marker PRESENCE is the static gate — datasets whose
-        # batches straddle the span threshold would produce inconsistent
-        # extras trees (DeviceStackLoader np.stack), but a span this
-        # close to the window limit means the kernel is inapplicable
-        # anyway; molecular batches sit far below it.
-        if span <= 2 and not env_flag("HYDRAGNN_DN_TRI_OFF"):
+        # window.  The decision comes from the DnTriGate — static per
+        # dataset when the caller provides the max-edges-per-graph bound
+        # (loaders do: load_data.py), so every batch of a run carries the
+        # same extras tree; a span this close to the window limit means the
+        # kernel is inapplicable anyway — molecular batches sit far below.
+        if tri_gate is None:
+            tri_gate = DnTriGate()  # transient: per-batch (one-shot callers)
+        if not env_flag("HYDRAGNN_DN_TRI_OFF") and tri_gate.allow(
+                measure_span):
             extras["dn_tri_ok"] = np.zeros((1,), np.float32)
         if env_flag("HYDRAGNN_DIMENET_FUSED_TRI"):
             # legacy opt-in T->E fused path (measured slower; kept as a
             # tested capability) — the user opted in, so a batch whose
             # graphs exceed the window is an error, not a fallback
+            span = measure_span()
             if span > 2:
                 raise ValueError(
                     f"HYDRAGNN_DIMENET_FUSED_TRI: a graph spans {span} "

@@ -236,8 +236,13 @@ def gather_mul_segment_sum(x, w, senders, receivers, sender_perm,
     masked-edge blocks outright (halves scheduled work at flagship
     padding ratios).  Contract: edge_valid == 0 edges carry zero ``w``
     rows and sort after all real edges in BOTH edge orderings (collate
-    guarantees this); their dw cotangent is computed densely and is
-    exact regardless.
+    guarantees this).  Their dw cotangent is computed densely and is
+    GARBAGE: a skipped edge contributes nothing forward, so its true
+    gradient is zero, but the dense ``x[send] * g[recv]`` formula reads
+    the padding node's rows instead — callers must not consume dw on
+    masked edges; the caller's w-premask multiply must kill it (same
+    contract as :func:`~hydragnn_tpu.ops.scf_mp.scf_edge_pipeline`'s
+    masked-edge grads).
     """
     interpret = jax.default_backend() != "tpu"
     return _fused_impl(x, w, senders, receivers, interpret, window=window,

@@ -33,6 +33,23 @@ from hydragnn_tpu.models.base import Base, ModelConfig
 from hydragnn_tpu.train.optimizer import OptimizerSpec
 from hydragnn_tpu.train.trainer import TrainState, _force_head_indices, _loss_and_metrics
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """Version-portable shard_map: newer jax exports it top-level with a
+    ``check_vma`` kwarg; 0.4.x has ``jax.experimental.shard_map`` with
+    ``check_rep``.  Replication checking stays off either way (the metric
+    dicts are replicated by construction via psum/pmean)."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # jax 0.4.x
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:  # pre-check_vma signature
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 DATA_AXIS = "data"
 # multi-slice pods: outer axis crosses slices over DCN, inner axis stays on
 # a slice's ICI.  DP spans both; ZeRO-1 shards along ICI only so its
@@ -228,6 +245,7 @@ def make_dp_train_step(
     zero_specs=None,
     zero_axis: Optional[str] = None,
     steps: int = 1,
+    telemetry_metrics: bool = False,
 ):
     """jit'd DP train step over stacked batches [D, ...].
 
@@ -249,7 +267,6 @@ def make_dp_train_step(
     reference optimizer.py:43-103).
     """
     import optax
-    from jax import shard_map
 
     energy_head, forces_head = _force_head_indices(output_names)
     axes = _dp_axes(axis)
@@ -335,17 +352,34 @@ def make_dp_train_step(
             "num_graphs": num_graphs,
             **{f"task_{i}": t for i, t in enumerate(per_head)},
         }
+        if telemetry_metrics:
+            from hydragnn_tpu.train.trainer import (
+                step_telemetry_metrics,
+                tree_l2_norm,
+            )
+
+            tele = step_telemetry_metrics(g, grads, new_params, updates)
+            # counts are per-shard — make them global like num_graphs
+            tele["nodes_real"] = jax.lax.psum(tele["nodes_real"], axes)
+            tele["edges_real"] = jax.lax.psum(tele["edges_real"], axes)
+            if zero_specs is not None:
+                # ZeRO: updates live sharded along zero_axis — psum the
+                # squared slice norms for the global update norm
+                # (grad/param norms are already replicated: pmean'd grads,
+                # all-gathered params)
+                tele["update_norm"] = jnp.sqrt(jax.lax.psum(
+                    jnp.square(tree_l2_norm(updates)), zero_axis))
+            metrics.update(tele)
         return new_state, metrics
 
     opt_spec_tree = P() if zero_specs is None else zero_specs
     state_specs = TrainState(
         step=P(), params=P(), batch_stats=P(), opt_state=opt_spec_tree)
-    sharded = shard_map(
+    sharded = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=(state_specs, P(axes)),
         out_specs=(state_specs, P()),
-        check_vma=False,
     )
     if steps > 1:
         from jax import lax
@@ -368,8 +402,6 @@ def make_dp_eval_step(
 ):
     """jit'd DP eval step over stacked batches [D, ...].  ``axis`` may be a
     tuple of mesh axes (multi-slice meshes)."""
-    from jax import shard_map
-
     axes = _dp_axes(axis)
 
     def per_device(state: TrainState, g: GraphBatch):
@@ -392,7 +424,7 @@ def make_dp_eval_step(
             "outputs": outputs,
         }
 
-    sharded = shard_map(
+    sharded = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(axes)),
@@ -402,7 +434,6 @@ def make_dp_eval_step(
             "per_head": P(),
             "outputs": P(axes),
         },
-        check_vma=False,
     )
     return jax.jit(sharded)
 

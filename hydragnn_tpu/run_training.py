@@ -97,6 +97,19 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
         except Exception:
             writer = None
 
+    # unified telemetry: config's Telemetry section (finalize() wrote the
+    # defaults) overlaid by env knobs (HYDRAGNN_TELEMETRY=1 enables the
+    # per-step JSONL event log; see docs/TELEMETRY.md)
+    from hydragnn_tpu.telemetry import MetricsLogger, TelemetryConfig
+
+    telemetry = MetricsLogger(
+        TelemetryConfig.from_section(config.get("Telemetry")),
+        run_name=log_name,
+        out_dir=os.path.join(logs_dir, log_name, "telemetry"),
+        rank=rank,
+        world_size=world_size,
+    )
+
     state, history = train_validate_test(
         model,
         cfg,
@@ -113,6 +126,7 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
         world_size=world_size,
         logs_dir=logs_dir,
         profile_config=config.get("Profile"),
+        telemetry=telemetry,
     )
 
     save_state(state, log_name, logs_dir, rank=rank)

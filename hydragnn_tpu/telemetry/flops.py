@@ -1,0 +1,67 @@
+"""Shared flops-basis helpers — ONE definition for bench.py and in-run telemetry.
+
+MFU is only comparable when every reporter divides by the same flops basis
+and the same peak.  bench.py's roofline and the telemetry subsystem's in-run
+MFU estimate both import from here, so the two cannot drift (round-5 VERDICT
+names honest-basis MFU as the top remaining gap — a gap we cannot close if
+the bench harness and the training run disagree about what "100%" means).
+"""
+
+from __future__ import annotations
+
+import os
+
+# v5e bf16 systolic peak.  Also the right basis for JAX default-precision
+# f32: the default matmul precision runs f32 dots through the MXU as bf16
+# (measured 56.7 TF/s on an 8192^3 f32 matmul on this chip, above the
+# 49 TF/s "f32 peak", so 49e12 would be the wrong denominator — see
+# bench.py's module docstring for the full rationale).
+MXU_PEAK_FLOPS = 197e12
+
+
+def peak_flops() -> float:
+    """Peak flops basis for MFU.  HYDRAGNN_PEAK_FLOPS overrides the built-in
+    v5e constant for other parts (e.g. a CPU smoke run where the MXU peak is
+    a nominal reference, or a v4/v5p deployment)."""
+    return float(os.environ.get("HYDRAGNN_PEAK_FLOPS", "") or MXU_PEAK_FLOPS)
+
+
+def step_cost_flops(step_fn, *args) -> float:
+    """XLA cost-model flops of one compiled call of ``step_fn(*args)``.
+
+    The cost model is fusion-invariant and reliable for flops (unlike its
+    bytes figure — see bench.py's ``_roofline``).  ``args`` may be concrete
+    arrays or ``jax.ShapeDtypeStruct`` pytrees: lowering only needs avals,
+    so telemetry can compute the basis for a step whose buffers were donated
+    away.  Caveat shared with bench.py: Pallas calls are opaque to the cost
+    model — when a fused kernel hides matmul work, the composed-twin program
+    is the honest basis (bench's dense phase builds that twin; in-run
+    telemetry reports the timed program's basis and names the method in the
+    manifest so the two are never silently conflated).
+    """
+    import jax
+
+    compiled = jax.jit(step_fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca.get("flops", 0.0))
+
+
+def mfu_pct(flops_per_step: float, step_s: float, peak: float = None) -> float:
+    """Model-flops-utilization percent for one step."""
+    if step_s <= 0.0 or flops_per_step <= 0.0:
+        return 0.0
+    return flops_per_step / step_s / (peak or peak_flops()) * 100.0
+
+
+def shape_struct_tree(tree):
+    """Pytree of ``jax.ShapeDtypeStruct`` mirroring ``tree``'s array leaves
+    (non-array leaves pass through) — avals survive buffer donation."""
+    import jax
+
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(one, tree)

@@ -1,0 +1,476 @@
+"""MetricsLogger: the host-side spine of the telemetry subsystem.
+
+Design constraints (why this is not a naive per-step print):
+
+- ZERO added device->host syncs on the hot path.  The trainer's epoch loop
+  dispatches steps back-to-back and fetches ONE accumulator per epoch (each
+  sync costs a ~100 ms round trip on tunneled PJRT runtimes — see
+  train/trainer.py).  ``on_step`` therefore only appends the step's DEVICE
+  scalars + a host timestamp to a pending list; ``flush_steps`` fetches them
+  all in one ``jax.device_get`` at epoch end and emits the JSONL records
+  then.  Consequence: per-step ``step_time_s`` is dispatch-to-dispatch host
+  wall time (under async dispatch that is queue-feed time, not device
+  execution time; the epoch record's ``epoch_time_s`` is the authoritative
+  wall clock).  ``sync_steps=1`` opts into a per-step block for true device
+  step times, at the known throughput cost.
+
+- Rank-0-gated sinks, all-rank collectives.  Every rank runs the logger
+  (cross-rank reductions via ``parallel/comm.py`` host collectives must be
+  entered by all processes or they deadlock); only rank 0 holds sinks.
+
+- Derived perf accounting is computed from STATIC batch metadata (leaf
+  shapes = the PadSpec bucket actually used) plus the in-jit real-count
+  metrics, so padding-waste % is exact and free.  The in-run MFU estimate
+  uses the SAME flops-basis helper as bench.py (telemetry/flops.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.telemetry import pipeline
+from hydragnn_tpu.telemetry.flops import (
+    mfu_pct,
+    peak_flops,
+    shape_struct_tree,
+    step_cost_flops,
+)
+from hydragnn_tpu.telemetry.sinks import Sink, TensorBoardSink, build_sinks
+from hydragnn_tpu.utils.env import env_flag, env_int, env_str
+
+
+@dataclasses.dataclass
+class TelemetryConfig:
+    """Parsed ``Telemetry`` config section + env knobs (env wins).
+
+    Knobs: HYDRAGNN_TELEMETRY (enable), HYDRAGNN_TELEMETRY_SINKS
+    (comma list: jsonl,csv,stdout), HYDRAGNN_TELEMETRY_DIR,
+    HYDRAGNN_TELEMETRY_HEARTBEAT (stdout cadence, steps),
+    HYDRAGNN_TELEMETRY_SYNC (block per step for true step times),
+    HYDRAGNN_PEAK_FLOPS (MFU peak basis override, see telemetry/flops.py).
+    """
+
+    enable: bool = False
+    sinks: Tuple[str, ...] = ("jsonl", "stdout")
+    dir: Optional[str] = None
+    heartbeat: int = 50
+    ring: int = 256
+    sync_steps: bool = False
+    mfu: bool = True
+
+    @staticmethod
+    def from_section(section: Optional[Dict[str, Any]]) -> "TelemetryConfig":
+        s = dict(section or {})
+        d = TelemetryConfig()  # the dataclass IS the single default source
+        sinks = s.get("sinks", ",".join(d.sinks))
+        if isinstance(sinks, str):
+            sinks = tuple(x.strip() for x in sinks.split(",") if x.strip())
+        cfg = TelemetryConfig(
+            enable=bool(int(s.get("enable", d.enable))),
+            sinks=tuple(sinks),
+            dir=s.get("dir"),
+            heartbeat=int(s.get("heartbeat", d.heartbeat)),
+            ring=int(s.get("ring", d.ring)),
+            sync_steps=bool(int(s.get("sync_steps", d.sync_steps))),
+            mfu=bool(int(s.get("mfu", d.mfu))),
+        )
+        # env overrides (the smoke-run contract: HYDRAGNN_TELEMETRY=1 turns
+        # the subsystem on with no config edit)
+        if "HYDRAGNN_TELEMETRY" in os.environ:
+            cfg.enable = env_flag("HYDRAGNN_TELEMETRY")
+        env_sinks = env_str("HYDRAGNN_TELEMETRY_SINKS", "")
+        if env_sinks:
+            cfg.sinks = tuple(
+                x.strip() for x in env_sinks.split(",") if x.strip())
+        cfg.dir = env_str("HYDRAGNN_TELEMETRY_DIR", cfg.dir or "") or cfg.dir
+        if "HYDRAGNN_TELEMETRY_HEARTBEAT" in os.environ:
+            cfg.heartbeat = env_int("HYDRAGNN_TELEMETRY_HEARTBEAT", 50)
+        if "HYDRAGNN_TELEMETRY_SYNC" in os.environ:
+            cfg.sync_steps = env_flag("HYDRAGNN_TELEMETRY_SYNC")
+        return cfg
+
+
+class RingBuffer:
+    """Fixed-capacity window of recent step records with min/max/avg/last
+    aggregation — the heartbeat's and manifest's rolling summary."""
+
+    def __init__(self, capacity: int = 256):
+        self._buf: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity)))
+
+    def push(self, record: Dict[str, Any]) -> None:
+        self._buf.append(record)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def aggregate(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        cols: Dict[str, List[float]] = {}
+        for rec in self._buf:
+            for k, v in rec.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    cols.setdefault(k, []).append(float(v))
+        for k, vals in cols.items():
+            out[k] = {
+                "min": min(vals),
+                "max": max(vals),
+                "avg": sum(vals) / len(vals),
+                "last": vals[-1],
+                "count": len(vals),
+            }
+        return out
+
+
+def batch_pad_meta(batch) -> Dict[str, int]:
+    """Padded slot counts of one dispatch unit, from STATIC leaf shapes.
+
+    Works for plain batches ([N]-space leaves), device-stacked ([D, N]) and
+    scan-chunked ([K, D, N]) superbatches: every leading axis multiplies the
+    slot count, matching the in-jit real-count metrics which sum (and psum)
+    over the same axes.
+    """
+    x = batch.x.shape            # (..., N, F)
+    e = batch.senders.shape      # (..., E)
+    g = batch.graph_mask.shape   # (..., G)
+    lead = int(np.prod(x[:-2], dtype=np.int64)) if len(x) > 2 else 1
+    return {
+        "padded_nodes": lead * int(x[-2]),
+        "padded_edges": int(np.prod(e, dtype=np.int64)),
+        "padded_graphs": int(np.prod(g, dtype=np.int64)),
+    }
+
+
+def waste_pct(real: float, padded: float) -> float:
+    """Fraction of padded slots that carried no real work, in percent."""
+    if padded <= 0:
+        return 0.0
+    return max(0.0, (1.0 - float(real) / float(padded))) * 100.0
+
+
+def _loader_padding_efficiency(loader) -> Optional[float]:
+    """Walk a loader wrapper chain for the innermost
+    ``padding_efficiency()`` (GraphDataLoader keeps real/padded node-slot
+    counters per epoch)."""
+    obj = loader
+    while obj is not None:
+        fn = getattr(obj, "padding_efficiency", None)
+        if callable(fn):
+            try:
+                return float(fn())
+            except Exception:  # noqa: BLE001
+                return None
+        obj = getattr(obj, "loader", None)
+    return None
+
+
+class MetricsLogger:
+    """Unified per-step/per-epoch telemetry with pluggable sinks."""
+
+    def __init__(self, cfg: Optional[TelemetryConfig] = None,
+                 run_name: str = "run", out_dir: Optional[str] = None,
+                 rank: int = 0, world_size: int = 1,
+                 cross_rank: Optional[bool] = None):
+        self.cfg = cfg or TelemetryConfig()
+        self.run_name = run_name
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        # cross-rank host collectives must be entered by EVERY process of
+        # the global runtime; an ensemble branch (explicit sub-mesh) must
+        # not attempt them — the other branch won't match the call.
+        self.cross_rank = (self.world_size > 1 if cross_rank is None
+                           else bool(cross_rank))
+        self.run_id = f"{run_name}-{uuid.uuid4().hex[:8]}"
+        # explicit config/env dir wins over the caller's default location
+        self.out_dir = self.cfg.dir or out_dir or os.path.join(
+            "./logs", run_name, "telemetry")
+        self.ring = RingBuffer(self.cfg.ring)
+        self.sinks: List[Sink] = []
+        self._pending: List[Tuple[Any, Dict[str, int], float, tuple]] = []
+        self._pending_avals: Dict[tuple, Any] = {}
+        self._epoch = 0
+        self._epoch_t0 = time.perf_counter()
+        self._global_step = 0
+        self._dispatch = 0
+        self._steps_per_item = 1
+        self._step_fn = None
+        self._state_avals = None
+        self._flops_cache: Dict[tuple, Optional[float]] = {}
+        self._mfu_broken = False
+        if self.enabled and self.rank == 0:
+            self.sinks = build_sinks(
+                self.cfg.sinks, self.out_dir, self.run_id,
+                heartbeat=self.cfg.heartbeat)
+        if self.enabled:
+            pipeline.set_enabled(True)
+            self._emit({
+                "event": "run_start",
+                "run_id": self.run_id,
+                "run_name": run_name,
+                "rank": self.rank,
+                "world_size": self.world_size,
+                "t": time.time(),
+                "peak_flops_basis": peak_flops(),
+                "sinks": list(self.cfg.sinks),
+                "sync_steps": self.cfg.sync_steps,
+            })
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def disabled(cls) -> "MetricsLogger":
+        return cls(TelemetryConfig(enable=False))
+
+    @classmethod
+    def from_env(cls, run_name: str = "run",
+                 out_dir: Optional[str] = None, rank: int = 0,
+                 world_size: int = 1,
+                 cross_rank: Optional[bool] = None) -> "MetricsLogger":
+        return cls(TelemetryConfig.from_section(None), run_name=run_name,
+                   out_dir=out_dir, rank=rank, world_size=world_size,
+                   cross_rank=cross_rank)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cfg.enable)
+
+    def attach_tensorboard(self, writer) -> None:
+        """Route epoch/step scalars to an existing SummaryWriter (the
+        trainer's pre-telemetry inline ``add_scalar`` calls, refactored into
+        a sink).  Works even when step telemetry is disabled — TensorBoard
+        epoch scalars are a base capability, not an opt-in."""
+        if writer is not None and self.rank == 0:
+            self.sinks.append(TensorBoardSink(writer))
+
+    def bind_step(self, step_fn, state, steps_per_item: int = 1) -> None:
+        """Remember the jitted step and the train state's avals (captured
+        BEFORE the first donated call, while buffers are alive) for the
+        in-run MFU flops basis."""
+        self._steps_per_item = max(1, int(steps_per_item))
+        # the flops basis costs a second XLA compile of the step (per
+        # PadSpec bucket) — only the rank that actually writes records
+        # (sinks exist) should pay it
+        if not (self.enabled and self.cfg.mfu and self.sinks):
+            return
+        self._step_fn = step_fn
+        try:
+            self._state_avals = shape_struct_tree(state)
+        except Exception:  # noqa: BLE001 — MFU is best-effort
+            self._state_avals = None
+            self._mfu_broken = True
+
+    # -- per-step path (zero-sync) -------------------------------------------
+
+    def begin_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+        self._epoch_t0 = time.perf_counter()
+
+    def on_step(self, metrics, batch) -> None:
+        """Record one dispatched train step: device metric scalars + host
+        timestamp + static batch metadata.  No device sync unless
+        ``sync_steps`` is set."""
+        if not self.enabled:
+            return
+        if self.cfg.sync_steps:
+            import jax
+
+            jax.block_until_ready(metrics["loss"])
+        sig = (tuple(batch.x.shape), tuple(batch.senders.shape),
+               tuple(batch.graph_mask.shape))
+        if (self._step_fn is not None and sig not in self._flops_cache
+                and not self._mfu_broken):
+            # first sighting of this PadSpec bucket: stash avals now (cheap)
+            # so flush can compile the cost analysis off the hot path
+            self._flops_cache[sig] = None
+            self._pending_avals[sig] = shape_struct_tree(batch)
+        self._pending.append(
+            (metrics, batch_pad_meta(batch), time.perf_counter(), sig))
+
+    def _flops_for(self, sig: tuple) -> Optional[float]:
+        if self._mfu_broken or self._step_fn is None:
+            return None
+        cached = self._flops_cache.get(sig)
+        if cached is not None:
+            return cached
+        avals = self._pending_avals.get(sig)
+        if avals is None or self._state_avals is None:
+            return None
+        try:
+            fl = step_cost_flops(self._step_fn, self._state_avals, avals)
+            self._flops_cache[sig] = fl
+            return fl
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            # (e.g. a backend without cost_analysis); disable for the run
+            self._mfu_broken = True
+            return None
+
+    def flush_steps(self) -> None:
+        """One ``device_get`` of every pending step's metric scalars, then
+        emit the step records.  Called at epoch end by the trainer, after
+        its own combined accumulator fetch."""
+        if not self.enabled or not self._pending:
+            self._pending = []
+            return
+        import jax
+
+        fetched = jax.device_get([m for m, _, _, _ in self._pending])
+        prev_t = self._epoch_t0
+        for (_, pad, t, sig), m in zip(self._pending, fetched):
+            dt = max(t - prev_t, 0.0)
+            prev_t = t
+            n_tasks = sum(1 for k in m if k.startswith("task_"))
+            ng = float(m.get("num_graphs", 0.0))
+            nodes_real = float(m.get("nodes_real", 0.0))
+            edges_real = float(m.get("edges_real", 0.0))
+            self._dispatch += 1
+            self._global_step += self._steps_per_item
+            rec: Dict[str, Any] = {
+                "event": "step",
+                "run_id": self.run_id,
+                "rank": self.rank,
+                "t": time.time(),
+                "epoch": self._epoch,
+                "step": self._global_step,
+                "dispatch": self._dispatch,
+                "steps_in_dispatch": self._steps_per_item,
+                "loss": float(m["loss"]),
+                "tasks": [float(m[f"task_{i}"]) for i in range(n_tasks)],
+                "num_graphs": ng,
+                "step_time_s": dt,
+            }
+            for k in ("grad_norm", "param_norm", "update_norm"):
+                if k in m:
+                    rec[k] = float(m[k])
+            if dt > 0:
+                rec["graphs_per_s"] = ng / dt
+                rec["nodes_per_s"] = nodes_real / dt
+                rec["edges_per_s"] = edges_real / dt
+            rec["padding"] = {
+                "nodes_real": nodes_real,
+                "edges_real": edges_real,
+                **pad,
+                "nodes_waste_pct": waste_pct(nodes_real, pad["padded_nodes"]),
+                "edges_waste_pct": waste_pct(edges_real, pad["padded_edges"]),
+                "graphs_waste_pct": waste_pct(ng, pad["padded_graphs"]),
+            }
+            fl = self._flops_for(sig)
+            if fl:
+                rec["flops_per_dispatch"] = fl
+                if dt > 0:
+                    rec["mfu_est_pct"] = mfu_pct(fl, dt)
+            self.ring.push({k: v for k, v in rec.items()
+                            if isinstance(v, (int, float))})
+            self._emit(rec)
+        self._pending = []
+
+    # -- per-epoch path ------------------------------------------------------
+
+    def log_epoch(self, epoch: int, scalars: Dict[str, Any],
+                  train_loader=None) -> None:
+        """Emit the epoch record (all ranks call this; collectives inside).
+
+        ``scalars`` carries train/val/test loss, lr, epoch_time_s,
+        train_tasks.  Pipeline counters and loader padding efficiency are
+        collected here; cross-rank min/max/avg of timing metrics ride the
+        host collectives when enabled.
+        """
+        rec: Dict[str, Any] = {
+            "event": "epoch",
+            "run_id": self.run_id,
+            "rank": self.rank,
+            "t": time.time(),
+            "epoch": int(epoch),
+            **scalars,
+        }
+        if self.enabled:
+            if train_loader is not None:
+                eff = _loader_padding_efficiency(train_loader)
+                if eff is not None:
+                    rec["padding_efficiency"] = eff
+                    rec["padding_waste_pct"] = (1.0 - eff) * 100.0
+            pipe = pipeline.snapshot(reset=True)
+            if pipe:
+                rec["pipeline"] = pipe
+        # collectives only when the subsystem is ON: a disabled logger must
+        # not add a per-epoch host collective to every multi-process run
+        if self.enabled and self.cross_rank and self.world_size > 1:
+            self._reduce_ranks(rec)
+        self._emit(rec)
+
+    def _reduce_ranks(self, rec: Dict[str, Any]) -> None:
+        """min/max/avg of per-rank timing metrics via host collectives.
+        The key list is derived the same way on every rank (same code, same
+        trainer-built record), keeping the collective symmetric."""
+        from hydragnn_tpu.parallel.comm import host_allreduce
+
+        keys = [k for k in ("epoch_time_s", "graphs_per_s") if k in rec]
+        if not keys:
+            return
+        vals = np.asarray([float(rec[k]) for k in keys], np.float64)
+        mn = host_allreduce(vals, "min")
+        mx = host_allreduce(vals, "max")
+        sm = host_allreduce(vals, "sum")
+        rec["ranks"] = {
+            k: {"min": float(mn[i]), "max": float(mx[i]),
+                "avg": float(sm[i]) / self.world_size}
+            for i, k in enumerate(keys)
+        }
+
+    # -- end of run ----------------------------------------------------------
+
+    def finalize(self, history: Optional[Dict[str, Any]] = None,
+                 timers: Optional[Dict[str, Any]] = None) -> None:
+        """Write the end-of-run manifest (TimerTracer summaries folded in)
+        and close the sinks."""
+        if self.enabled:
+            rec: Dict[str, Any] = {
+                "event": "manifest",
+                "run_id": self.run_id,
+                "run_name": self.run_name,
+                "rank": self.rank,
+                "world_size": self.world_size,
+                "t": time.time(),
+                "total_steps": self._global_step,
+                "total_dispatches": self._dispatch,
+                "peak_flops_basis": peak_flops(),
+                "flops_method": "XLA cost model of the timed program "
+                                "(telemetry/flops.py:step_cost_flops — "
+                                "shared with bench.py; Pallas-opaque)",
+                "ring_summary": self.ring.aggregate(),
+            }
+            if history is not None:
+                rec["history"] = {
+                    k: v for k, v in history.items()
+                    if k in ("train", "val", "test", "lr", "epoch_time",
+                             "pipeline")}
+            if timers is not None:
+                rec["timers"] = timers
+            pipe = pipeline.snapshot(reset=True)
+            if pipe:
+                rec["pipeline"] = pipe
+            self._emit(rec)
+            pipeline.set_enabled(False)
+        for s in self.sinks:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — close is best-effort
+                pass
+        self.sinks = []
+
+    # -- internals -----------------------------------------------------------
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        for s in self.sinks:
+            s.emit(record)
+
+    @property
+    def jsonl_path(self) -> str:
+        return os.path.join(self.out_dir, "events.jsonl")

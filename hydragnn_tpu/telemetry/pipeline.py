@@ -1,0 +1,69 @@
+"""Input-pipeline counters: queue depth, transfer bytes, collate volume.
+
+The prefetch/dataloader stack is a chain of wrappers built fresh per run
+(GraphDataLoader -> PrefetchLoader -> DeviceStackLoader -> DevicePrefetcher
+-> ResidentDeviceLoader ...), several of which produce from background
+threads — so the counters live here as one module-level, lock-guarded
+accumulator instead of being threaded through every wrapper's constructor.
+The MetricsLogger snapshots (and resets) them once per epoch into the epoch
+JSONL record.
+
+Everything is gated on :func:`enabled` (set by the MetricsLogger when step
+telemetry is on): disabled, every hook is a single dict lookup + branch, so
+the hot collate/transfer paths stay pristine for non-telemetry runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_enabled = False
+
+
+def set_enabled(flag: bool) -> None:
+    global _enabled
+    _enabled = bool(flag)
+    if not _enabled:
+        with _lock:
+            _counters.clear()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def add(key: str, value: float = 1.0) -> None:
+    """Accumulate ``value`` onto ``key`` (no-op unless enabled)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[key] = _counters.get(key, 0.0) + float(value)
+
+
+def batch_nbytes(batch) -> int:
+    """Host-side byte size of a batch pytree (numpy leaves; device arrays
+    report their nbytes too)."""
+    import jax
+
+    return int(sum(getattr(l, "nbytes", 0)
+                   for l in jax.tree_util.tree_leaves(batch)))
+
+
+def snapshot(reset: bool = False) -> Dict[str, float]:
+    """Current counters (plus derived averages); optionally reset — the
+    per-epoch consumer resets so each epoch record carries deltas."""
+    with _lock:
+        out = dict(_counters)
+        if reset:
+            _counters.clear()
+    # derived: average queue depth per get, average bytes per batch
+    for base in ("prefetch_qdepth", "device_prefetch_qdepth"):
+        n = out.get(base + "_gets", 0.0)
+        if n:
+            out[base + "_avg"] = out.get(base + "_sum", 0.0) / n
+    if out.get("h2d_batches"):
+        out["h2d_bytes_per_batch"] = out["h2d_bytes"] / out["h2d_batches"]
+    return out

@@ -1,0 +1,186 @@
+"""Pluggable telemetry sinks: JSONL event log, CSV, stdout heartbeat,
+TensorBoard.
+
+A sink receives every telemetry record (a JSON-serializable dict with an
+``event`` field: "run_start" | "step" | "epoch" | "manifest") and renders
+the subset it cares about.  Sinks are constructed rank-0-only by the
+MetricsLogger, so none of them needs its own rank gate.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Sink:
+    def emit(self, record: Dict[str, Any]) -> None:  # pragma: no cover
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+class JsonlSink(Sink):
+    """One JSON object per line, flushed per record so ``tools/teleview.py``
+    can tail a live run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(record, separators=(",", ":"),
+                                 default=_json_default) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class CsvSink(Sink):
+    """Step records as a flat CSV (one row per step; the schema is the
+    flattened key set of the FIRST step record — later records fill missing
+    columns with empty cells and drop unknown ones, keeping the file
+    rectangular).  Truncates on open: a CSV cannot tolerate a restart's
+    second header / different column set mid-file the way the append-mode
+    JSONL can — one run per file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "w", newline="", buffering=1)
+        self._writer: Optional[csv.DictWriter] = None
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        if record.get("event") != "step":
+            return
+        flat = _flatten(record)
+        if self._writer is None:
+            self._writer = csv.DictWriter(
+                self._f, fieldnames=list(flat), extrasaction="ignore")
+            self._writer.writeheader()
+        self._writer.writerow(flat)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class StdoutSink(Sink):
+    """Heartbeat: one compact line every ``every`` step records (and every
+    epoch record), so a console user sees in-run loss/MFU/padding without
+    opening the JSONL."""
+
+    def __init__(self, every: int = 50, stream=None):
+        self.every = max(1, int(every))
+        self._n = 0
+        self._stream = stream or sys.stdout
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        ev = record.get("event")
+        if ev == "step":
+            self._n += 1
+            if self._n % self.every:
+                return
+            parts = [f"step {record.get('step', '?')}",
+                     f"loss {record.get('loss', float('nan')):.5g}"]
+            if record.get("grad_norm") is not None:
+                parts.append(f"|g| {record['grad_norm']:.3g}")
+            if record.get("step_time_s") is not None:
+                parts.append(f"{record['step_time_s'] * 1e3:.1f} ms")
+            pad = record.get("padding") or {}
+            if pad.get("nodes_waste_pct") is not None:
+                parts.append(f"pad {pad['nodes_waste_pct']:.1f}%")
+            if record.get("mfu_est_pct") is not None:
+                parts.append(f"mfu {record['mfu_est_pct']:.2f}%")
+            print("telemetry: " + "  ".join(parts), file=self._stream,
+                  flush=True)
+        elif ev == "epoch":
+            print(f"telemetry: epoch {record.get('epoch')} "
+                  f"train {record.get('train_loss', float('nan')):.6g} "
+                  f"val {record.get('val_loss', float('nan')):.6g} "
+                  f"({record.get('epoch_time_s', 0.0):.2f}s)",
+                  file=self._stream, flush=True)
+
+
+class TensorBoardSink(Sink):
+    """The pre-telemetry TensorBoard scalars, refactored into a sink: the
+    same four tags the trainer used to write inline
+    (train/validate/test error + per-task train error, one point per
+    epoch), plus the new per-step norms under a ``telemetry/`` prefix.
+    Wraps an existing SummaryWriter; closing is the creator's business."""
+
+    def __init__(self, writer):
+        self.writer = writer
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        ev = record.get("event")
+        if ev == "epoch":
+            epoch = int(record["epoch"])
+            self.writer.add_scalar("train error", record["train_loss"], epoch)
+            self.writer.add_scalar(
+                "validate error", record["val_loss"], epoch)
+            self.writer.add_scalar("test error", record["test_loss"], epoch)
+            for i, t in enumerate(record.get("train_tasks", ())):
+                self.writer.add_scalar(
+                    f"train error of task {i}", float(t), epoch)
+        elif ev == "step":
+            step = int(record.get("step", 0))
+            for k in ("loss", "grad_norm", "param_norm", "update_norm",
+                      "mfu_est_pct"):
+                v = record.get(k)
+                if v is not None:
+                    self.writer.add_scalar(f"telemetry/{k}", float(v), step)
+
+
+def _json_default(o):
+    try:
+        return float(o)
+    except Exception:  # noqa: BLE001 — last resort, keep the line valid
+        return repr(o)
+
+
+def _flatten(record: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in record.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        elif isinstance(v, (list, tuple)):
+            for i, item in enumerate(v):
+                out[f"{key}.{i}"] = item
+        else:
+            out[key] = v
+    return out
+
+
+def build_sinks(names, out_dir: str, run_id: str,
+                heartbeat: int = 50) -> List[Sink]:
+    """Instantiate the named sinks ("jsonl", "csv", "stdout") under
+    ``out_dir``.  Unknown names raise — a typo must not silently drop a
+    run's event log."""
+    sinks: List[Sink] = []
+    for name in names:
+        name = name.strip().lower()
+        if not name:
+            continue
+        if name == "jsonl":
+            sinks.append(JsonlSink(os.path.join(out_dir, "events.jsonl")))
+        elif name == "csv":
+            sinks.append(CsvSink(os.path.join(out_dir, "steps.csv")))
+        elif name == "stdout":
+            sinks.append(StdoutSink(every=heartbeat))
+        elif name == "tensorboard":
+            # attach-only: the TensorBoardSink wraps the trainer's
+            # SummaryWriter (MetricsLogger.attach_tensorboard), which does
+            # not exist yet at sink-construction time — accept the name so
+            # README's sink list is valid config, build nothing here
+            continue
+        else:
+            raise ValueError(f"unknown telemetry sink {name!r} "
+                             f"(known: jsonl, csv, stdout, tensorboard)")
+    return sinks

@@ -179,12 +179,45 @@ def _loss_and_metrics(
     return total, (per_head, new_stats, outputs)
 
 
+def tree_l2_norm(tree) -> jax.Array:
+    """Global L2 norm of a pytree's leaves, accumulated in f32 (the in-jit
+    grad/param/update norm metric — a tree-wide reduction is noise next to
+    the step's matmuls, and under scan-chunking it rides the same
+    executable, so it's effectively free)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def step_telemetry_metrics(g: GraphBatch, grads, new_params,
+                           updates) -> Dict[str, jax.Array]:
+    """The in-jit telemetry extension of the step ``metrics`` dict: global
+    grad/param/update norms plus real node/edge counts (the numerators of
+    the host-side padding-waste accounting; the denominators are the static
+    padded shapes the host already knows)."""
+    return {
+        "grad_norm": tree_l2_norm(grads),
+        "param_norm": tree_l2_norm(new_params),
+        "update_norm": tree_l2_norm(updates),
+        "nodes_real": jnp.sum(g.node_mask),
+        "edges_real": jnp.sum(g.edge_mask),
+    }
+
+
 def make_train_step(
     model: Base,
     cfg: ModelConfig,
     opt_spec: OptimizerSpec,
     output_names: Optional[Sequence[str]] = None,
+    telemetry_metrics: bool = False,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """``telemetry_metrics=True`` adds the in-jit norm/count extension; the
+    trainer passes the MetricsLogger's enable state.  Default OFF so direct
+    builders (bench.py, tools/) time/cost-model the exact program a
+    non-telemetry production run executes."""
     energy_head, forces_head = _force_head_indices(output_names)
 
     def train_step(state: TrainState, g: GraphBatch):
@@ -216,23 +249,32 @@ def make_train_step(
             "num_graphs": g.n_real_graphs,
             **{f"task_{i}": t for i, t in enumerate(per_head)},
         }
+        if telemetry_metrics:
+            metrics.update(
+                step_telemetry_metrics(g, grads, new_params, updates))
         return new_state, metrics
 
     return train_step
 
 
+# metric keys that are COUNTS over the dispatch (summed across the K
+# scanned steps); every other scalar merges as a graph-weighted mean
+_COUNT_METRIC_KEYS = ("num_graphs", "nodes_real", "edges_real")
+
+
 def merge_scanned_metrics(ms):
     """Graph-weighted merge of per-step metric stacks [K] from a scanned
     multi-step train step — same epoch-accumulation semantics as K separate
-    dispatches (one definition shared by the local and mesh scan paths)."""
+    dispatches (one definition shared by the local and mesh scan paths).
+    Counts (graphs/nodes/edges consumed) sum over the K steps; losses and
+    the telemetry norms merge graph-weighted."""
     ng = ms["num_graphs"]
     total = jnp.maximum(jnp.sum(ng), 1.0)
-    merged = {
-        "loss": jnp.sum(ms["loss"] * ng) / total,
-        "num_graphs": jnp.sum(ng),
-    }
+    merged = {}
     for k, v in ms.items():
-        if k.startswith("task_"):
+        if k in _COUNT_METRIC_KEYS:
+            merged[k] = jnp.sum(v)
+        else:
             merged[k] = jnp.sum(v * ng) / total
     return merged
 
@@ -319,6 +361,7 @@ def make_scan_train_step(
     opt_spec: OptimizerSpec,
     output_names: Optional[Sequence[str]] = None,
     steps: int = 1,
+    telemetry_metrics: bool = False,
 ):
     """K sequential train steps inside one executable via ``lax.scan``.
 
@@ -332,7 +375,8 @@ def make_scan_train_step(
     """
     from jax import lax
 
-    base = make_train_step(model, cfg, opt_spec, output_names)
+    base = make_train_step(model, cfg, opt_spec, output_names,
+                           telemetry_metrics=telemetry_metrics)
 
     def scan_step(state: TrainState, g: GraphBatch):
         state, ms = lax.scan(base, state, g, length=steps)
@@ -478,7 +522,7 @@ def load_state(state: TrainState, log_name: str, path: str = "./logs/") -> Train
 
 
 def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
-               steps_per_item: int = 1):
+               steps_per_item: int = 1, telemetry=None):
     # Metrics accumulate as DEVICE scalars: no float() in the batch loop, so
     # steps dispatch back-to-back with no device->host sync (the reference
     # accumulates on device and reduces at epoch end,
@@ -503,6 +547,10 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
             break
         if train:
             state, metrics = step_fn(state, g)
+            if telemetry is not None:
+                # zero-sync: device scalars + host timestamp are buffered;
+                # the one fetch happens in telemetry.flush_steps at epoch end
+                telemetry.on_step(metrics, g)
             n_tasks = sum(1 for k in metrics if k.startswith("task_"))
             per_head = [metrics[f"task_{i}"] for i in range(n_tasks)]
         else:
@@ -547,6 +595,7 @@ def train_validate_test(
     use_mesh_dp: Optional[bool] = None,
     profile_config: Optional[Dict[str, Any]] = None,
     mesh=None,
+    telemetry=None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Epoch loop with LR plateau scheduling, early stopping, checkpointing.
 
@@ -562,6 +611,11 @@ def train_validate_test(
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     output_names = config_nn["Variables_of_interest"].get("output_names")
+    # an explicit (ensemble-branch) mesh means other branches run disjoint
+    # programs concurrently — global host collectives (telemetry cross-rank
+    # reduction) would interleave with theirs and deadlock; remember before
+    # ``mesh`` is reassigned below
+    explicit_mesh = mesh is not None
 
     if rank is None:
         # who writes artifacts for this log_name: with an explicit (branch)
@@ -573,6 +627,22 @@ def train_validate_test(
             rank = 0 if jax.process_index() == leader else 1
         else:
             rank = jax.process_index()
+
+    # unified telemetry (hydragnn_tpu/telemetry): callers (run_training)
+    # pass a configured MetricsLogger; direct trainer users get the env-knob
+    # construction (HYDRAGNN_TELEMETRY=1 turns on the JSONL event log with
+    # no config edit).  Built BEFORE the step functions: its enable state
+    # decides whether the jitted steps carry the in-jit norm metrics.
+    # Epoch records flow through it unconditionally — that's how the
+    # TensorBoard scalars are written (TensorBoardSink).
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    if telemetry is None:
+        telemetry = MetricsLogger.from_env(
+            run_name=log_name,
+            out_dir=os.path.join(logs_dir, log_name, "telemetry"),
+            rank=rank, world_size=world_size,
+            cross_rank=(not explicit_mesh and world_size > 1))
 
     n_local_devices = len(jax.local_devices())
     n_proc = jax.process_count()
@@ -636,7 +706,8 @@ def train_validate_test(
         steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
-            zero_specs=zero_specs, steps=steps_per_dispatch)
+            zero_specs=zero_specs, steps=steps_per_dispatch,
+            telemetry_metrics=telemetry.enabled)
         eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes)
         _align_bucket_group(
             train_loader, n_local_devices * steps_per_dispatch)
@@ -695,14 +766,16 @@ def train_validate_test(
 
             train_step = jax.jit(
                 make_scan_train_step(model, cfg, opt_spec, output_names,
-                                     steps_per_dispatch),
+                                     steps_per_dispatch,
+                                     telemetry_metrics=telemetry.enabled),
                 donate_argnums=0)
             _align_bucket_group(train_loader, steps_per_dispatch)
             train_loader = DeviceStackLoader(
                 train_loader, steps_per_dispatch, drop_last=True)
         else:
             train_step = jax.jit(
-                make_train_step(model, cfg, opt_spec, output_names),
+                make_train_step(model, cfg, opt_spec, output_names,
+                                telemetry_metrics=telemetry.enabled),
                 donate_argnums=0)
         if env_flag("HYDRAGNN_DEVICE_PREFETCH"):
             # async H2D of upcoming (stacked) batches — AFTER stacking, so
@@ -759,6 +832,9 @@ def train_validate_test(
     # profiler.step() per train batch, train_validate_test.py:503)
     profiler = Profiler(profile_config, log_name, logs_dir)
 
+    telemetry.attach_tensorboard(writer)
+    telemetry.bind_step(train_step, state, steps_per_dispatch)
+
     history: Dict[str, Any] = {
         "train": [], "val": [], "test": [], "lr": [], "epoch_time": [],
         # the fast-pipeline configuration THIS run actually used — exact
@@ -770,100 +846,132 @@ def train_validate_test(
                          "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
 
-    for epoch in range(num_epoch):
-        t0 = time.time()
-        train_loader.set_epoch(epoch)
-        # train/val/test all DISPATCH without a device->host sync; ONE
-        # combined device_get drains the queue per epoch (each separate
-        # sync costs a full tunnel round trip, ~100 ms on remote PJRT —
-        # three of them made the out-of-the-box epoch 37% slower).  The
-        # tr regions therefore time dispatch, not execution; the fetch
-        # region carries the wait.
-        tr.start("train")
-        state, train_acc = _run_epoch(
-            train_step, state, train_loader, True, profiler=profiler,
-            steps_per_item=steps_per_dispatch)
-        tr.stop("train")
-        # HYDRAGNN_VALTEST=0 skips the val/test epochs (reference knob)
-        valtest = bool(int(os.getenv("HYDRAGNN_VALTEST", "1")))
-        val_acc = test_acc = None
-        if valtest:
-            tr.start("validate")
-            _, val_acc = _run_epoch(eval_step, state, val_loader, False)
-            tr.stop("validate")
-            tr.start("test")
-            _, test_acc = _run_epoch(eval_step, state, test_loader, False)
-            tr.stop("test")
-        tr.start("metrics_fetch")
-        train_acc, val_acc, test_acc = jax.device_get(
-            (train_acc, val_acc, test_acc))
-        tr.stop("metrics_fetch")
-        train_loss, train_tasks = _epoch_metrics(train_acc)
-        if valtest:
-            val_loss, _ = _epoch_metrics(val_acc)
-            test_loss, _ = _epoch_metrics(test_acc)
-        else:
-            val_loss = test_loss = train_loss
+    try:
+        for epoch in range(num_epoch):
+            t0 = time.time()
+            telemetry.begin_epoch(epoch)
+            train_loader.set_epoch(epoch)
+            # train/val/test all DISPATCH without a device->host sync; ONE
+            # combined device_get drains the queue per epoch (each separate
+            # sync costs a full tunnel round trip, ~100 ms on remote PJRT —
+            # three of them made the out-of-the-box epoch 37% slower).  The
+            # tr regions therefore time dispatch, not execution; the fetch
+            # region carries the wait.
+            tr.start("train")
+            state, train_acc = _run_epoch(
+                train_step, state, train_loader, True, profiler=profiler,
+                steps_per_item=steps_per_dispatch,
+                telemetry=telemetry if telemetry.enabled else None)
+            tr.stop("train")
+            # HYDRAGNN_VALTEST=0 skips the val/test epochs (reference knob)
+            valtest = bool(int(os.getenv("HYDRAGNN_VALTEST", "1")))
+            val_acc = test_acc = None
+            if valtest:
+                tr.start("validate")
+                _, val_acc = _run_epoch(eval_step, state, val_loader, False)
+                tr.stop("validate")
+                tr.start("test")
+                _, test_acc = _run_epoch(eval_step, state, test_loader, False)
+                tr.stop("test")
+            tr.start("metrics_fetch")
+            train_acc, val_acc, test_acc = jax.device_get(
+                (train_acc, val_acc, test_acc))
+            # drain the buffered per-step telemetry in the same sync window
+            # (one device_get of tiny scalars; no-op when disabled)
+            telemetry.flush_steps()
+            tr.stop("metrics_fetch")
+            train_loss, train_tasks = _epoch_metrics(train_acc)
+            if valtest:
+                val_loss, _ = _epoch_metrics(val_acc)
+                test_loss, _ = _epoch_metrics(test_acc)
+            else:
+                val_loss = test_loss = train_loss
 
-        if world_size > 1 and not use_mesh_dp:
-            # local-jit fallback only: the global-mesh step already psums
-            # losses across every process's devices inside the jit.
-            from hydragnn_tpu.parallel.comm import host_allreduce
-            reduced = host_allreduce(
-                np.asarray([train_loss, val_loss, test_loss]), op="sum")
-            train_loss, val_loss, test_loss = (reduced / world_size).tolist()
+            if world_size > 1 and not use_mesh_dp:
+                # local-jit fallback only: the global-mesh step already psums
+                # losses across every process's devices inside the jit.
+                from hydragnn_tpu.parallel.comm import host_allreduce
+                reduced = host_allreduce(
+                    np.asarray([train_loss, val_loss, test_loss]), op="sum")
+                train_loss, val_loss, test_loss = (reduced / world_size).tolist()
 
-        new_lr = scheduler.step(val_loss, lr)
-        if new_lr != lr:
-            lr = new_lr
-            state = state.replace(
-                opt_state=set_learning_rate(state.opt_state, lr))
+            new_lr = scheduler.step(val_loss, lr)
+            if new_lr != lr:
+                lr = new_lr
+                state = state.replace(
+                    opt_state=set_learning_rate(state.opt_state, lr))
 
-        history["train"].append(train_loss)
-        history["val"].append(val_loss)
-        history["test"].append(test_loss)
-        history["lr"].append(lr)
-        # wall time per epoch (train + val/test + host bookkeeping): the
-        # sustained-throughput evidence bench.py reports comes from here
-        history["epoch_time"].append(time.time() - t0)
+            history["train"].append(train_loss)
+            history["val"].append(val_loss)
+            history["test"].append(test_loss)
+            history["lr"].append(lr)
+            # wall time per epoch (train + val/test + host bookkeeping): the
+            # sustained-throughput evidence bench.py reports comes from here
+            history["epoch_time"].append(time.time() - t0)
 
-        if writer is not None and rank == 0:
-            writer.add_scalar("train error", train_loss, epoch)
-            writer.add_scalar("validate error", val_loss, epoch)
-            writer.add_scalar("test error", test_loss, epoch)
-            for i, t in enumerate(train_tasks):
-                writer.add_scalar(f"train error of task {i}", float(t), epoch)
+            # one epoch record through the telemetry spine: the TensorBoard
+            # scalars ride TensorBoardSink (same tags as the old inline
+            # add_scalar calls), JSONL/CSV/stdout sinks get the full record,
+            # and cross-rank min/max/avg of the timing metrics reduce here
+            epoch_scalars = {
+                "train_loss": train_loss,
+                "val_loss": val_loss,
+                "test_loss": test_loss,
+                "lr": lr,
+                "epoch_time_s": history["epoch_time"][-1],
+                "train_tasks": [float(t) for t in train_tasks],
+            }
+            # epoch-level throughput (the fetched accumulator's graph count
+            # over the epoch wall clock) — the metric the cross-rank
+            # min/max/avg reduction compares across hosts.  ALWAYS present
+            # (0.0 for an empty epoch): the reduction's key list must be
+            # identical on every rank or the collective shapes mismatch.
+            epoch_scalars["graphs_per_s"] = (
+                float(train_acc[2]) / history["epoch_time"][-1]
+                if train_acc is not None and history["epoch_time"][-1] > 0
+                else 0.0)
+            telemetry.log_epoch(epoch, epoch_scalars,
+                                train_loader=train_loader)
 
-        print_distributed(
-            verbosity,
-            f"Epoch: {epoch:4d}, train loss: {train_loss:.8f}, "
-            f"val loss: {val_loss:.8f}, test loss: {test_loss:.8f}, "
-            f"lr: {lr:.2e}  ({time.time() - t0:.2f}s)",
-        )
+            print_distributed(
+                verbosity,
+                f"Epoch: {epoch:4d}, train loss: {train_loss:.8f}, "
+                f"val loss: {val_loss:.8f}, test loss: {test_loss:.8f}, "
+                f"lr: {lr:.2e}  ({time.time() - t0:.2f}s)",
+            )
 
-        if checkpointer is not None:
-            checkpointer(state, val_loss)
-        if orbax_every and (epoch + 1) % orbax_every == 0:
-            # EVERY process calls this: the ZeRO consolidation jit and
-            # orbax's CheckpointManager are both cross-process collectives —
-            # a rank-0 gate would deadlock multi-host runs.
-            from hydragnn_tpu.utils.checkpoint import save_checkpoint
+            if checkpointer is not None:
+                checkpointer(state, val_loss)
+            if orbax_every and (epoch + 1) % orbax_every == 0:
+                # EVERY process calls this: the ZeRO consolidation jit and
+                # orbax's CheckpointManager are both cross-process collectives —
+                # a rank-0 gate would deadlock multi-host runs.
+                from hydragnn_tpu.utils.checkpoint import save_checkpoint
 
-            save_checkpoint(consolidate(state), orbax_dir)
-        if earlystopper is not None and earlystopper(val_loss):
-            print_distributed(verbosity, f"Early stopping at epoch {epoch}")
-            break
-        # SLURM walltime graceful stop (reference train_validate_test.py:229-235)
-        if os.getenv("SLURM_JOB_ID"):
-            from hydragnn_tpu.utils.slurm import check_remaining
-
-            if not check_remaining(time.time() - t0):
-                print_distributed(
-                    verbosity,
-                    f"Stopping at epoch {epoch}: insufficient SLURM walltime")
+                save_checkpoint(consolidate(state), orbax_dir)
+            if earlystopper is not None and earlystopper(val_loss):
+                print_distributed(verbosity, f"Early stopping at epoch {epoch}")
                 break
+            # SLURM walltime graceful stop (reference train_validate_test.py:229-235)
+            if os.getenv("SLURM_JOB_ID"):
+                from hydragnn_tpu.utils.slurm import check_remaining
 
-    profiler.disable()
+                if not check_remaining(time.time() - t0):
+                    print_distributed(
+                        verbosity,
+                        f"Stopping at epoch {epoch}: insufficient SLURM walltime")
+                    break
+
+    finally:
+        # teardown runs on EVERY exit path — a crash mid-epoch must
+        # still stop an active trace, write the (partial-history)
+        # manifest, close the sinks and unlatch the module-global
+        # pipeline counters, or the next run in this process (HPO
+        # trial, test) inherits stale telemetry state
+        profiler.disable()
+        timer = tr.get("timer")
+        telemetry.finalize(
+            history, timers=timer.summary() if timer is not None else None)
     if use_mesh_dp and zero_dims is not None:
         from hydragnn_tpu.parallel.zero import consolidate_opt_state
 

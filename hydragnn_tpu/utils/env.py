@@ -13,3 +13,7 @@ def env_flag(name: str) -> bool:
 
 def env_int(name: str, default: int = 0) -> int:
     return int(os.environ.get(name, str(default)) or default)
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default) or default

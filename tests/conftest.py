@@ -44,3 +44,10 @@ def _scratch_cwd(tmp_path_factory):
     os.environ["SERIALIZED_DATA_PATH"] = scratch
     yield scratch
     os.chdir(old)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight end-to-end suites (full example/accuracy "
+        "training runs) excluded from the tier-1 `-m 'not slow'` pass")

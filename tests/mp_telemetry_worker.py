@@ -1,0 +1,53 @@
+"""Worker for the telemetry cross-rank reduction test: two jax.distributed
+CPU processes each log one epoch record with a rank-dependent epoch time;
+rank 0's JSONL must carry the min/max/avg across BOTH ranks (the host
+collectives in MetricsLogger._reduce_ranks are entered by every rank)."""
+
+import json
+import os
+import sys
+
+rank = int(sys.argv[1])
+world = int(sys.argv[2])
+port = sys.argv[3]
+scratch = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=world,
+    process_id=rank,
+)
+assert jax.process_count() == world
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hydragnn_tpu.telemetry import MetricsLogger, TelemetryConfig
+
+out_dir = os.path.join(scratch, f"tele_rank{rank}")
+logger = MetricsLogger(
+    TelemetryConfig(enable=True, sinks=("jsonl",)),
+    run_name="mp_telemetry", out_dir=out_dir,
+    rank=rank, world_size=world, cross_rank=True)
+
+# rank 0 -> 1.0s, rank 1 -> 3.0s: reduced min/max/avg must be 1/3/2
+logger.log_epoch(0, {
+    "train_loss": 0.5, "val_loss": 0.4, "test_loss": 0.3,
+    "lr": 1e-3, "epoch_time_s": 1.0 + 2.0 * rank, "train_tasks": [],
+})
+logger.finalize()
+
+if rank == 0:
+    recs = [json.loads(line)
+            for line in open(os.path.join(out_dir, "events.jsonl"))]
+    ep = [r for r in recs if r["event"] == "epoch"][0]
+    rk = ep["ranks"]["epoch_time_s"]
+    print(f"TELEMRESULT rank=0 min={rk['min']:.4f} max={rk['max']:.4f} "
+          f"avg={rk['avg']:.4f}")
+else:
+    # non-rank-0 has no sinks; reaching here means the collective matched
+    print(f"TELEMRESULT rank={rank} ok=1")

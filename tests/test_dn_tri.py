@@ -163,3 +163,54 @@ def test_model_level_kernel_equals_composed(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat_off[path]), rtol=5e-4,
             atol=5e-4, err_msg=str(path))
+
+
+def test_dn_tri_gate_static_and_sticky(monkeypatch):
+    """DnTriGate: static mode decides once from the dataset bound with no
+    per-batch measurement; sticky mode falls back for the whole run on the
+    first over-span batch (ADVICE: dn_tri_ok marker instability)."""
+    from hydragnn_tpu.models.dimenet import DnTriGate
+    from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK
+
+    def must_not_measure():
+        raise AssertionError("static gate measured a batch span")
+
+    # static: small bound -> always ok, and never measures
+    small = DnTriGate(max_edges_per_graph=2 * _NODE_BLOCK)
+    assert small.static and small.allow(must_not_measure)
+    assert small.allow(must_not_measure)  # stable across batches
+    # static: a bound spanning > 2 blocks at worst alignment -> always off
+    big = DnTriGate(max_edges_per_graph=4 * _NODE_BLOCK)
+    assert not big.allow(must_not_measure)
+
+    # sticky: first over-span disables the marker for the rest of the run
+    gate = DnTriGate()
+    assert gate.allow(lambda: 1)
+    assert not gate.allow(lambda: 3)
+    assert not gate.allow(lambda: 0)  # stays off: whole-run fallback
+    assert not gate.allow(must_not_measure)  # and stops measuring
+
+
+def test_dn_tri_gate_marker_consistency(monkeypatch):
+    """With a static gate every batch carries the same extras tree even if
+    an individual batch would have over-spanned the per-batch check."""
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    from hydragnn_tpu.models.dimenet import DnTriGate
+
+    def raw_batch(seed):
+        rng = np.random.RandomState(seed)
+        samples = []
+        for _ in range(5):
+            pos = rng.rand(7, 3).astype(np.float32) * 2.0
+            samples.append(GraphSample(
+                x=rng.rand(7, 1).astype(np.float32), pos=pos,
+                edge_index=radius_graph(pos, 1.3, 6),
+                graph_y=rng.rand(1).astype(np.float32)))
+        pad = PadSpec.for_batch(5, 7, max(s.num_edges for s in samples))
+        return collate(samples, pad, [HeadSpec("e", "graph", 1)])
+
+    gate = DnTriGate(max_edges_per_graph=42)
+    b1 = add_dimenet_extras(raw_batch(21), max_triplets=4096, tri_gate=gate)
+    b2 = add_dimenet_extras(raw_batch(22), max_triplets=4096, tri_gate=gate)
+    assert ("dn_tri_ok" in b1.extras) == ("dn_tri_ok" in b2.extras)
+    assert sorted(b1.extras) == sorted(b2.extras)

@@ -11,6 +11,12 @@ import pytest
 
 import hydragnn_tpu
 
+# The accuracy matrix trains 26 configs to threshold (~25 min total on the
+# CPU mesh; TEST_MATRIX.md).  Until the shard_map import fix these failed
+# at import time and cost tier-1 nothing; actually RUNNING them does not
+# fit the 870 s tier-1 budget, so they are tier-2 (`-m slow`).
+pytestmark = pytest.mark.slow
+
 # RMSE-threshold / sample-MAE-threshold per model (reference
 # tests/test_graphs.py:126-136)
 THRESHOLDS = {

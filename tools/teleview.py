@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""teleview: summarize a telemetry JSONL event log as a compact table.
+
+Usage:
+    python tools/teleview.py LOGDIR_OR_FILE [--tail N] [--epochs] [--json]
+
+Accepts either the events.jsonl file itself or a directory containing one
+(e.g. ``logs/<run>/telemetry``).  Pure stdlib — safe to run anywhere,
+including while a run is still writing (the JSONL sink flushes per record).
+
+Default view: the last ``--tail`` step records (epoch, step, loss,
+grad-norm, step time, padding waste, MFU estimate) followed by the epoch
+rows and the manifest summary.  ``--epochs`` shows only epoch rows;
+``--json`` re-emits the selected records as JSONL (for piping into jq).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def find_events(path: str) -> str:
+    if os.path.isdir(path):
+        cand = os.path.join(path, "events.jsonl")
+        if os.path.exists(cand):
+            return cand
+        # accept logs/<run>/ by looking one level down
+        cand = os.path.join(path, "telemetry", "events.jsonl")
+        if os.path.exists(cand):
+            return cand
+        raise FileNotFoundError(f"no events.jsonl under {path}")
+    return path
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # a live run may be mid-write on the last line
+                continue
+    return records
+
+
+def _fmt(v: Optional[float], spec: str = ".4g", dash: str = "-") -> str:
+    if v is None:
+        return dash
+    return format(v, spec)
+
+
+def _table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [len(h) for h in headers]
+    for r in rows:
+        widths = [max(w, len(c)) for w, c in zip(widths, r)]
+    fmt = "  ".join(f"{{:>{w}}}" for w in widths)
+    lines = [fmt.format(*headers)]
+    lines.append(fmt.format(*("-" * w for w in widths)))
+    lines.extend(fmt.format(*r) for r in rows)
+    return "\n".join(lines)
+
+
+def step_rows(steps: List[Dict[str, Any]]) -> str:
+    rows = []
+    for r in steps:
+        pad = r.get("padding") or {}
+        rows.append([
+            str(r.get("epoch", "-")),
+            str(r.get("step", "-")),
+            _fmt(r.get("loss"), ".6g"),
+            _fmt(r.get("grad_norm")),
+            _fmt(None if r.get("step_time_s") is None
+                 else r["step_time_s"] * 1e3, ".3g"),
+            _fmt(r.get("graphs_per_s"), ".4g"),
+            _fmt(pad.get("nodes_waste_pct"), ".1f"),
+            _fmt(pad.get("edges_waste_pct"), ".1f"),
+            _fmt(r.get("mfu_est_pct"), ".3g"),
+        ])
+    return _table(rows, ["ep", "step", "loss", "|grad|", "ms",
+                         "graphs/s", "pad_n%", "pad_e%", "mfu%"])
+
+
+def epoch_rows(epochs: List[Dict[str, Any]]) -> str:
+    rows = []
+    for r in epochs:
+        rows.append([
+            str(r.get("epoch", "-")),
+            _fmt(r.get("train_loss"), ".6g"),
+            _fmt(r.get("val_loss"), ".6g"),
+            _fmt(r.get("test_loss"), ".6g"),
+            _fmt(r.get("lr"), ".2e"),
+            _fmt(r.get("epoch_time_s"), ".3g"),
+            _fmt(r.get("padding_waste_pct"), ".1f"),
+        ])
+    return _table(rows, ["ep", "train", "val", "test", "lr", "s", "pad%"])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="events.jsonl or a directory holding one")
+    ap.add_argument("--tail", type=int, default=20,
+                    help="show the last N step records (default 20)")
+    ap.add_argument("--epochs", action="store_true",
+                    help="epoch rows only")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit selected records as JSONL")
+    args = ap.parse_args(argv)
+
+    path = find_events(args.path)
+    records = load_records(path)
+    steps = [r for r in records if r.get("event") == "step"]
+    epochs = [r for r in records if r.get("event") == "epoch"]
+    manifests = [r for r in records if r.get("event") == "manifest"]
+
+    if args.json:
+        sel = epochs if args.epochs else steps[-args.tail:] + epochs
+        for r in sel:
+            print(json.dumps(r, separators=(",", ":")))
+        return 0
+
+    print(f"{path}: {len(steps)} step, {len(epochs)} epoch, "
+          f"{len(manifests)} manifest record(s)")
+    if steps and not args.epochs:
+        print("\nlast steps:")
+        print(step_rows(steps[-args.tail:]))
+    if epochs:
+        print("\nepochs:")
+        print(epoch_rows(epochs))
+    if manifests:
+        m = manifests[-1]
+        print(f"\nmanifest: run {m.get('run_id')}  "
+              f"steps {m.get('total_steps')}  "
+              f"peak basis {m.get('peak_flops_basis', 0) / 1e12:.0f} TF/s")
+        agg = (m.get("ring_summary") or {}).get("mfu_est_pct")
+        if agg:
+            print(f"  mfu_est_pct (ring window): avg {agg['avg']:.3g}  "
+                  f"min {agg['min']:.3g}  max {agg['max']:.3g}")
+        timers = m.get("timers") or {}
+        for name, s in sorted(timers.items()):
+            print(f"  timer {name}: {s.get('total_s', 0.0):.3f}s "
+                  f"over {int(s.get('count', 0))} calls")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
