@@ -101,8 +101,10 @@ def _mxu_peak() -> float:
 
     return peak_flops()
 
-ARCHS = ["SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN", "SchNet", "DimeNet",
-         "EGNN"]
+# the per-arch sweep list is hydragnn_tpu.models.create.ALL_ARCHS — the ONE
+# canonical list shared with the parity tests — imported lazily inside the
+# child (the parent process must not import the package before choosing a
+# platform)
 
 
 def _baseline_ratio(graphs_per_sec: float) -> float:
@@ -521,6 +523,16 @@ def _arch_est(arch: str) -> float:
     return _EST["arch"]
 
 
+def _dispatch_backend(before: dict, after: dict) -> str:
+    """The aggregation backend an arch ACTUALLY used, from the trace-time
+    dispatch tally delta around its build+measure (telemetry/pipeline.py):
+    'fused' / 'scatter' / 'mixed(...)' / 'none'.  This is how a config
+    that silently fell off the fast path shows up in the arch records."""
+    from hydragnn_tpu.telemetry import pipeline
+
+    return pipeline.dispatch_summary(pipeline.dispatch_delta(before, after))
+
+
 def _deadline_remaining() -> float:
     d = float(os.getenv("HYDRAGNN_BENCH_DEADLINE", "0") or 0.0)
     return (d - time.time()) if d > 0 else float("inf")
@@ -530,7 +542,8 @@ def _shrunk(compact: dict) -> str:
     """Serialize the compact line, enforcing the <1 KB driver-tail contract
     by dropping optional blocks in reverse-importance order if needed."""
     line = json.dumps(compact, separators=(",", ":"))
-    for drop in ("skipped", "sustained_gps", "dense", "archs"):
+    for drop in ("aggr_fallback", "skipped", "sustained_gps", "dense",
+                 "archs"):
         if len(line) <= 1000:
             break
         compact = {k: v for k, v in compact.items() if k != drop}
@@ -796,11 +809,18 @@ def _child(platform: str) -> None:
         # DimeNet-bf16: user-selectable mixed_precision run of the
         # slow-tail arch.  GAT-h128: the at-width zoo row (round-4
         # VERDICT item 8) — the fused GATv2 kernel's width win.
+        # GAT-h256: hf=1536 — above one kernel call's FUSED_HF_LIMIT, so
+        # this row measures the head-group TILED fused path that used to
+        # silently fall back to the composed segment ops.
+        from hydragnn_tpu.models.create import ALL_ARCHS
+        from hydragnn_tpu.telemetry import pipeline as tele_pipeline
+
         order = ["DimeNet"]
         if dtype != "bfloat16":
             order.append("DimeNet-bf16")
-        order += ["GAT", "GAT-h128"] + [
-            a for a in ARCHS if a not in ("DimeNet", "GAT")]
+        order += ["GAT", "GAT-h128", "GAT-h256"] + [
+            a for a in ALL_ARCHS if a not in ("DimeNet", "GAT")]
+        fallback_archs = []
         for arch in order:
             est = _arch_est(arch)
             if _deadline_remaining() < est:
@@ -816,19 +836,31 @@ def _child(platform: str) -> None:
                     arch_model, adtype = arch[:-5], "bfloat16"
                 elif arch.endswith("-h128"):
                     arch_model, hidden = arch[:-5], 128
+                elif arch.endswith("-h256"):
+                    arch_model, hidden = arch[:-5], 256
+                disp0 = tele_pipeline.dispatch_snapshot()
                 astate, abatch, astep, acfg, _s, _h = _build(
                     model_type=arch_model, hidden=hidden, dtype=adtype,
                     tight_edges=tight)
                 astep_s, astate = _chip_loop(
                     astate, abatch, astep, max(n_iters // 4, 2),
                     max(n_repeats - 1, 1))
+                backend = _dispatch_backend(
+                    disp0, tele_pipeline.dispatch_snapshot())
                 sweep[arch] = {
                     "graphs_per_sec": round(512 / astep_s, 1),
                     "step_ms": round(astep_s * 1e3, 3),
+                    "aggr_backend": backend,
                 }
                 if not arch.endswith("-loose"):
                     sweep_c[arch] = round(512 / astep_s)
+                # the silent-fallback signal: the fused backend was
+                # requested but this arch's traces took scatter paths
+                if (os.environ.get("HYDRAGNN_AGGR_BACKEND") == "fused"
+                        and backend != "fused"):
+                    fallback_archs.append(arch)
                 print(f"bench: arch {arch} {512 / astep_s:,.0f} g/s "
+                      f"aggr={backend} "
                       f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
             except Exception as e:  # noqa: BLE001
                 sweep[arch] = {"error": repr(e)[:160]}
@@ -838,6 +870,9 @@ def _child(platform: str) -> None:
             _release_device()
             evidence["archs"] = dict(sweep)
             compact["archs"] = dict(sweep_c)
+            if fallback_archs:
+                evidence["aggr_fallback_archs"] = list(fallback_archs)
+                compact["aggr_fallback"] = list(fallback_archs)
             emit()
 
     if want("sustained", _EST["sustained"]):

@@ -17,12 +17,22 @@ kernel) — see hydragnn_tpu/ops/aggregate.py.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
 _BIG = 1e9
+
+
+def _count(op: str, fused: bool) -> None:
+    """Trace-time dispatch tally (fused fast path vs scatter fallback) —
+    folded into the telemetry manifest and bench's per-arch records so a
+    run that silently fell off the fast path is visible.  Runs once per
+    trace (Python level), never inside compiled code."""
+    from hydragnn_tpu.telemetry import pipeline
+
+    pipeline.count_fused_choice(op, fused)
 
 
 def segment_sum(data, segment_ids, num_segments, mask=None):
@@ -53,6 +63,7 @@ def gather_mul_segment(x, w, g):
     messages in HBM; otherwise the standard gather + masked segment_sum.
     """
     perm = g.extras.get("edge_perm_sender") if g.extras else None
+    _count("gather_mul", perm is not None)
     if perm is not None:
         from hydragnn_tpu.ops.fused_mp import gather_mul_segment_sum
 
@@ -70,6 +81,7 @@ def gather_segment(x, g):
     real edges — fused-kernel path when available (same dispatch rules as
     :func:`gather_mul_segment`), else gather + masked segment_sum."""
     perm = g.extras.get("edge_perm_sender") if g.extras else None
+    _count("gather_sum", perm is not None)
     if perm is not None:
         from hydragnn_tpu.ops.fused_mp import gather_segment_sum
 
@@ -161,6 +173,7 @@ def sorted_segment_sum(data, segment_ids, num_segments, mask=None,
     are nondecreasing; else the standard masked segment_sum.  Masking
     happens BEFORE the dense scatter — padding rows park on real slots, so
     an unmasked dense scatter would corrupt them."""
+    _count("sorted_sum", bool(sorted_hint))
     if sorted_hint:
         from hydragnn_tpu.ops.fused_mp import segment_sum_dense
 
@@ -180,6 +193,7 @@ def scatter_segment(data, g):
     verified-invariants marker (``edge_perm_sender``), else the masked
     segment_sum.  Always edge-masked — padding edges park on a real node
     slot, so an unmasked dense scatter would corrupt it."""
+    _count("scatter_sum", bool(g.extras and "edge_perm_sender" in g.extras))
     if g.extras and "edge_perm_sender" in g.extras:
         from hydragnn_tpu.ops.fused_mp import segment_sum_dense
 
@@ -198,6 +212,7 @@ def masked_mean_pool(x, node_gid, num_graphs, node_mask, sorted_hint=False):
     verified-invariants marker) routes the sum through the dense-schedule
     sorted scatter kernel — collate's node_gid is nondecreasing by
     construction."""
+    _count("mean_pool", bool(sorted_hint))
     if sorted_hint:
         from hydragnn_tpu.ops.fused_mp import segment_sum_dense
 
@@ -210,6 +225,166 @@ def masked_mean_pool(x, node_gid, num_graphs, node_mask, sorted_hint=False):
 
 def masked_sum_pool(x, node_gid, num_graphs, node_mask):
     return segment_sum(x, node_gid, num_graphs, node_mask)
+
+
+# ---------------------------------------------------------------------------
+# multi-moment (poly) aggregation: sum/sq-derived mean+std, max, min, count
+# in ONE fused pass (ops/poly_mp.py) when the batch carries the collate
+# marker — the PNA-class multi-aggregator archs' hot path
+# ---------------------------------------------------------------------------
+
+def _poly_public_keys():
+    """Public moment vocabulary, DERIVED from the kernel's MOMENT_ORDER
+    (ops/poly_mp.py owns the contract): the combined ``mxmn`` kernel
+    output splits into the ``mx``/``mn`` keys callers consume."""
+    from hydragnn_tpu.ops.poly_mp import MOMENT_ORDER
+
+    keys = []
+    for m in MOMENT_ORDER:
+        keys.extend(("mx", "mn") if m == "mxmn" else (m,))
+    return tuple(keys)
+
+
+def _poly_kernel_moments(moments):
+    from hydragnn_tpu.ops.poly_mp import MOMENT_ORDER
+
+    want = set(moments)
+    unknown = want - set(_poly_public_keys())
+    if unknown or not want:
+        raise ValueError(f"moments must be a nonempty subset of "
+                         f"{_poly_public_keys()}, got {moments!r}")
+    return tuple(
+        m for m in MOMENT_ORDER
+        if m in want or (m == "mxmn" and ("mx" in want or "mn" in want)))
+
+
+def _poly_unpack(kern_moments, outs, moments, f):
+    """Kernel tuple -> {requested key: cleaned array}.  mx/mn get the
+    segment_max/min empty-segment zero-clean (same convention as
+    :func:`segment_max` / :func:`segment_min`)."""
+    res: Dict[str, jax.Array] = {}
+    by = dict(zip(kern_moments, outs))
+    if "sum" in moments:
+        res["sum"] = by["sum"]
+    if "sq" in moments:
+        res["sq"] = by["sq"]
+    if "mx" in moments or "mn" in moments:
+        # clean threshold derives from the KERNEL's empty-segment
+        # sentinel (poly_mp._NEG), not segment.py's _BIG — retuning one
+        # must not silently break the other
+        from hydragnn_tpu.ops.poly_mp import _NEG
+
+        mxmn = by["mxmn"]
+        if "mx" in moments:
+            mx = mxmn[:, :f]
+            res["mx"] = jnp.where(mx <= _NEG * 0.5, 0.0, mx)
+        if "mn" in moments:
+            neg = mxmn[:, f:]
+            res["mn"] = jnp.where(neg <= _NEG * 0.5, 0.0, -neg)
+    if "cnt" in moments:
+        res["cnt"] = by["cnt"]
+    return res
+
+
+def _poly_composed(moments, g, data_fn, sum_fn):
+    """Composed fallback shared by both poly dispatchers: ``data_fn``
+    lazily yields the edge-valued messages (only materialized when a
+    beyond-sum moment needs them), ``sum_fn`` the masked segment sum of
+    the raw inputs (which may itself still ride a fused sum kernel when
+    only the poly WIDTH gate failed)."""
+    res: Dict[str, jax.Array] = {}
+    if "sum" in moments:
+        res["sum"] = sum_fn()
+    data = (data_fn() if ("sq" in moments or "mx" in moments
+                          or "mn" in moments) else None)
+    n = g.num_nodes
+    if "sq" in moments:
+        # scatter_segment re-dispatches like the sum: still the dense
+        # kernel when only the poly width gate failed (data is
+        # edge-valued here in BOTH modes)
+        res["sq"] = scatter_segment(data * data, g)
+    if "mx" in moments or "mn" in moments:
+        f = data.shape[-1]
+        mxmn = segment_max(jnp.concatenate([data, -data], axis=-1),
+                           g.receivers, n, g.edge_mask)
+        if "mx" in moments:
+            res["mx"] = mxmn[:, :f]
+        if "mn" in moments:
+            res["mn"] = -mxmn[:, f:]
+    if "cnt" in moments:
+        res["cnt"] = degree(g.receivers, n, g.edge_mask)
+    return res
+
+
+def _poly_fused_ok(g, f: int, moments) -> bool:
+    from hydragnn_tpu.ops.poly_mp import POLY_MAX_F, POLY_MAX_F_MXMN
+
+    if not (g.extras and "edge_perm_sender" in g.extras):
+        return False
+    limit = (POLY_MAX_F_MXMN if ("mx" in moments or "mn" in moments)
+             else POLY_MAX_F)
+    return f <= limit
+
+
+def poly_scatter_segment(data, g, moments: Sequence[str]):
+    """Multi-moment masked segment reduce of already-edge-valued ``data``
+    [E, F] at receivers: returns a dict with the requested subset of
+
+      sum [N, F], sq [N, F] (sum of squares), mx/mn [N, F] (max/min over
+      REAL edges, 0 on empty nodes — the segment_max/min convention),
+      cnt [N] (real in-edges, == :func:`degree`).
+
+    One fused Pallas pass (ops/poly_mp.py) when the batch carries
+    collate's verified-invariants marker AND F fits the kernel's width
+    gate (POLY_MAX_F_MXMN with mx/mn, POLY_MAX_F otherwise); composed
+    segment ops otherwise.  mean/std are elementwise outside:
+    ``sum / max(cnt, 1)`` and the :func:`segment_std` formula."""
+    kern = _poly_kernel_moments(moments)
+    if kern == ("sum",):
+        # pure sum: scatter_segment's single-moment dense kernel already
+        # does this exact job (and is compiled in the same program for
+        # pooling) — don't trace a second near-identical Pallas kernel
+        return {"sum": scatter_segment(data, g)}
+    f = data.shape[-1]
+    fused = _poly_fused_ok(g, f, moments)
+    _count("poly_scatter", fused)
+    if fused:
+        from hydragnn_tpu.ops.poly_mp import segment_poly_dense
+
+        outs = segment_poly_dense(data, g.receivers, g.num_nodes, kern,
+                                  valid=g.edge_mask)
+        return _poly_unpack(kern, outs, moments, f)
+    # scatter_segment re-dispatches the sum: still the dense kernel when
+    # only the poly width gate failed
+    return _poly_composed(moments, g, lambda: data,
+                          lambda: scatter_segment(data, g))
+
+
+def poly_gather_segment(x, g, moments: Sequence[str]):
+    """Multi-moment reduce of the gathered neighbor messages
+    ``x[senders]`` over REAL edges — same result dict as
+    :func:`poly_scatter_segment`, but the fused path forms the messages
+    in-VMEM (one-hot window gather) so the [E, F] tensor never hits HBM.
+    The SAGE/MFC neighbor aggregation (sum + cnt in one pass replaces the
+    separate neighbor-sum and degree scatters)."""
+    kern = _poly_kernel_moments(moments)
+    if kern == ("sum",):
+        # pure sum: gather_segment's existing fused kernel is this job
+        return {"sum": gather_segment(x, g)}
+    f = x.shape[-1]
+    perm = g.extras.get("edge_perm_sender") if g.extras else None
+    fused = perm is not None and _poly_fused_ok(g, f, moments)
+    _count("poly_gather", fused)
+    if fused:
+        from hydragnn_tpu.ops.poly_mp import gather_poly_segment
+
+        outs = gather_poly_segment(x, g.senders, g.receivers, perm, kern,
+                                   mask=g.edge_mask)
+        return _poly_unpack(kern, outs, moments, f)
+    # gather_segment re-dispatches the sum: a marker-present batch that
+    # only failed the poly WIDTH gate still rides the fused sum kernel
+    return _poly_composed(moments, g, lambda: x[g.senders],
+                          lambda: gather_segment(x, g))
 
 
 def _bcast(mask, data):

@@ -34,9 +34,11 @@ class CGConv(nn.Module):
         z = jnp.concatenate(parts, axis=-1)
         gate = jax.nn.sigmoid(nn.Dense(self.dim, name="lin_f")(z))
         core = jax.nn.softplus(nn.Dense(self.dim, name="lin_s")(z))
-        # dense-schedule sorted scatter when the batch carries the collate
-        # marker (HYDRAGNN_AGGR_BACKEND=fused), else masked segment_sum
-        agg = segment.scatter_segment(gate * core, g)
+        # fused multi-moment scatter (sum moment only) when the batch
+        # carries the collate marker (HYDRAGNN_AGGR_BACKEND=fused), else
+        # masked segment_sum — one dispatcher with the PNA-class archs
+        agg = segment.poly_scatter_segment(
+            gate * core, g, ("sum",))["sum"]
         return x + agg, pos
 
 

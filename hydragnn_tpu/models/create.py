@@ -35,6 +35,11 @@ _STACKS = {
     "EGNN": EGCLStack,
 }
 
+# THE canonical arch list: bench.py's per-arch sweep and the fused-vs-
+# scatter parity tests (tests/test_fused_mp.py) both derive from it, so a
+# newly registered stack cannot miss bench or parity coverage.
+ALL_ARCHS = tuple(_STACKS)
+
 
 def create_model_config(config: Dict[str, Any]) -> Base:
     """Build the (uninitialized) flax module from a finalized config dict."""

@@ -22,7 +22,6 @@ import flax.linen as nn
 
 from hydragnn_tpu.graph import segment
 from hydragnn_tpu.models.base import Base
-from hydragnn_tpu.ops.gat_mp import FUSED_HF_LIMIT
 
 
 def _fused_gat_enabled() -> bool:
@@ -66,8 +65,19 @@ class GATv2Conv(nn.Module):
             train, g.senders.shape[0], n, x.dtype)
 
         perm = g.extras.get("edge_perm_sender") if g.extras else None
-        if (perm is not None and _fused_gat_enabled()
-                and h * f <= FUSED_HF_LIMIT):
+        # width gate is PER HEAD: wider hf = h*f tiles over balanced head
+        # groups inside gat_edge_attention_tiled (attention is head-
+        # independent), so only a single over-wide head forces the
+        # composed path.  Queried live from ops/gat_mp (the module that
+        # owns FUSED_HF_LIMIT) so gate and tiling can never diverge.
+        from hydragnn_tpu.ops.gat_mp import fused_head_width_ok
+
+        fused = (perm is not None and _fused_gat_enabled()
+                 and fused_head_width_ok(f))
+        from hydragnn_tpu.telemetry.pipeline import count_fused_choice
+
+        count_fused_choice("gat_attn", fused)
+        if fused:
             out = self._fused_attention(xl, xr, att, logits, g, perm,
                                         b_edge, b_self)
         else:
@@ -149,8 +159,10 @@ class GATv2Conv(nn.Module):
         merged here in plain jnp.  Numerically the same softmax over
         {incident edges} U {self} as the composed path; the max shifts are
         stop_gradient'd (shift invariance) exactly as there.  Returns
-        [N, h, f] in the compute dtype."""
-        from hydragnn_tpu.ops.gat_mp import gat_edge_attention
+        [N, h, f] in the compute dtype.  Above FUSED_HF_LIMIT the call
+        tiles over balanced head groups (ops/gat_mp.py) — same math, one
+        kernel launch per group."""
+        from hydragnn_tpu.ops.gat_mp import gat_edge_attention_tiled
 
         n = xl.shape[0]
         h, f = self.heads, self.out_dim
@@ -165,7 +177,7 @@ class GATv2Conv(nn.Module):
             b_edge = jnp.ones((e_count, h), jnp.float32)
             b_self = jnp.ones((n, h), jnp.float32)
 
-        acc, m, d = gat_edge_attention(
+        acc, m, d = gat_edge_attention_tiled(
             xl, xr, att_mat, g.senders, g.receivers, perm,
             g.edge_mask, b_edge, (self.negative_slope, f))
         m = jax.lax.stop_gradient(m)

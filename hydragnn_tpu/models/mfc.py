@@ -31,9 +31,12 @@ class MFConv(nn.Module):
         )
         bias = self.param("bias", nn.initializers.zeros, (d, self.out_dim))
 
-        deg = segment.degree(g.receivers, n, g.edge_mask).astype(jnp.int32)
-        deg = jnp.clip(deg, 0, self.max_degree)
-        agg = segment.gather_segment(x, g)
+        # neighbor sum AND degree from ONE fused multi-moment pass when
+        # the batch carries the collate marker (ops/poly_mp.py) — the
+        # separate degree scatter folds into the aggregation kernel
+        res = segment.poly_gather_segment(x, g, ("sum", "cnt"))
+        deg = jnp.clip(res["cnt"].astype(jnp.int32), 0, self.max_degree)
+        agg = res["sum"]
 
         # One wide MXU matmul against ALL degree banks + a row select,
         # instead of gathering a per-node [N, in, out] weight tensor

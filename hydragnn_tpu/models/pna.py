@@ -27,9 +27,7 @@ class PNAConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, g, train):
-        n = x.shape[0]
         f = self.in_dim
-        src, dst = g.senders, g.receivers
 
         # gathers whose backward rides the dense sorted scatter instead of
         # XLA's scatter-add (marker-gated; plain gathers otherwise)
@@ -42,20 +40,22 @@ class PNAConv(nn.Module):
             z = jnp.concatenate([h_dst, h_src], axis=-1)
         msg = nn.Dense(f, name="pre_nn")(z)  # pre_layers=1
 
-        # mean and std share ONE masked sum pair riding the dense-schedule
-        # sorted scatter when available (same numerics as
-        # segment_mean/segment_std: max(deg,1) divide, eps 1e-5); min and
-        # max share ONE scatter-max over [msg, -msg] — XLA expands each
-        # segment max/min into a long sort pipeline, so halving the count
-        # matters (min(x) = -max(-x), same values and gradients)
-        deg = jnp.maximum(segment.degree(dst, n, g.edge_mask), 1.0)[:, None]
-        mean = segment.scatter_segment(msg, g) / deg
-        sq_mean = segment.scatter_segment(msg * msg, g) / deg
+        # ALL FOUR aggregators (mean/std via a sum + sum-of-squares pair,
+        # min/max via a running max of [msg, -msg]) plus the degree come
+        # out of ONE fused multi-moment pass when the batch carries the
+        # collate marker (ops/poly_mp.py) — composed, they cost two
+        # scatter-sums, a double-width segment_max that XLA lowers to a
+        # long sort pipeline, and a separate degree scatter.  Numerics
+        # are the segment_mean/segment_std conventions (max(deg,1)
+        # divide, eps 1e-5); min(x) = -max(-x), same values and grads.
+        res = segment.poly_scatter_segment(
+            msg, g, ("sum", "sq", "mx", "mn", "cnt"))
+        deg = jnp.maximum(res["cnt"], 1.0)[:, None]
+        mean = res["sum"] / deg
+        sq_mean = res["sq"] / deg
         std = jnp.sqrt(jnp.maximum(sq_mean - mean * mean, 0.0) + 1e-5)
-        mxmn = segment.segment_max(
-            jnp.concatenate([msg, -msg], axis=-1), dst, n, g.edge_mask)
-        aggs = [mean, -mxmn[:, f:], mxmn[:, :f], std]
-        agg = jnp.concatenate(aggs, axis=-1)  # [N, 4F]
+        agg = jnp.concatenate(
+            [mean, res["mn"], res["mx"], std], axis=-1)  # [N, 4F]
 
         log_deg = jnp.log(deg + 1.0)
         scaled = jnp.concatenate(

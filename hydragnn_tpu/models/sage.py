@@ -18,9 +18,12 @@ class SAGEConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, g, train):
-        # masked neighbor mean; lowers to the fused Pallas kernel under
-        # HYDRAGNN_AGGR_BACKEND=fused
-        neigh = segment.gather_segment_mean(x, g)
+        # masked neighbor mean: sum AND count from ONE fused multi-moment
+        # pass under HYDRAGNN_AGGR_BACKEND=fused (ops/poly_mp.py) — the
+        # separate degree scatter folds into the aggregation kernel;
+        # _mean_divide = THE empty-segment convention (max(cnt, 1))
+        res = segment.poly_gather_segment(x, g, ("sum", "cnt"))
+        neigh = segment._mean_divide(res["sum"], res["cnt"])
         out = nn.Dense(self.out_dim, name="lin_self")(x) + nn.Dense(
             self.out_dim, use_bias=False, name="lin_neigh"
         )(neigh)

@@ -52,12 +52,31 @@ import jax.numpy as jnp
 _EDGE_BLOCK = 256  # edges per grid step; onehot tile = _EDGE_BLOCK x N_pad
 
 
+# THE backend vocabulary (config validation in run_training.py imports it
+# — one definition, no drift between the two validation points)
+KNOWN_BACKENDS = ("scatter", "onehot", "pallas", "fused")
+_warned_unknown = set()
+
+
 def aggr_backend() -> str:
     """Current backend name.  The env knob is read at TRACE time: a jitted
     caller (every real train/eval step) pins whichever backend was active
     when it was first traced, so set the knob before building the step —
-    flipping it mid-process does not retrace cached executables."""
-    return os.environ.get("HYDRAGNN_AGGR_BACKEND", "scatter").lower()
+    flipping it mid-process does not retrace cached executables.
+
+    An unrecognized env value warns ONCE and behaves as ``scatter``
+    (every backend check misses): a typo like ``fusd`` would otherwise
+    silently lose the whole fused path AND evade the fallback telemetry,
+    which only compares against the exact string ``fused``."""
+    v = os.environ.get("HYDRAGNN_AGGR_BACKEND", "scatter").lower()
+    if v not in KNOWN_BACKENDS and v not in _warned_unknown:
+        _warned_unknown.add(v)
+        import warnings
+
+        warnings.warn(
+            f"HYDRAGNN_AGGR_BACKEND={v!r} is not one of {KNOWN_BACKENDS};"
+            " every aggregation will take the scatter path", stacklevel=2)
+    return v
 
 
 def _round_up(x: int, m: int) -> int:

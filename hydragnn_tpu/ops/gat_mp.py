@@ -52,14 +52,17 @@ from hydragnn_tpu.ops.fused_mp import _dense_schedule
 _NODE_BLOCK = 128
 _EDGE_BLOCK = 512
 
-# Widest flat head-feature width (h*f) the fused kernels compile for: the
-# per-iteration [BE, HF] temporaries and the double-buffered [BN, HF]
+# Widest flat head-feature width (h*f) ONE fused kernel call compiles for:
+# the per-iteration [BE, HF] temporaries and the double-buffered [BN, HF]
 # window blocks scale with HF against the v5e's 16 MB scoped-VMEM budget.
 # Measured on the v5e: hf=768 (34.6 ms/step) and hf=1020 (49.3 ms/step)
 # compile and run at BE=256; hf=1536 (h256 x 6 heads) OOMs at BE=512 AND
 # at BE=128 (the backward's seven double-buffered [BN, HF] node windows
-# alone approach the budget), so above 1024 GATv2Conv falls back to the
-# composed segment-op path (measured working at every width).
+# alone approach the budget).  Wider configs stay fused by TILING over the
+# flat head-feature axis (:func:`gat_edge_attention_tiled`): attention is
+# independent per head, so the heads split into balanced groups of
+# group_hf <= this limit, one kernel call each.  Only a SINGLE head wider
+# than the limit (f > FUSED_HF_LIMIT) still forces the composed path.
 FUSED_HF_LIMIT = 1024
 
 
@@ -613,3 +616,56 @@ def _gea_bwd(slope_f, res, cot):
 
 
 gat_edge_attention.defvjp(_gea_fwd, _gea_bwd)
+
+
+def fused_head_width_ok(f: int) -> bool:
+    """The per-head width gate, reading THIS module's live limit — the
+    dispatcher (models/gat.py) queries it instead of caching an
+    import-time copy, so adjusting FUSED_HF_LIMIT at runtime (tests,
+    smaller-VMEM parts) moves the gate and the tiling together."""
+    return f <= FUSED_HF_LIMIT
+
+
+def _head_groups(h: int, f: int):
+    """Balanced head-group sizes with group_hf = size * f <= FUSED_HF_LIMIT.
+
+    Groups are as equal as possible (6 heads at cap 4 -> [3, 3], not
+    [4, 2]) so same-shaped calls share one compiled kernel."""
+    assert f <= FUSED_HF_LIMIT, "single head exceeds the kernel width cap"
+    gmax = max(1, FUSED_HF_LIMIT // f)
+    n_groups = -(-h // gmax)
+    base, rem = divmod(h, n_groups)
+    return [base + 1] * rem + [base] * (n_groups - rem)
+
+
+def gat_edge_attention_tiled(xl, xr, att_mat, senders, receivers,
+                             sender_perm, edge_mask, b_edge, slope_f):
+    """:func:`gat_edge_attention`, tiled over the flat head-feature axis
+    so hf = h*f > FUSED_HF_LIMIT configs (h256 x 6 heads = 1536, the
+    round-4 VMEM OOM) STAY on the fused path instead of silently
+    reverting to the composed segment ops.  Attention is independent per
+    head, so the heads split into balanced groups of group_hf <= the
+    limit — one kernel call per group over column slices of
+    xl / xr / att_mat / b_edge, outputs concatenated back.  Gradients
+    flow through the slicing (each group's custom VJP applies); the
+    caller's stop_gradient(m) contract is unchanged.  Within the limit
+    this is exactly one untiled call."""
+    slope, f = slope_f
+    h = att_mat.shape[1]
+    if h * f <= FUSED_HF_LIMIT:
+        return gat_edge_attention(xl, xr, att_mat, senders, receivers,
+                                  sender_perm, edge_mask, b_edge, slope_f)
+    accs, ms, ds = [], [], []
+    h0 = 0
+    for size in _head_groups(h, f):
+        h1 = h0 + size
+        cols = slice(h0 * f, h1 * f)
+        acc, m, d = gat_edge_attention(
+            xl[:, cols], xr[:, cols], att_mat[cols, h0:h1], senders,
+            receivers, sender_perm, edge_mask, b_edge[:, h0:h1], slope_f)
+        accs.append(acc)
+        ms.append(m)
+        ds.append(d)
+        h0 = h1
+    return (jnp.concatenate(accs, axis=1), jnp.concatenate(ms, axis=1),
+            jnp.concatenate(ds, axis=1))

@@ -205,12 +205,19 @@ class MetricsLogger:
         self._state_avals = None
         self._flops_cache: Dict[tuple, Optional[float]] = {}
         self._mfu_broken = False
+        self._dispatch_base: Dict[str, int] = {}
         if self.enabled and self.rank == 0:
             self.sinks = build_sinks(
                 self.cfg.sinks, self.out_dir, self.run_id,
                 heartbeat=self.cfg.heartbeat)
         if self.enabled:
             pipeline.set_enabled(True)
+            # dispatch counts are cumulative for the process (trace-time
+            # tally) — remember the baseline so the manifest reports THIS
+            # run's fused/fallback decisions, not a prior HPO trial's
+            self._dispatch_base = pipeline.dispatch_snapshot()
+            from hydragnn_tpu.ops.aggregate import aggr_backend
+
             self._emit({
                 "event": "run_start",
                 "run_id": self.run_id,
@@ -221,6 +228,7 @@ class MetricsLogger:
                 "peak_flops_basis": peak_flops(),
                 "sinks": list(self.cfg.sinks),
                 "sync_steps": self.cfg.sync_steps,
+                "aggr_backend": aggr_backend(),
             })
 
     # -- construction helpers ------------------------------------------------
@@ -453,6 +461,16 @@ class MetricsLogger:
                              "pipeline")}
             if timers is not None:
                 rec["timers"] = timers
+            # fused-vs-fallback dispatch tally (this run's delta over the
+            # process-cumulative trace-time counts): a run that silently
+            # fell off the fast path shows ``<op>:scatter`` entries here
+            # and in tools/teleview.py
+            delta = pipeline.dispatch_delta(
+                self._dispatch_base, pipeline.dispatch_snapshot())
+            if delta:
+                rec["aggr_dispatch"] = delta
+                rec["aggr_dispatch_summary"] = pipeline.dispatch_summary(
+                    delta)
             pipe = pipeline.snapshot(reset=True)
             if pipe:
                 rec["pipeline"] = pipe

@@ -22,6 +22,14 @@ _lock = threading.Lock()
 _counters: Dict[str, float] = {}
 _enabled = False
 
+# fused-vs-fallback dispatch tally — separate from the gated epoch counters:
+# it increments at TRACE time only (once per compiled program, not per
+# step), costs nothing on the hot path, and is therefore ALWAYS on.  It is
+# cumulative for the process lifetime (jit caching means a second run in
+# the same process re-uses traces and would otherwise read zero), so
+# consumers (telemetry manifest, bench per-arch records) snapshot deltas.
+_dispatch: Dict[str, int] = {}
+
 
 def set_enabled(flag: bool) -> None:
     global _enabled
@@ -41,6 +49,55 @@ def add(key: str, value: float = 1.0) -> None:
         return
     with _lock:
         _counters[key] = _counters.get(key, 0.0) + float(value)
+
+
+def count_dispatch(op: str, backend: str) -> None:
+    """Tally one trace-time aggregation-dispatch decision: ``op`` is the
+    dispatch site (gather_mul, poly_scatter, gat_attn, ...), ``backend``
+    is ``fused`` (fast path) or ``scatter`` (fallback).  A run that
+    silently fell off the fast path shows up as ``<op>:scatter`` counts
+    in the end-of-run manifest and in bench's per-arch records."""
+    with _lock:
+        key = f"{op}:{backend}"
+        _dispatch[key] = _dispatch.get(key, 0) + 1
+
+
+def dispatch_snapshot() -> Dict[str, int]:
+    """Current cumulative dispatch tally (process lifetime — see module
+    comment); callers wanting per-phase counts diff two snapshots."""
+    with _lock:
+        return dict(_dispatch)
+
+
+def count_fused_choice(op: str, fused: bool) -> None:
+    """Boolean-flavored :func:`count_dispatch`: THE one mapping from a
+    dispatch decision to the ``fused``/``scatter`` label vocabulary the
+    summary/teleview/bench parsers key on."""
+    count_dispatch(op, "fused" if fused else "scatter")
+
+
+def dispatch_delta(before: Dict[str, int],
+                   after: Dict[str, int]) -> Dict[str, int]:
+    """Positive per-key growth between two :func:`dispatch_snapshot`s —
+    the ONE definition of "this phase's dispatch decisions" (the tally is
+    process-cumulative), shared by the telemetry manifest and bench's
+    per-arch records."""
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v - before.get(k, 0) > 0}
+
+
+def dispatch_summary(counts: Dict[str, int]) -> str:
+    """Compact human layout of a tally (or a delta of two snapshots):
+    ``fused`` / ``scatter`` / ``mixed(fused=N,scatter=M)`` / ``none``."""
+    fused = sum(v for k, v in counts.items() if k.endswith(":fused"))
+    fallback = sum(v for k, v in counts.items() if k.endswith(":scatter"))
+    if fused and not fallback:
+        return "fused"
+    if fallback and not fused:
+        return "scatter"
+    if fused and fallback:
+        return f"mixed(fused={fused},scatter={fallback})"
+    return "none"
 
 
 def batch_nbytes(batch) -> int:

@@ -190,8 +190,15 @@ def test_segment_sum_dense_exact():
         rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize(
-    "model_type", ["GIN", "MFC", "SAGE", "CGCNN", "PNA", "EGNN", "GAT"])
+from hydragnn_tpu.models.create import ALL_ARCHS
+
+# the canonical arch list (shared with bench.py's sweep) minus the two
+# stacks with dedicated parity tests below — a newly registered arch lands
+# in THIS parametrization (and the bench sweep) automatically
+_PARITY_ARCHS = [a for a in ALL_ARCHS if a not in ("SchNet", "DimeNet")]
+
+
+@pytest.mark.parametrize("model_type", _PARITY_ARCHS)
 def test_sum_aggr_models_fused_match_scatter(model_type, monkeypatch):
     from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
     from hydragnn_tpu.models.create import create_model

@@ -229,17 +229,66 @@ def test_model_level_fused_equals_composed(monkeypatch):
                                    rtol=5e-4, atol=5e-4)
 
 
-def test_wide_heads_fall_back_to_composed(monkeypatch):
-    """hf = heads*hidden above FUSED_HF_LIMIT must take the composed path
-    even with the fused gate forced on — the Pallas kernels VMEM-OOM at
-    TPU compile time above the limit (measured: hf=1536 fails at every
-    edge block), so the width gate is what keeps wide-GAT configs
-    RUNNABLE rather than a hard compile error."""
+def test_tiled_matches_untiled(monkeypatch):
+    """gat_edge_attention_tiled with a forced-small FUSED_HF_LIMIT (heads
+    split into groups) must reproduce the one-call kernel: attention is
+    independent per head, so the group slicing changes launches, not
+    math — forward partials AND gradients."""
+    import hydragnn_tpu.ops.gat_mp as gat_mp
+    from hydragnn_tpu.ops.gat_mp import gat_edge_attention_tiled
+
+    g = _batch(seed=13)
+    xl, xr, att, att_mat = _inputs(g, seed=14)
+    b = jnp.ones((g.senders.shape[0], H), jnp.float32)
+    perm = g.extras["edge_perm_sender"]
+
+    ref = gat_edge_attention(xl, xr, att_mat, g.senders, g.receivers,
+                             perm, g.edge_mask, b, (SLOPE, F))
+    assert H * F > 2 * F  # the forced limit below actually splits
+    monkeypatch.setattr(gat_mp, "FUSED_HF_LIMIT", 2 * F)
+    assert gat_mp._head_groups(H, F) == [2, 2]
+    tiled = gat_edge_attention_tiled(
+        xl, xr, att_mat, g.senders, g.receivers, perm, g.edge_mask, b,
+        (SLOPE, F))
+    for a, r, name in zip(tiled, ref, ("acc", "m", "d")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+    def loss_tiled(xl_, xr_, am_):
+        acc, m, d = gat_edge_attention_tiled(
+            xl_, xr_, am_, g.senders, g.receivers, perm, g.edge_mask, b,
+            (SLOPE, F))
+        return _merge_loss(acc, m, d, xl_)
+
+    gt = jax.grad(loss_tiled, argnums=(0, 1, 2))(xl, xr, att_mat)
+    monkeypatch.setattr(gat_mp, "FUSED_HF_LIMIT", 1024)
+    gu = jax.grad(loss_tiled, argnums=(0, 1, 2))(xl, xr, att_mat)
+    for a, r, name in zip(gt[:2], gu[:2], ("dxl", "dxr")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
+    # datt: compare the block-diagonal entries only — the one-call kernel
+    # returns dense cotangents for att_mat's structurally-zero cross-group
+    # entries that the tiled slicing (correctly) never touches, and the
+    # model consumes only the diagonal (see test_fused_gradients_match_
+    # composed)
+    rows = np.arange(H * F)
+    np.testing.assert_allclose(
+        np.asarray(gt[2])[rows, rows // F],
+        np.asarray(gu[2])[rows, rows // F],
+        rtol=2e-3, atol=2e-3, err_msg="datt diagonal")
+
+
+def test_wide_heads_stay_fused_via_head_tiling(monkeypatch):
+    """hf = heads*hidden above FUSED_HF_LIMIT now STAYS on the fused path
+    by tiling over balanced head groups (the pre-tiling behavior was a
+    silent composed-path fallback at h256 x 6 heads — the GAT item of
+    round-5 VERDICT weak-2) and must match the composed path numerically.
+    The limit is monkeypatched small so the tier-1 test exercises the
+    tiled path at toy width."""
+    import hydragnn_tpu.ops.gat_mp as gat_mp
     from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
     from hydragnn_tpu.models.create import create_model
-    from hydragnn_tpu.models.gat import FUSED_HF_LIMIT, GATv2Conv
-
-    assert 6 * 256 > FUSED_HF_LIMIT  # the shape below must exceed the gate
+    from hydragnn_tpu.models.gat import GATv2Conv
 
     calls = []
     orig = GATv2Conv._fused_attention
@@ -250,10 +299,56 @@ def test_wide_heads_fall_back_to_composed(monkeypatch):
 
     monkeypatch.setattr(GATv2Conv, "_fused_attention", spy)
     monkeypatch.setenv("HYDRAGNN_GAT_FUSED", "1")
+    # hidden=8 x 6 heads = hf 48 > 16 = limit -> 3 groups of 2 heads;
+    # f=8 <= 16 keeps the per-head gate satisfied.  ONE patch point:
+    # the dispatcher queries gat_mp's live limit (fused_head_width_ok)
+    monkeypatch.setattr(gat_mp, "FUSED_HF_LIMIT", 16)
 
     g = _batch(seed=11)
     cfg = ModelConfig(
-        model_type="GAT", input_dim=2, hidden_dim=256, output_dim=(1,),
+        model_type="GAT", input_dim=2, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        dropout=0.0)
+    model = create_model(cfg)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        g, train=False)
+    out_fused = model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})}, g, train=False)
+    assert calls, "wide config must stay on the fused (tiled) path"
+    monkeypatch.setenv("HYDRAGNN_GAT_FUSED", "0")
+    out_plain = model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})}, g, train=False)
+    for a, bb in zip(out_fused, out_plain):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_single_over_wide_head_falls_back(monkeypatch):
+    """Only a SINGLE head wider than FUSED_HF_LIMIT still forces the
+    composed path (no group can shrink below one head)."""
+    import hydragnn_tpu.ops.gat_mp as gat_mp
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.models.gat import GATv2Conv
+
+    calls = []
+    orig = GATv2Conv._fused_attention
+
+    def spy(self, *a, **k):
+        calls.append(self.out_dim)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(GATv2Conv, "_fused_attention", spy)
+    monkeypatch.setenv("HYDRAGNN_GAT_FUSED", "1")
+    monkeypatch.setattr(gat_mp, "FUSED_HF_LIMIT", 4)  # < f = 8
+
+    g = _batch(seed=12)
+    cfg = ModelConfig(
+        model_type="GAT", input_dim=2, hidden_dim=8, output_dim=(1,),
         output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
         node_head=None, task_weights=(1.0,), num_conv_layers=2,
         dropout=0.0)

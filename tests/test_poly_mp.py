@@ -1,0 +1,287 @@
+"""Fused multi-aggregator kernel (ops/poly_mp.py): forward and gradient
+parity vs the composed XLA path — f32, masked/padded edges, multi-graph
+batches, tie handling — plus the graph/segment.py dispatchers' fallback
+equivalence and the trace-time dispatch tally.  Interpret mode on CPU,
+same collate invariants as production.  (Model-level parity for every
+routed arch — PNA, MFC, CGCNN, SAGE — lives in tests/test_fused_mp.py's
+canonical-arch-list parametrization, which exercises this kernel under
+HYDRAGNN_AGGR_BACKEND=fused.)"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hydragnn_tpu.graph import segment
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.ops.poly_mp import (
+    gather_poly_segment,
+    segment_poly_dense,
+)
+
+_BIG = 1e9
+ALL_MOMENTS = ("sum", "sq", "mxmn", "cnt")
+
+
+def _batch(n_graphs=24, max_nodes=16, seed=0, max_neigh=10):
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n_graphs):
+        n = int(rng.randint(3, max_nodes + 1))
+        pos = rng.rand(n, 3).astype(np.float32) * 2.5
+        x = rng.rand(n, 2).astype(np.float32)
+        ei = radius_graph(pos, 1.4, max_neigh)
+        samples.append(GraphSample(x=x, pos=pos, edge_index=ei,
+                                   graph_y=np.ones(1, np.float32), node_y=x))
+    pad = PadSpec.for_batch(n_graphs, max_nodes, max_nodes * max_neigh)
+    return collate(samples, pad, [HeadSpec("e", "graph", 1)])
+
+
+def _edge_data(b, f=48, seed=1, quantize=False):
+    rng = np.random.RandomState(seed)
+    e = b.senders.shape[0]
+    data = rng.randn(e, f).astype(np.float32)
+    if quantize:
+        # coarse grid -> deliberate within-segment ties, exercising the
+        # even tie-split of the max/min gradient
+        data = np.round(data * 2.0) / 2.0
+    return jnp.asarray(data)
+
+
+def _refs(data, ids, mask, n):
+    """Composed-path moments with the production masking conventions."""
+    dm = data * mask[:, None]
+    cat = jnp.concatenate([data, -data], axis=1)
+    cat = jnp.where(mask[:, None] > 0, cat, -_BIG)
+    mxmn = jax.ops.segment_max(cat, ids, num_segments=n)
+    return {
+        "sum": jax.ops.segment_sum(dm, ids, num_segments=n),
+        "sq": jax.ops.segment_sum(dm * dm, ids, num_segments=n),
+        "mxmn": mxmn,
+        "cnt": jax.ops.segment_sum(mask, ids, num_segments=n),
+    }
+
+
+def test_scatter_forward_all_moments():
+    b = _batch()
+    data = _edge_data(b)
+    ids, mask = jnp.asarray(b.receivers), jnp.asarray(b.edge_mask)
+    n = b.x.shape[0]
+    outs = segment_poly_dense(data, ids, n, ALL_MOMENTS, valid=mask)
+    ref = _refs(data, ids, mask, n)
+    np.testing.assert_allclose(outs[0], ref["sum"], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[1], ref["sq"], rtol=1e-5, atol=1e-5)
+    # empty segments: kernel yields -1e9, XLA's masked max too (both
+    # pre-clean) — compare after the common clamp
+    np.testing.assert_allclose(
+        jnp.where(outs[2] <= -_BIG * 0.5, -_BIG, outs[2]),
+        jnp.where(ref["mxmn"] <= -_BIG * 0.5, -_BIG, ref["mxmn"]),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[3], ref["cnt"], rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("quantize", [False, True],
+                         ids=["distinct", "with-ties"])
+def test_scatter_gradients_match_composed(quantize):
+    """d(sum)/d(sq)/d(max)/d(min) vs the composed twin, including the
+    even tie split jax.ops.segment_max's VJP applies."""
+    b = _batch(seed=2)
+    data = _edge_data(b, seed=3, quantize=quantize)
+    ids, mask = jnp.asarray(b.receivers), jnp.asarray(b.edge_mask)
+    n = b.x.shape[0]
+    f = data.shape[1]
+
+    def loss_fused(d):
+        s, q, mxmn, cnt = segment_poly_dense(d, ids, n, ALL_MOMENTS,
+                                             valid=mask)
+        mx = jnp.where(mxmn[:, :f] <= -_BIG * 0.5, 0.0, mxmn[:, :f])
+        mn = jnp.where(mxmn[:, f:] <= -_BIG * 0.5, 0.0, -mxmn[:, f:])
+        return (jnp.sum(s ** 2) + 0.5 * jnp.sum(q ** 2)
+                + jnp.sum(mx ** 2) + jnp.sum(mn ** 3) + jnp.sum(cnt))
+
+    def loss_ref(d):
+        r = _refs(d, ids, mask, n)
+        mm = jnp.where(r["mxmn"] <= -_BIG * 0.5, 0.0, r["mxmn"])
+        return (jnp.sum(r["sum"] ** 2) + 0.5 * jnp.sum(r["sq"] ** 2)
+                + jnp.sum(mm[:, :f] ** 2) + jnp.sum((-mm[:, f:]) ** 3)
+                + jnp.sum(r["cnt"]))
+
+    g1 = jax.grad(loss_fused)(data)
+    g2 = jax.grad(loss_ref)(data)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+    # masked edges must carry EXACTLY zero gradient
+    m = np.asarray(b.edge_mask)
+    assert np.all(np.asarray(g1)[m == 0] == 0.0)
+
+
+def test_gather_forward_and_gradients():
+    """Gather mode (messages formed in-VMEM): all moments of x[senders]
+    over real edges, fwd + dx vs the materialized composed twin."""
+    b = _batch(seed=7)
+    rng = np.random.RandomState(8)
+    n = b.x.shape[0]
+    f = 40
+    x = jnp.asarray(rng.rand(n, f), jnp.float32)
+    s, r = jnp.asarray(b.senders), jnp.asarray(b.receivers)
+    mask = jnp.asarray(b.edge_mask)
+    perm = jnp.asarray(np.argsort(np.asarray(b.senders), kind="stable"),
+                       jnp.int32)
+
+    outs = gather_poly_segment(x, s, r, perm, ALL_MOMENTS, mask=mask)
+    ref = _refs(x[s], r, mask, n)
+    np.testing.assert_allclose(outs[0], ref["sum"], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[1], ref["sq"], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        jnp.where(outs[2] <= -_BIG * 0.5, -_BIG, outs[2]),
+        jnp.where(ref["mxmn"] <= -_BIG * 0.5, -_BIG, ref["mxmn"]),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[3], ref["cnt"], rtol=1e-6, atol=1e-6)
+
+    def loss_fused(x_):
+        su, q, mxmn, cnt = gather_poly_segment(x_, s, r, perm, ALL_MOMENTS,
+                                               mask=mask)
+        mx = jnp.where(mxmn[:, :f] <= -_BIG * 0.5, 0.0, mxmn[:, :f])
+        mn = jnp.where(mxmn[:, f:] <= -_BIG * 0.5, 0.0, -mxmn[:, f:])
+        return (jnp.sum(su ** 2) + 0.5 * jnp.sum(q ** 2)
+                + jnp.sum(mx ** 2) + jnp.sum(mn ** 3))
+
+    def loss_ref(x_):
+        rr = _refs(x_[s], r, mask, n)
+        mm = jnp.where(rr["mxmn"] <= -_BIG * 0.5, 0.0, rr["mxmn"])
+        return (jnp.sum(rr["sum"] ** 2) + 0.5 * jnp.sum(rr["sq"] ** 2)
+                + jnp.sum(mm[:, :f] ** 2) + jnp.sum((-mm[:, f:]) ** 3))
+
+    g1 = jax.grad(loss_fused)(x)
+    g2 = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_gather_sum_cnt_only():
+    """The SAGE/MFC moment set (sum + cnt): forward and the one-pass
+    fused backward (no [E, F] intermediate) vs the composed twin."""
+    b = _batch(seed=9)
+    rng = np.random.RandomState(10)
+    n = b.x.shape[0]
+    x = jnp.asarray(rng.rand(n, 32), jnp.float32)
+    s, r = jnp.asarray(b.senders), jnp.asarray(b.receivers)
+    mask = jnp.asarray(b.edge_mask)
+    perm = jnp.asarray(np.argsort(np.asarray(b.senders), kind="stable"),
+                       jnp.int32)
+
+    su, cnt = gather_poly_segment(x, s, r, perm, ("sum", "cnt"), mask=mask)
+    np.testing.assert_allclose(
+        su, jax.ops.segment_sum(x[s] * mask[:, None], r, num_segments=n),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        cnt, jax.ops.segment_sum(mask, r, num_segments=n),
+        rtol=1e-6, atol=1e-6)
+    # the neighbor-MEAN composition SAGE uses (max(cnt,1) divide)
+    mean = su / jnp.maximum(cnt, 1.0)[:, None]
+    np.testing.assert_allclose(
+        mean, np.asarray(segment.gather_segment_mean(x, b)),
+        rtol=1e-5, atol=1e-5)
+
+    g1 = jax.grad(lambda x_: jnp.sum(gather_poly_segment(
+        x_, s, r, perm, ("sum", "cnt"), mask=mask)[0] ** 2))(x)
+    g2 = jax.grad(lambda x_: jnp.sum(jax.ops.segment_sum(
+        x_[s] * mask[:, None], r, num_segments=n) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_all_masked_segment_yields_zero_moments():
+    """A node with NO real in-edges (every slot masked) must read 0 for
+    every cleaned moment — the segment_mean/max/min empty conventions."""
+    b = _batch(seed=11)
+    e = b.senders.shape[0]
+    data = _edge_data(b, seed=12) + 5.0   # strictly positive: a leaked
+    ids = jnp.asarray(b.receivers)        # masked max would be visibly > 0
+    n = b.x.shape[0]
+    mask = jnp.zeros((e,), jnp.float32)   # EVERYTHING masked
+    s, q, mxmn, cnt = segment_poly_dense(data, ids, n, ALL_MOMENTS,
+                                         valid=mask)
+    assert np.all(np.asarray(s) == 0.0)
+    assert np.all(np.asarray(q) == 0.0)
+    assert np.all(np.asarray(cnt) == 0.0)
+    f = data.shape[1]
+    mx = jnp.where(mxmn[:, :f] <= -_BIG * 0.5, 0.0, mxmn[:, :f])
+    mn = jnp.where(mxmn[:, f:] <= -_BIG * 0.5, 0.0, -mxmn[:, f:])
+    assert np.all(np.asarray(mx) == 0.0)
+    assert np.all(np.asarray(mn) == 0.0)
+
+
+def test_dispatcher_fused_matches_fallback(monkeypatch):
+    """poly_scatter_segment / poly_gather_segment: the fused dict (marker
+    present) must equal the composed dict (marker stripped), including
+    the mx/mn empty-segment zero-clean and cnt == degree."""
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    b = _batch(seed=13)
+    assert "edge_perm_sender" in b.extras
+    ex = dict(b.extras)
+    del ex["edge_perm_sender"]
+    b_plain = b.replace(extras=ex)
+
+    data = _edge_data(b, seed=14)
+    moments = ("sum", "sq", "mx", "mn", "cnt")
+    rf = segment.poly_scatter_segment(data, b, moments)
+    rp = segment.poly_scatter_segment(data, b_plain, moments)
+    for k in moments:
+        np.testing.assert_allclose(np.asarray(rf[k]), np.asarray(rp[k]),
+                                   rtol=1e-5, atol=1e-5, err_msg=k)
+
+    rng = np.random.RandomState(15)
+    x = jnp.asarray(rng.rand(b.x.shape[0], 24), jnp.float32)
+    gf = segment.poly_gather_segment(x, b, moments)
+    gp = segment.poly_gather_segment(x, b_plain, moments)
+    for k in moments:
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(gp[k]),
+                                   rtol=1e-5, atol=1e-5, err_msg=k)
+
+
+def test_dispatch_tally_counts_fused_and_fallback(monkeypatch):
+    """The trace-time dispatch tally: a marker-carrying batch counts
+    :fused, a marker-less one :scatter, and the width gate falls back
+    (the silent-fast-path-loss signal the telemetry manifest surfaces)."""
+    from hydragnn_tpu.ops.poly_mp import POLY_MAX_F_MXMN
+    from hydragnn_tpu.telemetry import pipeline
+
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "fused")
+    b = _batch(seed=16)
+    data = _edge_data(b, seed=17, f=16)
+
+    base = pipeline.dispatch_snapshot()
+    segment.poly_scatter_segment(data, b, ("sum", "mx"))
+    d1 = pipeline.dispatch_snapshot()
+    assert d1.get("poly_scatter:fused", 0) \
+        == base.get("poly_scatter:fused", 0) + 1
+
+    ex = dict(b.extras)
+    del ex["edge_perm_sender"]
+    segment.poly_scatter_segment(data, b.replace(extras=ex), ("sum", "mx"))
+    d2 = pipeline.dispatch_snapshot()
+    assert d2.get("poly_scatter:scatter", 0) \
+        == d1.get("poly_scatter:scatter", 0) + 1
+
+    # width gate: F above the mxmn cap must take the composed path even
+    # with the marker present — and still be numerically right
+    wide = jnp.asarray(
+        np.random.RandomState(18).rand(b.senders.shape[0],
+                                       POLY_MAX_F_MXMN + 1), jnp.float32)
+    out = segment.poly_scatter_segment(wide, b, ("sum", "mx"))
+    d3 = pipeline.dispatch_snapshot()
+    assert d3.get("poly_scatter:scatter", 0) \
+        == d2.get("poly_scatter:scatter", 0) + 1
+    np.testing.assert_allclose(
+        np.asarray(out["sum"]),
+        np.asarray(jax.ops.segment_sum(
+            wide * jnp.asarray(b.edge_mask)[:, None],
+            jnp.asarray(b.receivers), num_segments=b.x.shape[0])),
+        rtol=1e-5, atol=1e-5)
+
+    assert pipeline.dispatch_summary(
+        {"poly_scatter:fused": 2}) == "fused"
+    assert pipeline.dispatch_summary(
+        {"a:fused": 1, "b:scatter": 2}) == "mixed(fused=1,scatter=2)"

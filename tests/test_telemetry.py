@@ -291,6 +291,14 @@ def test_training_smoke_emits_full_jsonl(tmp_path, capsys):
     # manifest folds the TimerTracer summaries in
     assert "train" in manifests[-1]["timers"]
     assert manifests[-1]["total_steps"] == steps[-1]["step"]
+    # ... and the fused-vs-fallback dispatch tally (trace-time counts of
+    # this run's aggregation dispatch decisions; scatter backend here, so
+    # every entry is a :scatter fallback)
+    disp = manifests[-1]["aggr_dispatch"]
+    assert disp and all(k.endswith(":scatter") for k in disp)
+    assert manifests[-1]["aggr_dispatch_summary"] == "scatter"
+    run_starts = [r for r in recs if r["event"] == "run_start"]
+    assert run_starts[-1]["aggr_backend"] == "scatter"
     # epoch record carries loader padding + pipeline accounting
     assert "padding_waste_pct" in epochs[0]
 
@@ -302,6 +310,7 @@ def test_training_smoke_emits_full_jsonl(tmp_path, capsys):
     assert teleview.main([out_dir, "--tail", "4"]) == 0
     rendered = capsys.readouterr().out
     assert "mfu%" in rendered and "epochs:" in rendered
+    assert "aggr dispatch:" in rendered
 
 
 def test_disabled_logger_writes_nothing(tmp_path):

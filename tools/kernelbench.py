@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""kernelbench: isolated aggregator microbenchmark — one command reproduces
+the docs/PERF.md segment-reduce numbers.
+
+Compares, at the documented sweep shapes, the backends the dispatchers in
+graph/segment.py choose between:
+
+  scatter   jax.ops.segment_sum (XLA sort/scatter path)
+  onehot    one-hot x messages MXU matmul (ops/aggregate.py)
+  pallas    blocked one-hot Pallas contraction (ops/aggregate.py)
+  dense     sorted dense-schedule scatter (ops/fused_mp.segment_sum_dense)
+  poly      fused multi-moment pass (ops/poly_mp.segment_poly_dense)
+
+Two moment sets:
+
+  sum       plain segment sum — every backend
+  pna       the PNA aggregator set (sum + sum-of-squares + max/min +
+            degree): composed (2 scatter-sums + double-width segment_max +
+            degree scatter) vs the ONE fused poly pass — the number behind
+            the PNA end-to-end claim.
+
+Methodology matches bench.py: each measurement jits a fori_loop of
+``--inner`` serially-dependent applications (the loop carry feeds a hair of
+each output back into the input, so nothing is hoisted or DCE'd and the
+~20 ms tunneled-PJRT dispatch overhead amortizes away), takes best-of-
+``--repeats``, and forces completion with a host fetch (block_until_ready
+returns at dispatch on tunneled runtimes — bench.py's _sync rationale).
+
+On CPU the Pallas backends run in INTERPRET mode (minutes per call), so
+they are skipped unless --force-pallas; the XLA backends still run, which
+makes the tool usable as a smoke test anywhere.
+
+Usage:
+  python tools/kernelbench.py                     # all shapes, fwd+bwd
+  python tools/kernelbench.py --shapes small --moments pna --no-grad
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable from anywhere: the repo root owns the hydragnn_tpu package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+_BIG = 1e9
+
+# the documented sweep shapes (docs/PERF.md: the isolated segment_sum
+# measurement set and the flagship collate shape with degree <= 20)
+SHAPES = {
+    "tiny": dict(num_edges=2048, num_nodes=512, feat=32, max_deg=8),
+    "small": dict(num_edges=32768, num_nodes=2560, feat=64, max_deg=16),
+    "flagship": dict(num_edges=81920, num_nodes=10240, feat=64, max_deg=20),
+}
+
+
+def _make_problem(num_edges, num_nodes, feat, max_deg, seed=0):
+    """Sorted-receiver edge structure with ~7% masked tail (the padding
+    edges a bucketed loader ships), degree capped at max_deg.  The degree
+    draw's lower bound is sized so the expected total OVERFILLS the edge
+    array, then truncates — every shape gets the same ~93% fill instead
+    of whatever randint(1, max_deg) happens to produce."""
+    rng = np.random.RandomState(seed)
+    e_real = int(num_edges * 0.93)
+    avg_needed = num_edges / num_nodes
+    lo = max(1, min(max_deg, int(np.ceil(2 * 0.95 * avg_needed)) - max_deg))
+    deg = rng.randint(lo, max_deg + 1, num_nodes)
+    ids = np.repeat(np.arange(num_nodes, dtype=np.int32), deg)
+    e_real = min(e_real, ids.shape[0])
+    receivers = np.full(num_edges, num_nodes - 1, np.int32)  # padding on
+    receivers[:e_real] = ids[:e_real]                        # N-1, like
+    mask = np.zeros(num_edges, np.float32)                   # collate
+    mask[:e_real] = 1.0
+    data = rng.randn(num_edges, feat).astype(np.float32)
+    assert e_real >= int(num_edges * 0.9), (
+        f"degree draw under-filled the shape: {e_real}/{num_edges}")
+    return receivers, mask, data
+
+
+def _sync(x):
+    np.asarray(x).reshape(-1)[:1]
+
+
+def _time_chain(fn, data, inner, repeats):
+    """Best-of-N seconds per application of ``fn`` inside one compiled
+    serially-dependent fori_loop (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def leaf_of(outs):
+        if isinstance(outs, (tuple, list)):
+            outs = outs[0]
+        return outs.reshape(-1)[0]
+
+    @jax.jit
+    def run(d, s0):
+        def body(_, carry):
+            d, s = carry
+            out = fn(d)
+            s = s + leaf_of(out) * 1e-20
+            return d + s * 1e-30, s
+        return lax.fori_loop(0, inner, body, (d, s0))
+
+    d0 = data
+    out = run(d0, jnp.float32(0.0))
+    _sync(out[1])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = run(d0, jnp.float32(0.0))
+        _sync(out[1])
+        best = min(best, time.perf_counter() - t0)
+    return best / inner
+
+
+def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas):
+    """{name: data -> output} for the requested moment set."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.aggregate import (
+        segment_sum_onehot, segment_sum_pallas)
+    from hydragnn_tpu.ops.fused_mp import segment_sum_dense
+    from hydragnn_tpu.ops.poly_mp import segment_poly_dense
+
+    r = jnp.asarray(receivers)
+    m = jnp.asarray(mask)
+    n = num_nodes
+    run_pallas = on_tpu or force_pallas
+
+    if moments == "sum":
+        out = {
+            "scatter": lambda d: jax.ops.segment_sum(
+                d * m[:, None], r, num_segments=n),
+            "onehot": lambda d: segment_sum_onehot(d * m[:, None], r, n),
+        }
+        if run_pallas:
+            out["pallas"] = lambda d: segment_sum_pallas(
+                d * m[:, None], r, n)
+            out["dense"] = lambda d: segment_sum_dense(
+                d * m[:, None], r, n, valid=m)
+            out["poly"] = lambda d: segment_poly_dense(
+                d, r, n, ("sum",), valid=m)
+        return out
+
+    # pna: [sum, sq, max/min, degree] — composed vs one fused pass
+    def composed(d):
+        s = jax.ops.segment_sum(d * m[:, None], r, num_segments=n)
+        q = jax.ops.segment_sum((d * d) * m[:, None], r, num_segments=n)
+        cat = jnp.where(m[:, None] > 0,
+                        jnp.concatenate([d, -d], axis=1), -_BIG)
+        mxmn = jax.ops.segment_max(cat, r, num_segments=n)
+        mxmn = jnp.where(mxmn <= -_BIG * 0.5, 0.0, mxmn)
+        cnt = jax.ops.segment_sum(m, r, num_segments=n)
+        return s, q, mxmn, cnt
+
+    def dense_composed(d):
+        # what PNA's composed path ACTUALLY ran under the r05 fused
+        # backend (graph/segment.py scatter_segment routed the two sums
+        # through the dense-schedule kernel; only max/min and degree
+        # stayed XLA) — the honest pre-poly twin for the speedup claim
+        dm = d * m[:, None]
+        s = segment_sum_dense(dm, r, n, valid=m)
+        q = segment_sum_dense(dm * d, r, n, valid=m)
+        cat = jnp.where(m[:, None] > 0,
+                        jnp.concatenate([d, -d], axis=1), -_BIG)
+        mxmn = jax.ops.segment_max(cat, r, num_segments=n)
+        mxmn = jnp.where(mxmn <= -_BIG * 0.5, 0.0, mxmn)
+        cnt = jax.ops.segment_sum(m, r, num_segments=n)
+        return s, q, mxmn, cnt
+
+    out = {"scatter": composed}
+    if run_pallas:
+        out["dense-composed"] = dense_composed
+        out["poly"] = lambda d: segment_poly_dense(
+            d, r, n, ("sum", "sq", "mxmn", "cnt"), valid=m)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--shapes", default="small,flagship",
+                    help=f"comma list from {sorted(SHAPES)}")
+    ap.add_argument("--moments", default="sum,pna",
+                    help="comma list from sum,pna")
+    ap.add_argument("--inner", type=int, default=20,
+                    help="op applications per compiled loop (default 20)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="best-of repeats (default 3)")
+    ap.add_argument("--no-grad", action="store_true",
+                    help="skip the fwd+bwd rows")
+    ap.add_argument("--force-pallas", action="store_true",
+                    help="run Pallas backends even off-TPU (interpret "
+                         "mode: MINUTES per measurement)")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == "tpu"
+    print(f"kernelbench: backend={jax.default_backend()} "
+          f"inner={args.inner} repeats={args.repeats}")
+    if not on_tpu and not args.force_pallas:
+        print("kernelbench: off-TPU — Pallas backends skipped "
+              "(--force-pallas to run them in interpret mode)")
+
+    results = {}
+    for shape_name in [s for s in args.shapes.split(",") if s]:
+        spec = SHAPES[shape_name]
+        receivers, mask, data = _make_problem(**spec)
+        data = jnp.asarray(data)
+        for moments in [m for m in args.moments.split(",") if m]:
+            fns = _backends(moments, receivers, mask, spec["num_nodes"],
+                            on_tpu, args.force_pallas)
+            for name, fn in fns.items():
+                key = f"{shape_name}/{moments}/{name}"
+                try:
+                    fwd_s = _time_chain(fn, data, args.inner, args.repeats)
+                    row = {"fwd_ms": round(fwd_s * 1e3, 4)}
+                    if not args.no_grad:
+                        def loss(d, fn=fn):
+                            out = fn(d)
+                            if not isinstance(out, (tuple, list)):
+                                out = (out,)
+                            return sum(jnp.sum(o.astype(jnp.float32) ** 2)
+                                       for o in out)
+                        g = jax.grad(loss)
+                        bwd_s = _time_chain(g, data, args.inner,
+                                            args.repeats)
+                        row["fwdbwd_ms"] = round(bwd_s * 1e3, 4)
+                    results[key] = row
+                    print(f"  {key:34s} " + "  ".join(
+                        f"{k}={v}" for k, v in row.items()))
+                except Exception as e:  # noqa: BLE001 — keep sweeping
+                    results[key] = {"error": repr(e)[:120]}
+                    print(f"  {key:34s} FAILED {e!r}")
+    print(json.dumps({"kernelbench": results}, separators=(",", ":")))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

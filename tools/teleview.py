@@ -143,6 +143,33 @@ def main(argv=None) -> int:
         if agg:
             print(f"  mfu_est_pct (ring window): avg {agg['avg']:.3g}  "
                   f"min {agg['min']:.3g}  max {agg['max']:.3g}")
+        disp = m.get("aggr_dispatch") or {}
+        if disp:
+            fused = sum(v for k, v in disp.items() if k.endswith(":fused"))
+            fallback = sum(v for k, v in disp.items()
+                           if k.endswith(":scatter"))
+            summary = m.get("aggr_dispatch_summary", "")
+            print(f"  aggr dispatch: {int(fused)} fused / {int(fallback)} "
+                  f"scatter-fallback ({summary})")
+            fell = sorted(k for k in disp if k.endswith(":scatter"))
+            # the silent-fallback signal this tally exists for: warn on
+            # ANY :scatter entry when the run either asked for the fused
+            # backend (run_start records it) or did reach it elsewhere —
+            # a run that fell ENTIRELY off the fast path is the worst
+            # case, not an exempt one
+            # match the run_start belonging to THIS manifest (append-mode
+            # JSONL can hold several runs; a prior fused run must not
+            # make a deliberate scatter run warn)
+            starts = [r for r in records if r.get("event") == "run_start"
+                      and r.get("run_id") == m.get("run_id")]
+            if not starts:
+                starts = [r for r in records
+                          if r.get("event") == "run_start"][-1:]
+            want_fused = any(r.get("aggr_backend") == "fused"
+                             for r in starts)
+            if fell and (fused or want_fused):
+                print("  WARNING fell off the fast path: "
+                      + ", ".join(f"{k}={disp[k]}" for k in fell))
         timers = m.get("timers") or {}
         for name, s in sorted(timers.items()):
             print(f"  timer {name}: {s.get('total_s', 0.0):.3f}s "
