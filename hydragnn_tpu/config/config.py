@@ -169,6 +169,13 @@ def finalize(
     config.setdefault("Telemetry", {})
     for k, v in _telemetry_defaults().items():
         config["Telemetry"].setdefault(k, v)
+    # resilience knobs live in Training (they steer the trainer's step
+    # builders and epoch driver); same defaults-written-back contract, env
+    # knobs overlay at ResilienceConfig.from_training (docs/RESILIENCE.md)
+    from hydragnn_tpu.resilience.config import resilience_training_defaults
+
+    for k, v in resilience_training_defaults().items():
+        training.setdefault(k, v)
     return config
 
 

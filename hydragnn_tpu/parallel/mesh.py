@@ -246,6 +246,7 @@ def make_dp_train_step(
     zero_axis: Optional[str] = None,
     steps: int = 1,
     telemetry_metrics: bool = False,
+    nonfinite_guard: bool = False,
 ):
     """jit'd DP train step over stacked batches [D, ...].
 
@@ -265,6 +266,12 @@ def make_dp_train_step(
     so the ZeRO all_gather stays on ICI) — each device updates only its
     slice of params/moments and the new params are all_gather-ed (ZeRO-1,
     reference optimizer.py:43-103).
+
+    ``nonfinite_guard`` adds the in-jit NaN/Inf step guard
+    (resilience/guards.py).  The flag is derived AFTER the gradient pmean,
+    so a non-finite shard on any device poisons the replicated check and
+    every replica skips the same update — replicas can never diverge on a
+    bad batch.  Default OFF: traces the exact pre-guard program.
     """
     import optax
 
@@ -370,6 +377,18 @@ def make_dp_train_step(
                 tele["update_norm"] = jnp.sqrt(jax.lax.psum(
                     jnp.square(tree_l2_norm(updates)), zero_axis))
             metrics.update(tele)
+        if nonfinite_guard:
+            from hydragnn_tpu.resilience.guards import (
+                apply_step_guard,
+                nonfinite_flag,
+            )
+
+            # grads are already pmean'd (replicated) and loss psum'd, so
+            # `bad` is identical on every replica; the selects revert the
+            # sharded (ZeRO) opt-state slices and replicated params alike
+            bad = nonfinite_flag(loss, grads)
+            new_state, metrics = apply_step_guard(
+                bad, state, new_state, metrics)
         return new_state, metrics
 
     opt_spec_tree = P() if zero_specs is None else zero_specs

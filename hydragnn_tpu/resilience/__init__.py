@@ -1,0 +1,55 @@
+"""Fault-tolerant training subsystem.
+
+Long multi-host runs on preemptible queues (the reference HydraGNN targets
+Summit/Frontier SLURM/LSF allocations) fail in three characteristic ways,
+and this package makes each one survivable AND testable:
+
+  1. a single non-finite batch silently corrupts params forever —
+     :mod:`~hydragnn_tpu.resilience.guards` adds an in-jit skip-the-update
+     guard to all three step paths (local jit, scanned-K, mesh-DP
+     shard_map) plus a host-side monitor that aborts with a diagnostic
+     dump after N consecutive bad steps;
+  2. a preemption (SIGTERM) or walltime expiry loses everything since the
+     last epoch-granular checkpoint — :mod:`~hydragnn_tpu.resilience.preempt`
+     turns the signal into a batch-boundary stop with multi-host agreement,
+     and :mod:`~hydragnn_tpu.resilience.resume` saves/loads a full resume
+     bundle (train state + epoch index + step-within-epoch + scheduler /
+     early-stop / best-checkpoint state + history + LR) so ``continue``
+     resumes bit-identically instead of restarting at epoch 0;
+  3. flaky checkpoint filesystems abort runs —
+     :mod:`~hydragnn_tpu.resilience.ckpt_io` gives every checkpoint write
+     retry-with-backoff, atomic finalize, and warn-and-keep-training
+     degradation.
+
+:mod:`~hydragnn_tpu.resilience.chaos` is the fault-injection harness the
+crash-and-resume tests are built on (NaN batches at step k, simulated
+preemption at step k, checkpoint I/O failures) — gated by
+``HYDRAGNN_CHAOS_*`` env knobs or a ``Training.Chaos`` config section,
+inert otherwise.
+
+Health events (``step_skipped``, ``preempt_save``, ``resume_from``,
+``ckpt_retry``, ...) flow through the telemetry spine
+(:meth:`MetricsLogger.health`) into the JSONL event log and manifest; see
+docs/RESILIENCE.md for knobs and invariants.
+"""
+
+from hydragnn_tpu.resilience.config import ResilienceConfig  # noqa: F401
+from hydragnn_tpu.resilience.chaos import Chaos  # noqa: F401
+from hydragnn_tpu.resilience.ckpt_io import (  # noqa: F401
+    atomic_write_json,
+    atomic_write_pickle,
+    with_retries,
+)
+from hydragnn_tpu.resilience.guards import (  # noqa: F401
+    NonFiniteGuardMonitor,
+    NonFiniteTrainingError,
+    apply_step_guard,
+    nonfinite_flag,
+)
+from hydragnn_tpu.resilience.preempt import PreemptionHandler  # noqa: F401
+from hydragnn_tpu.resilience.resume import (  # noqa: F401
+    clear_resume_bundle,
+    load_resume_bundle,
+    resume_dir,
+    save_resume_bundle,
+)

@@ -1,0 +1,132 @@
+"""Chaos harness: deterministic fault injection for resilience testing.
+
+Failure handling that is never exercised is broken by the time it matters,
+so the crash-and-resume tier-1 tests (tests/test_resilience.py) and
+``tools/crashtest.py`` drive the real production code paths through
+injected faults:
+
+  - **NaN batches**: at train dispatch k the batch's node features are
+    replaced with NaN, which poisons loss and gradients — exactly what a
+    corrupt sample or an overflowed bf16 activation does — and must be
+    absorbed by the in-jit non-finite guard;
+  - **simulated preemption**: at train dispatch k the preemption handler's
+    flag is raised as if SIGTERM had arrived, triggering the
+    resume-bundle save at the next batch boundary;
+  - **checkpoint I/O failures**: the first n checkpoint write attempts
+    raise OSError, exercising the retry/backoff/degradation ladder in
+    ckpt_io.with_retries.
+
+Gating: env knobs (below) overlay an optional ``Training.Chaos`` config
+dict; with nothing armed :meth:`Chaos.from_env` returns None and the
+trainer threads no chaos object at all — zero production overhead.
+
+Env knobs (dispatch indices are 1-based over EXECUTED train dispatches,
+counted across epochs; a scanned-K dispatch counts once):
+
+  HYDRAGNN_CHAOS_NAN_STEP      "4" | "4,9" | "4+"  (single, list, or
+                               every dispatch from 4 on)
+  HYDRAGNN_CHAOS_PREEMPT_STEP  "7"  — request preemption after dispatch 7
+  HYDRAGNN_CHAOS_CKPT_FAILS    "2"  — fail the first 2 ckpt attempts
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Set, Tuple
+
+
+def _parse_nan_spec(spec: str) -> Tuple[Set[int], Optional[int]]:
+    """'4' / '4,9' / '4+' -> (explicit steps, every-step-from or None)."""
+    steps: Set[int] = set()
+    frm: Optional[int] = None
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.endswith("+"):
+            k = int(part[:-1])
+            frm = k if frm is None else min(frm, k)
+        else:
+            steps.add(int(part))
+    return steps, frm
+
+
+class Chaos:
+    """Per-run fault injector; all counters are instance state so an HPO
+    loop's next trial starts clean."""
+
+    def __init__(self, nan_steps: Set[int] = frozenset(),
+                 nan_from: Optional[int] = None,
+                 preempt_step: Optional[int] = None,
+                 ckpt_fails: int = 0):
+        self.nan_steps = set(nan_steps)
+        self.nan_from = nan_from
+        self.preempt_step = preempt_step
+        self.ckpt_fails = int(ckpt_fails)
+        self._dispatch = 0
+        self._ckpt_fails_left = self.ckpt_fails
+        self._preempt_fired = False
+        self.injected_nan = 0
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_env(cls, section: Optional[Dict[str, Any]] = None
+                 ) -> Optional["Chaos"]:
+        """Build from the optional ``Training.Chaos`` config dict overlaid
+        by HYDRAGNN_CHAOS_* env knobs (env wins); None when nothing armed."""
+        s = dict(section or {})
+        nan_spec = os.environ.get("HYDRAGNN_CHAOS_NAN_STEP",
+                                  str(s.get("nan_step", "") or ""))
+        preempt = os.environ.get("HYDRAGNN_CHAOS_PREEMPT_STEP",
+                                 str(s.get("preempt_step", "") or ""))
+        fails = os.environ.get("HYDRAGNN_CHAOS_CKPT_FAILS",
+                               str(s.get("ckpt_fails", "") or ""))
+        nan_steps, nan_from = _parse_nan_spec(nan_spec) if nan_spec else (
+            set(), None)
+        preempt_step = int(preempt) if preempt else None
+        ckpt_fails = int(fails) if fails else 0
+        if not nan_steps and nan_from is None and preempt_step is None \
+                and ckpt_fails <= 0:
+            return None
+        return cls(nan_steps, nan_from, preempt_step, ckpt_fails)
+
+    # -- injection points ----------------------------------------------------
+
+    def _nan_now(self) -> bool:
+        if self._dispatch in self.nan_steps:
+            return True
+        return self.nan_from is not None and self._dispatch >= self.nan_from
+
+    def on_train_dispatch(self, g):
+        """Count one executed train dispatch; corrupt the batch if armed.
+
+        The whole node-feature tensor goes NaN (works for plain [N, F],
+        device-stacked [D, N, F] and scan-chunked [K, D, N, F] batches) —
+        the forward then produces a NaN loss and NaN grads on every
+        device, the worst case the guard must absorb.
+        """
+        self._dispatch += 1
+        if self._nan_now():
+            import jax.numpy as jnp
+
+            self.injected_nan += 1
+            g = g.replace(x=jnp.full(g.x.shape, jnp.nan, dtype=g.x.dtype))
+        return g
+
+    def preempt_now(self) -> bool:
+        """True exactly once, after the armed dispatch has executed."""
+        if (self.preempt_step is not None and not self._preempt_fired
+                and self._dispatch >= self.preempt_step):
+            self._preempt_fired = True
+            return True
+        return False
+
+    def ckpt_attempt(self) -> None:
+        """Raise while injected checkpoint failures remain."""
+        if self._ckpt_fails_left > 0:
+            self._ckpt_fails_left -= 1
+            raise OSError(
+                f"chaos: injected checkpoint I/O failure "
+                f"({self.ckpt_fails - self._ckpt_fails_left}/"
+                f"{self.ckpt_fails})")

@@ -1,0 +1,126 @@
+"""Robust checkpoint I/O: retry-with-backoff, atomic finalize, degradation.
+
+Checkpoint filesystems on shared HPC machines fail transiently (quota
+flaps, metadata-server hiccups, stale NFS handles).  A training run must
+never die because a *checkpoint* write failed — the run IS the valuable
+thing — so every checkpoint path routes through :func:`with_retries`:
+bounded exponential backoff, a ``ckpt_retry`` health event per failed
+attempt, and ``on_fail="warn"`` degradation that logs ``ckpt_giveup`` and
+keeps training.
+
+Atomicity: a crash mid-write must never corrupt the previous good file.
+:func:`atomic_write_json` / :func:`atomic_write_pickle` write to a
+same-directory temp file and ``os.replace`` it over the target (POSIX
+rename atomicity); readers see either the old or the new bytes, never a
+torn write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import warnings
+from typing import Any, Callable, Optional
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    *,
+    retries: int = 3,
+    backoff: float = 0.5,
+    what: str = "checkpoint",
+    telemetry=None,
+    chaos=None,
+    on_fail: str = "raise",
+    cross_rank: bool = False,
+) -> bool:
+    """Run a checkpoint-write callable with retry/backoff; True on success.
+
+    ``retries`` is the number of RE-tries (retries=3 -> up to 4 attempts);
+    backoff doubles per attempt, capped at 30 s.  ``telemetry`` (a
+    MetricsLogger or None) receives a ``ckpt_retry`` health event per
+    failure and ``ckpt_giveup`` on exhaustion.  ``chaos`` (a Chaos or
+    None) lets the fault-injection harness fail attempts deterministically.
+    ``on_fail="warn"`` degrades gracefully — warn and return False so the
+    caller keeps training; ``"raise"`` re-raises the last error.
+
+    ``cross_rank=True`` is for callables that are cross-process
+    COLLECTIVES (the orbax save every rank must enter together): real
+    filesystem flakes are per-node, so one rank re-entering the save
+    while the others have moved on would mismatch collectives and hang.
+    Instead: ONE attempt per rank, then a host allreduce agrees on the
+    outcome — any rank's failure makes EVERY rank report failure (and
+    degrade identically); no per-rank retry.
+    """
+    retries = max(0, int(retries))
+    if cross_rank:
+        retries = 0
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        failed = False
+        try:
+            if chaos is not None:
+                chaos.ckpt_attempt()
+            fn()
+        except Exception as e:  # noqa: BLE001 — any I/O failure is retryable
+            last = e
+            failed = True
+            if telemetry is not None:
+                telemetry.health("ckpt_retry", what=what,
+                                 attempt=attempt + 1, error=str(e)[:200])
+        if cross_rank:
+            import numpy as np
+
+            from hydragnn_tpu.parallel.comm import host_allreduce
+
+            any_failed = host_allreduce(
+                np.asarray([1.0 if failed else 0.0]), "max")[0] > 0.5
+            if any_failed and not failed:
+                last = RuntimeError(
+                    f"{what}: another rank's attempt failed")
+                failed = True
+        if not failed:
+            return True
+        if attempt < retries and backoff > 0:
+            time.sleep(min(backoff * (2 ** attempt), 30.0))
+    if on_fail == "warn":
+        warnings.warn(
+            f"{what} failed after {retries + 1} attempt(s) — continuing "
+            f"WITHOUT it: {last!r}", stacklevel=2)
+        if telemetry is not None:
+            telemetry.health("ckpt_giveup", what=what,
+                             error=str(last)[:200])
+        return False
+    assert last is not None
+    raise last
+
+
+def _atomic_replace(path: str, write_fn: Callable[[Any], None],
+                    mode: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    """Write JSON via temp-file + ``os.replace`` (crash-safe finalize)."""
+    _atomic_replace(path, lambda f: json.dump(obj, f, indent=2), "w")
+
+
+def atomic_write_pickle(path: str, payload: Any) -> None:
+    """Pickle via temp-file + ``os.replace`` (crash-safe finalize)."""
+    _atomic_replace(path, lambda f: pickle.dump(payload, f), "wb")

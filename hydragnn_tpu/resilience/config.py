@@ -1,0 +1,92 @@
+"""Resilience knobs: ``Training`` config section keys + env overrides.
+
+Same layering as telemetry (telemetry/logger.py:TelemetryConfig): the
+dataclass is the single default source, config.finalize writes the defaults
+back into the saved config.json, and a user-set ``HYDRAGNN_*`` env knob wins
+over the config so a queued job can be hardened without a config edit.
+
+The non-finite guard is OFF by default: with the flag unset the jitted step
+programs are byte-identical to a pre-resilience build (the guard's
+isfinite/select ops are never traced), so bench numbers and the HLO-bytes
+accounting see zero cost.  Preemption handling is ON by default — it only
+reacts to SIGTERM/SIGINT and costs one flag check per batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from hydragnn_tpu.utils.env import env_flag, env_int
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Parsed resilience knobs (``Training`` section + env, env wins).
+
+    Env knobs: HYDRAGNN_NONFINITE_GUARD, HYDRAGNN_GUARD_MAX_BAD,
+    HYDRAGNN_GUARD_POLL, HYDRAGNN_PREEMPT, HYDRAGNN_PREEMPT_SYNC,
+    HYDRAGNN_CKPT_RETRIES, HYDRAGNN_CKPT_BACKOFF.
+    """
+
+    nonfinite_guard: bool = False
+    guard_max_consecutive: int = 5
+    guard_poll_every: int = 8
+    preemption: bool = True
+    preempt_sync_every: int = 8
+    ckpt_retries: int = 3
+    ckpt_backoff: float = 0.5
+
+    @classmethod
+    def from_training(cls, training: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        s = dict(training or {})
+        d = cls()
+        cfg = cls(
+            nonfinite_guard=bool(int(s.get("nonfinite_guard",
+                                           d.nonfinite_guard))),
+            guard_max_consecutive=int(s.get("guard_max_consecutive",
+                                            d.guard_max_consecutive)),
+            guard_poll_every=int(s.get("guard_poll_every",
+                                       d.guard_poll_every)),
+            preemption=bool(int(s.get("preemption", d.preemption))),
+            preempt_sync_every=int(s.get("preempt_sync_every",
+                                         d.preempt_sync_every)),
+            ckpt_retries=int(s.get("ckpt_retries", d.ckpt_retries)),
+            ckpt_backoff=float(s.get("ckpt_backoff", d.ckpt_backoff)),
+        )
+        if "HYDRAGNN_NONFINITE_GUARD" in os.environ:
+            cfg.nonfinite_guard = env_flag("HYDRAGNN_NONFINITE_GUARD")
+        if "HYDRAGNN_GUARD_MAX_BAD" in os.environ:
+            cfg.guard_max_consecutive = env_int("HYDRAGNN_GUARD_MAX_BAD",
+                                                d.guard_max_consecutive)
+        if "HYDRAGNN_GUARD_POLL" in os.environ:
+            cfg.guard_poll_every = env_int("HYDRAGNN_GUARD_POLL",
+                                           d.guard_poll_every)
+        if "HYDRAGNN_PREEMPT" in os.environ:
+            cfg.preemption = env_flag("HYDRAGNN_PREEMPT")
+        if "HYDRAGNN_PREEMPT_SYNC" in os.environ:
+            cfg.preempt_sync_every = env_int("HYDRAGNN_PREEMPT_SYNC",
+                                             d.preempt_sync_every)
+        if "HYDRAGNN_CKPT_RETRIES" in os.environ:
+            cfg.ckpt_retries = env_int("HYDRAGNN_CKPT_RETRIES",
+                                       d.ckpt_retries)
+        if "HYDRAGNN_CKPT_BACKOFF" in os.environ:
+            cfg.ckpt_backoff = float(
+                os.environ.get("HYDRAGNN_CKPT_BACKOFF") or d.ckpt_backoff)
+        return cfg
+
+
+def resilience_training_defaults() -> Dict[str, Any]:
+    """``Training``-section defaults written back by config.finalize, so a
+    saved config.json documents the run's fault-tolerance settings."""
+    d = ResilienceConfig()
+    return {
+        "nonfinite_guard": int(d.nonfinite_guard),
+        "guard_max_consecutive": d.guard_max_consecutive,
+        "guard_poll_every": d.guard_poll_every,
+        "preemption": int(d.preemption),
+        "preempt_sync_every": d.preempt_sync_every,
+        "ckpt_retries": d.ckpt_retries,
+        "ckpt_backoff": d.ckpt_backoff,
+    }

@@ -1,0 +1,191 @@
+"""In-jit non-finite step guards + the host-side abort monitor.
+
+One NaN batch poisons gradients, the optimizer moments, and then the
+params — permanently, because every later update mixes the NaN moments
+back in.  The guard makes the jitted step itself atomic: detect a
+non-finite loss or gradient INSIDE the step and keep the old params, old
+optimizer state and old batch statistics (a ``jnp.where`` select per
+leaf), so a bad batch costs one skipped update instead of the run.
+
+Detection is one f32 reduction: the global sum of squared gradients is
+finite iff every gradient element is finite (any NaN/Inf propagates
+through the sum), checked together with the loss scalar.  An exploding
+step whose squared-sum overflows f32 (global grad norm > ~1e19) is also
+caught — at that magnitude the update is garbage anyway.
+
+The guard is a trace-time flag: OFF (default) traces exactly the
+pre-resilience program — zero HLO change, zero cost.  ON adds the
+reduction + selects, and a ``skipped`` metric (1.0 when the update was
+suppressed; under scan-K the merged metric is the COUNT of skipped steps
+in the dispatch).  ``loss``/``task_i`` are zeroed and ``num_graphs`` is
+zeroed on skipped steps so epoch accumulators and graph-weighted scan
+merges exclude them instead of averaging a NaN in.
+
+Host side, :class:`NonFiniteGuardMonitor` rides the same zero-sync
+contract as telemetry: it buffers the device ``skipped`` scalars and
+fetches them in one ``device_get`` every ``poll_every`` dispatches (and at
+epoch end).  After ``max_consecutive`` consecutive skipped steps it writes
+a diagnostic dump (offending bucket shape, recent loss/grad-norm history)
+and raises :class:`NonFiniteTrainingError` — a run whose every step is bad
+must fail loudly, not spin.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class NonFiniteTrainingError(RuntimeError):
+    """Raised after ``max_consecutive`` consecutive non-finite steps."""
+
+
+def nonfinite_flag(loss, grads) -> jax.Array:
+    """Scalar bool: True when the loss or ANY gradient element is
+    non-finite (computed in-jit; one tree-wide f32 reduction)."""
+    gsq = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            gsq = gsq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return ~(jnp.isfinite(loss) & jnp.isfinite(gsq))
+
+
+def apply_step_guard(bad, old_state, new_state, metrics: Dict[str, Any]
+                     ) -> Tuple[Any, Dict[str, Any]]:
+    """Select old-vs-new train state on ``bad`` and sanitize the metrics.
+
+    The step counter still advances on a skipped step (it counts
+    ATTEMPTED steps; the per-step dropout fold-in stays aligned with the
+    batch sequence).  Params, optimizer state and batch statistics all
+    revert — a NaN optimizer moment would poison every later update even
+    with clean gradients.
+    """
+    def sel(new, old):
+        return jnp.where(bad, old, new)
+
+    guarded = new_state.replace(
+        params=jax.tree.map(sel, new_state.params, old_state.params),
+        batch_stats=jax.tree.map(sel, new_state.batch_stats,
+                                 old_state.batch_stats),
+        opt_state=jax.tree.map(sel, new_state.opt_state,
+                               old_state.opt_state),
+    )
+    m = dict(metrics)
+    zero = jnp.zeros((), jnp.float32)
+    m["loss"] = jnp.where(bad, zero, metrics["loss"])
+    for k in metrics:
+        if k.startswith("task_"):
+            m[k] = jnp.where(bad, zero, metrics[k])
+    # the telemetry norms are NaN on a bad step (computed from the raw
+    # grads/updates before the guard); zero them or the graph-weighted
+    # scan merge NaN-poisons the whole dispatch's norms (NaN * 0 = NaN)
+    for k in ("grad_norm", "param_norm", "update_norm"):
+        if k in metrics:
+            m[k] = jnp.where(bad, zero, metrics[k])
+    m["num_graphs"] = jnp.where(
+        bad, jnp.zeros_like(metrics["num_graphs"]), metrics["num_graphs"])
+    m["skipped"] = bad.astype(jnp.float32)
+    return guarded, m
+
+
+class NonFiniteGuardMonitor:
+    """Zero-sync host monitor over the guard's ``skipped`` step metric.
+
+    ``on_step`` buffers device scalars (no fetch); every ``poll_every``
+    dispatches — and on :meth:`flush` at epoch end — ONE ``device_get``
+    drains the buffer.  Consecutive-bad accounting under scan-K uses the
+    merged per-dispatch count: K skipped of K extends the streak, a
+    partial count restarts it at that count (the clean step broke the
+    streak; the skipped steps are assumed trailing — conservative, since
+    an all-bad stream still aborts within one dispatch of the threshold).
+    """
+
+    def __init__(self, max_consecutive: int = 5, poll_every: int = 8,
+                 steps_per_item: int = 1, dump_path: Optional[str] = None,
+                 telemetry=None, history: int = 64):
+        self.max_consecutive = max(1, int(max_consecutive))
+        self.poll_every = max(1, int(poll_every))
+        self.steps_per_item = max(1, int(steps_per_item))
+        self.dump_path = dump_path
+        self.telemetry = telemetry
+        self.total_skipped = 0
+        self._consec = 0
+        self._dispatch = 0
+        self._pending: List[tuple] = []
+        self._hist: collections.deque = collections.deque(
+            maxlen=max(8, int(history)))
+
+    @staticmethod
+    def _batch_sig(batch) -> Dict[str, List[int]]:
+        return {
+            "x": [int(d) for d in batch.x.shape],
+            "senders": [int(d) for d in batch.senders.shape],
+            "graph_mask": [int(d) for d in batch.graph_mask.shape],
+        }
+
+    def on_step(self, metrics: Dict[str, Any], batch) -> None:
+        if "skipped" not in metrics:
+            return
+        self._dispatch += 1
+        self._pending.append((metrics["skipped"], metrics["loss"],
+                              metrics.get("grad_norm"),
+                              self._batch_sig(batch), self._dispatch))
+        if len(self._pending) >= self.poll_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """Fetch buffered flags; raises NonFiniteTrainingError on abort."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        fetched = jax.device_get([(s, l, g) for s, l, g, _, _ in pending])
+        for (_, _, _, sig, idx), (s, l, g) in zip(pending, fetched):
+            nskip = int(round(float(s)))
+            self._hist.append({
+                "dispatch": idx,
+                "skipped": nskip,
+                "loss": float(l),
+                "grad_norm": None if g is None else float(g),
+                "batch_shape": sig,
+            })
+            if nskip >= self.steps_per_item:
+                self._consec += nskip
+            elif nskip > 0:
+                self._consec = nskip
+            else:
+                self._consec = 0
+            self.total_skipped += nskip
+            if self._consec >= self.max_consecutive:
+                self._abort(sig)
+
+    def _abort(self, sig: Dict[str, List[int]]) -> None:
+        dump = {
+            "reason": "non-finite loss/gradients",
+            "consecutive_bad_steps": self._consec,
+            "max_consecutive": self.max_consecutive,
+            "total_skipped": self.total_skipped,
+            "offending_batch_shape": sig,
+            "history": list(self._hist),
+        }
+        where = ""
+        if self.dump_path:
+            from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
+
+            try:
+                atomic_write_json(self.dump_path, dump)
+                where = f"; diagnostic dump: {self.dump_path}"
+            except OSError:
+                where = "; diagnostic dump FAILED to write"
+        if self.telemetry is not None:
+            self.telemetry.health(
+                "nonfinite_abort", consecutive=self._consec,
+                total_skipped=self.total_skipped, batch_shape=sig)
+        raise NonFiniteTrainingError(
+            f"{self._consec} consecutive non-finite training steps "
+            f"(threshold {self.max_consecutive}) — params are intact (all "
+            f"bad updates were skipped in-jit) but the input stream or "
+            f"the model is producing NaN/Inf{where}")

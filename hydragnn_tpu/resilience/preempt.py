@@ -1,0 +1,104 @@
+"""Preemption-aware stopping: SIGTERM/SIGINT -> batch-boundary stop flag
+with multi-host agreement.
+
+SLURM/LSF preemption sends SIGTERM with a grace window; a KeyboardInterrupt
+mid-``device_get`` corrupts nothing but loses everything since the last
+checkpoint.  The handler converts the signal into a flag that the trainer
+polls at train-batch boundaries — the only safe point to stop: the last
+dispatched step's state is complete, no collective is half-entered.
+
+Multi-host runs must agree on WHICH step to stop at (every rank saves the
+same resume bundle step, and the loaders iterate in lockstep — one rank
+breaking early would deadlock the others' collectives).  Agreement rides a
+host allreduce-max of the local flags every ``sync_every`` polls — the
+same deterministic poll indices on every rank — so a signal delivered to
+any subset of ranks stops all of them together within ``sync_every``
+batches.  Single-process runs stop at the next batch boundary.
+
+A second SIGINT restores default behavior and raises KeyboardInterrupt —
+the operator's escape hatch when a graceful stop hangs.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class PreemptionHandler:
+    """Install with :meth:`install`, poll at batch boundaries, always
+    :meth:`uninstall` (the trainer does both under try/finally)."""
+
+    def __init__(self, sync_every: int = 8, cross_rank: bool = False):
+        self.sync_every = max(1, int(sync_every))
+        self.cross_rank = bool(cross_rank)
+        self.signum: Optional[int] = None
+        self.stop_requested = False
+        # loader items consumed in the epoch when the stop fired (set by
+        # the trainer's batch loop; the resume bundle's step-within-epoch)
+        self.consumed = 0
+        self._flag = False
+        self._polls = 0
+        self._saved: Optional[Dict[int, object]] = None
+
+    # -- signal plumbing -----------------------------------------------------
+
+    def install(self) -> "PreemptionHandler":
+        try:
+            self._saved = {
+                s: signal.signal(s, self._on_signal)
+                for s in (signal.SIGTERM, signal.SIGINT)
+            }
+        except ValueError:
+            # not the main thread (HPO worker): signals can't be hooked
+            # here; chaos/request() still drive the flag
+            self._saved = None
+        return self
+
+    def uninstall(self) -> None:
+        if self._saved:
+            for s, old in self._saved.items():
+                try:
+                    signal.signal(s, old)
+                except ValueError:
+                    pass
+        self._saved = None
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._flag and signum == signal.SIGINT:
+            # second Ctrl-C: the operator wants OUT, not another graceful lap
+            self.uninstall()
+            raise KeyboardInterrupt
+        self._flag = True
+        self.signum = signum
+
+    def request(self) -> None:
+        """Raise the stop flag programmatically (chaos-injected preemption
+        uses this; semantics identical to a delivered SIGTERM)."""
+        self._flag = True
+
+    # -- polling -------------------------------------------------------------
+
+    def poll(self, force: bool = False) -> bool:
+        """One batch-boundary check; True once the stop is agreed.
+
+        Single-process: the local flag decides immediately.  Multi-host
+        (``cross_rank``): ranks allreduce-max their flags every
+        ``sync_every`` polls (or on ``force`` — the per-epoch boundary
+        check, called by every rank).  Between sync points a locally-set
+        flag is NOT acted on, keeping ranks in lockstep.
+        """
+        if self.stop_requested:
+            return True
+        self._polls += 1
+        if not self.cross_rank:
+            self.stop_requested = self._flag
+        elif force or self._polls % self.sync_every == 0:
+            from hydragnn_tpu.parallel.comm import host_allreduce
+
+            agreed = host_allreduce(
+                np.asarray([1.0 if self._flag else 0.0]), "max")[0]
+            self.stop_requested = bool(agreed > 0.5)
+        return self.stop_requested

@@ -1,0 +1,148 @@
+"""Resume bundles: full train state + host control state, mid-run precise.
+
+The pre-resilience ``continue`` path restored params (orbax or the
+best-model pickle) but restarted the epoch loop, the LR-plateau scheduler,
+the early stopper and the shuffle order from zero — a resumed run was a
+DIFFERENT run.  A resume bundle captures everything the epoch driver
+needs to continue bit-identically:
+
+  - the full TrainState (step counter, params, batch stats, opt state)
+    as an orbax checkpoint under ``<dir>/state``;
+  - ``resume_meta.json``: epoch index, items consumed within the epoch
+    (dispatch units of the final wrapped train loader), scheduler /
+    early-stop / best-checkpoint tracker state, loss history, LR, and the
+    pipeline shape (steps-per-dispatch, mesh/local path) the counters
+    were measured in.
+
+Write ordering is the crash-safety argument: the state checkpoint is
+finalized FIRST (with retry/backoff through ckpt_io), the meta json is
+atomically replaced LAST, and load verifies meta.saved_step against the
+orbax latest step — a bundle interrupted mid-save is detected and
+ignored (the caller falls back to the epoch-granular checkpoints) rather
+than half-restored.  RNG state needs no extra capture: dropout folds the
+step counter (saved in state) and the per-epoch shuffle folds
+``seed + epoch`` (saved in meta), so replaying ``set_epoch(epoch)`` and
+skipping the first ``items_consumed`` units reproduces the exact batch
+stream with no sample double-seen.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json, with_retries
+
+META_NAME = "resume_meta.json"
+STATE_DIRNAME = "state"
+
+
+def resume_dir(logs_dir: str, log_name: str) -> str:
+    return os.path.join(logs_dir, log_name, "resume")
+
+
+def save_resume_bundle(
+    state,
+    meta: Dict[str, Any],
+    directory: str,
+    *,
+    rank: int = 0,
+    retries: int = 3,
+    backoff: float = 0.5,
+    telemetry=None,
+    chaos=None,
+    reason: str = "preempt",
+    cross_rank: bool = False,
+) -> bool:
+    """Save state (all ranks — orbax is a collective) then meta (rank 0).
+
+    Returns False (after warning + ``ckpt_giveup`` health event) when the
+    filesystem keeps failing — the caller keeps shutting down/training;
+    degradation must not turn a preemption into a crash.  ``cross_rank``
+    makes multi-host runs agree on the save outcome instead of retrying
+    per-rank (see ckpt_io.with_retries).
+    """
+    import jax
+
+    from hydragnn_tpu.utils.checkpoint import latest_step, save_checkpoint
+
+    meta = dict(meta)
+    meta["saved_step"] = int(jax.device_get(state.step))
+    meta["reason"] = reason
+    sdir = os.path.join(directory, STATE_DIRNAME)
+
+    if latest_step(sdir) == meta["saved_step"]:
+        # a run resumed and preempted again before any optimizer step
+        # re-saves the same step: the train state is IDENTICAL (params,
+        # opt state and batch stats only change with the step counter),
+        # so the existing checkpoint is reused and only the meta is
+        # rewritten — never delete-then-rewrite the one good copy
+        ok = True
+    else:
+        def _save_state():
+            save_checkpoint(state, sdir, step=meta["saved_step"])
+
+        ok = with_retries(
+            _save_state, retries=retries, backoff=backoff,
+            what=f"resume-bundle state ({reason})", telemetry=telemetry,
+            chaos=chaos, on_fail="warn", cross_rank=cross_rank)
+    if not ok:
+        return False
+    if rank != 0:
+        return True
+    # meta LAST: its presence (and step match) is what marks the bundle
+    # valid, so a crash between the two writes leaves no torn bundle
+    assert latest_step(sdir) == meta["saved_step"]
+    return with_retries(
+        lambda: atomic_write_json(os.path.join(directory, META_NAME), meta),
+        retries=retries, backoff=backoff,
+        what=f"resume-bundle meta ({reason})", telemetry=telemetry,
+        on_fail="warn")
+
+
+def load_resume_bundle(state_skeleton, directory: str
+                       ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+    """(restored state, meta) or None when no valid bundle exists.
+
+    Inconsistent bundles (unreadable meta, meta step != checkpoint step —
+    i.e. a save that died between the two writes) warn and return None so
+    the caller falls back to the ordinary checkpoints.
+    """
+    meta_path = os.path.join(directory, META_NAME)
+    if not os.path.exists(meta_path):
+        return None
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        warnings.warn(f"unreadable resume bundle meta {meta_path}: {e}",
+                      stacklevel=2)
+        return None
+    from hydragnn_tpu.utils.checkpoint import latest_step, restore_checkpoint
+
+    sdir = os.path.join(directory, STATE_DIRNAME)
+    step = latest_step(sdir)
+    if step is None or int(meta.get("saved_step", -1)) != int(step):
+        warnings.warn(
+            f"resume bundle {directory} is inconsistent (meta step "
+            f"{meta.get('saved_step')} vs checkpoint {step}); ignoring it",
+            stacklevel=2)
+        return None
+    state = restore_checkpoint(state_skeleton, sdir, step=int(step))
+    return state, meta
+
+
+def clear_resume_bundle(directory: str, rank: int = 0) -> None:
+    """Remove a CONSUMED bundle after the run completes normally — a stale
+    bundle would make the next ``continue`` rewind to mid-run."""
+    from hydragnn_tpu.utils.checkpoint import close_manager
+
+    # EVERY rank drops its cached manager (rank 0 is about to delete the
+    # directory out from under the others); only rank 0 touches the files
+    close_manager(os.path.join(directory, STATE_DIRNAME))
+    if rank != 0 or not os.path.isdir(directory):
+        return
+    shutil.rmtree(directory, ignore_errors=True)

@@ -108,19 +108,32 @@ def _run_training_dict(config: dict, logs_dir: str, seed: int):
     state = create_train_state(model, example, opt_spec, seed=seed)
 
     # warm start (reference load_existing_model_config, utils/model.py:81-84).
-    # An orbax full-state checkpoint (step counter + opt state included) is
-    # preferred over the best-model pickle when one exists.
+    # Restore preference order: (1) a resume bundle from a preempted /
+    # walltime-stopped run — full train state PLUS epoch index,
+    # step-within-epoch and scheduler/early-stop state, so the run
+    # continues mid-epoch bit-identically (resilience/resume.py); (2) an
+    # orbax full-state checkpoint (step counter + opt state included);
+    # (3) the best-model pickle.
     training = config["NeuralNetwork"]["Training"]
+    resume_meta = None
+    consumed_resume_dir = None
     if training.get("continue", 0):
+        from hydragnn_tpu.resilience import load_resume_bundle, resume_dir
         from hydragnn_tpu.train.trainer import load_state
         from hydragnn_tpu.utils.checkpoint import latest_step, restore_checkpoint
 
         start_from = training.get("startfrom", log_name)
-        orbax_dir = os.path.join(logs_dir, start_from, "orbax")
-        if latest_step(orbax_dir) is not None:
-            state = restore_checkpoint(state, orbax_dir)
+        rdir = resume_dir(logs_dir, start_from)
+        bundle = load_resume_bundle(state, rdir)
+        if bundle is not None:
+            state, resume_meta = bundle
+            consumed_resume_dir = rdir
         else:
-            state = load_state(state, start_from, logs_dir)
+            orbax_dir = os.path.join(logs_dir, start_from, "orbax")
+            if latest_step(orbax_dir) is not None:
+                state = restore_checkpoint(state, orbax_dir)
+            else:
+                state = load_state(state, start_from, logs_dir)
 
     writer = None
     if rank == 0:
@@ -161,7 +174,16 @@ def _run_training_dict(config: dict, logs_dir: str, seed: int):
         logs_dir=logs_dir,
         profile_config=config.get("Profile"),
         telemetry=telemetry,
+        resume_meta=resume_meta,
     )
+
+    # the consumed bundle is cleared only after a NORMAL completion — if
+    # this run was itself preempted, the trainer wrote a fresh bundle
+    # (possibly into the same directory) that the next `continue` needs
+    if consumed_resume_dir and not history.get("preempted"):
+        from hydragnn_tpu.resilience import clear_resume_bundle
+
+        clear_resume_bundle(consumed_resume_dir, rank=rank)
 
     save_state(state, log_name, logs_dir, rank=rank)
     tr.print_timers(verbosity)

@@ -206,6 +206,9 @@ class MetricsLogger:
         self._flops_cache: Dict[tuple, Optional[float]] = {}
         self._mfu_broken = False
         self._dispatch_base: Dict[str, int] = {}
+        # resilience health-event tally (step_skipped, preempt_save,
+        # resume_from, ckpt_retry, ...) — folded into the manifest
+        self._health_counts: Dict[str, int] = {}
         if self.enabled and self.rank == 0:
             self.sinks = build_sinks(
                 self.cfg.sinks, self.out_dir, self.run_id,
@@ -274,6 +277,36 @@ class MetricsLogger:
         except Exception:  # noqa: BLE001 — MFU is best-effort
             self._state_avals = None
             self._mfu_broken = True
+
+    # -- resilience health events --------------------------------------------
+
+    def health(self, kind: str, **fields) -> None:
+        """Record one resilience health event (docs/TELEMETRY.md schema):
+        counted always (the manifest's ``health`` tally is how tests and
+        teleview see a disabled-sink run's events too), emitted to the
+        sinks when any exist.  ``count=`` in fields bumps the tally by more
+        than one (e.g. K skipped steps in one scanned dispatch)."""
+        n = int(fields.pop("count", 1))
+        self._health_counts[kind] = self._health_counts.get(kind, 0) + n
+        self._emit({
+            "event": "health",
+            "kind": kind,
+            "count": n,
+            "run_id": self.run_id,
+            "rank": self.rank,
+            "t": time.time(),
+            **fields,
+        })
+
+    @property
+    def health_counts(self) -> Dict[str, int]:
+        return dict(self._health_counts)
+
+    def resume_counts(self, global_step: int) -> None:
+        """Continue the step/dispatch numbering of a preempted run so the
+        resumed JSONL stream's ``step`` axis doesn't restart at zero."""
+        self._global_step = max(0, int(global_step))
+        self._dispatch = self._global_step // max(1, self._steps_per_item)
 
     # -- per-step path (zero-sync) -------------------------------------------
 
@@ -357,6 +390,14 @@ class MetricsLogger:
             for k in ("grad_norm", "param_norm", "update_norm"):
                 if k in m:
                     rec[k] = float(m[k])
+            if "skipped" in m:
+                # non-finite guard: count of suppressed updates in this
+                # dispatch (0 or 1 unscanned; 0..K scanned)
+                nskip = int(round(float(m["skipped"])))
+                rec["skipped"] = nskip
+                if nskip > 0:
+                    self.health("step_skipped", count=nskip,
+                                step=self._global_step, epoch=self._epoch)
             if dt > 0:
                 rec["graphs_per_s"] = ng / dt
                 rec["nodes_per_s"] = nodes_real / dt
@@ -461,6 +502,8 @@ class MetricsLogger:
                              "pipeline")}
             if timers is not None:
                 rec["timers"] = timers
+            if self._health_counts:
+                rec["health"] = dict(self._health_counts)
             # fused-vs-fallback dispatch tally (this run's delta over the
             # process-cumulative trace-time counts): a run that silently
             # fell off the fast path shows ``<op>:scatter`` entries here

@@ -213,11 +213,18 @@ def make_train_step(
     opt_spec: OptimizerSpec,
     output_names: Optional[Sequence[str]] = None,
     telemetry_metrics: bool = False,
+    nonfinite_guard: bool = False,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, Dict[str, jax.Array]]]:
     """``telemetry_metrics=True`` adds the in-jit norm/count extension; the
     trainer passes the MetricsLogger's enable state.  Default OFF so direct
     builders (bench.py, tools/) time/cost-model the exact program a
-    non-telemetry production run executes."""
+    non-telemetry production run executes.
+
+    ``nonfinite_guard=True`` (resilience/guards.py) checks loss + gradients
+    for NaN/Inf inside the jit and suppresses the whole update (old params,
+    old opt state, old batch stats) on a bad step, adding a ``skipped``
+    metric.  Default OFF: the guard-off program is byte-identical to a
+    pre-guard build."""
     energy_head, forces_head = _force_head_indices(output_names)
 
     def train_step(state: TrainState, g: GraphBatch):
@@ -252,6 +259,15 @@ def make_train_step(
         if telemetry_metrics:
             metrics.update(
                 step_telemetry_metrics(g, grads, new_params, updates))
+        if nonfinite_guard:
+            from hydragnn_tpu.resilience.guards import (
+                apply_step_guard,
+                nonfinite_flag,
+            )
+
+            bad = nonfinite_flag(loss, grads)
+            new_state, metrics = apply_step_guard(
+                bad, state, new_state, metrics)
         return new_state, metrics
 
     return train_step
@@ -259,7 +275,8 @@ def make_train_step(
 
 # metric keys that are COUNTS over the dispatch (summed across the K
 # scanned steps); every other scalar merges as a graph-weighted mean
-_COUNT_METRIC_KEYS = ("num_graphs", "nodes_real", "edges_real")
+# ("skipped" counts guard-suppressed steps within the dispatch)
+_COUNT_METRIC_KEYS = ("num_graphs", "nodes_real", "edges_real", "skipped")
 
 
 def merge_scanned_metrics(ms):
@@ -362,6 +379,7 @@ def make_scan_train_step(
     output_names: Optional[Sequence[str]] = None,
     steps: int = 1,
     telemetry_metrics: bool = False,
+    nonfinite_guard: bool = False,
 ):
     """K sequential train steps inside one executable via ``lax.scan``.
 
@@ -376,7 +394,8 @@ def make_scan_train_step(
     from jax import lax
 
     base = make_train_step(model, cfg, opt_spec, output_names,
-                           telemetry_metrics=telemetry_metrics)
+                           telemetry_metrics=telemetry_metrics,
+                           nonfinite_guard=nonfinite_guard)
 
     def scan_step(state: TrainState, g: GraphBatch):
         state, ms = lax.scan(base, state, g, length=steps)
@@ -430,6 +449,13 @@ class ReduceLROnPlateau:
             return max(lr * self.factor, self.min_lr)
         return lr
 
+    def state_dict(self) -> Dict[str, float]:
+        return {"best": self.best, "bad_epochs": self.bad_epochs}
+
+    def load_state_dict(self, sd: Dict[str, float]) -> None:
+        self.best = float(sd["best"])
+        self.bad_epochs = int(sd["bad_epochs"])
+
 
 class EarlyStopping:
     """Patience on validation loss (reference utils/model.py:173-188)."""
@@ -450,6 +476,15 @@ class EarlyStopping:
             if self.count >= self.patience:
                 self.early_stop = True
         return self.early_stop
+
+    def state_dict(self) -> Dict[str, float]:
+        return {"count": self.count, "min_loss": self.min_loss,
+                "early_stop": self.early_stop}
+
+    def load_state_dict(self, sd: Dict[str, float]) -> None:
+        self.count = int(sd["count"])
+        self.min_loss = float(sd["min_loss"])
+        self.early_stop = bool(sd["early_stop"])
 
 
 class CheckpointTracker:
@@ -480,11 +515,20 @@ class CheckpointTracker:
         save_state(self.transform(state), self.name, self.path, rank=self.rank)
         return True
 
+    def state_dict(self) -> Dict[str, float]:
+        return {"count": self.count, "best": self.best}
+
+    def load_state_dict(self, sd: Dict[str, float]) -> None:
+        self.count = int(sd["count"])
+        self.best = float(sd["best"])
+
 
 def save_state(state: TrainState, log_name: str, path: str = "./logs/",
                rank: int = 0) -> Optional[str]:
     """Rank-0 single-file checkpoint (reference utils/model.py:58-71 writes
-    one .pk with model+optimizer state)."""
+    one .pk with model+optimizer state).  Written atomically (temp file +
+    ``os.replace``): this is often the ONLY best-model checkpoint, and a
+    crash mid-write must leave the previous good file intact."""
     if rank != 0:
         return None
     d = os.path.join(path, log_name)
@@ -498,8 +542,9 @@ def save_state(state: TrainState, log_name: str, path: str = "./logs/",
             "opt_state": state.opt_state,
         }
     )
-    with open(fname, "wb") as f:
-        pickle.dump(payload, f)
+    from hydragnn_tpu.resilience.ckpt_io import atomic_write_pickle
+
+    atomic_write_pickle(fname, payload)
     return fname
 
 
@@ -522,7 +567,8 @@ def load_state(state: TrainState, log_name: str, path: str = "./logs/") -> Train
 
 
 def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
-               steps_per_item: int = 1, telemetry=None):
+               steps_per_item: int = 1, telemetry=None, guard=None,
+               preempt=None, chaos=None, skip_first: int = 0):
     # Metrics accumulate as DEVICE scalars: no float() in the batch loop, so
     # steps dispatch back-to-back with no device->host sync (the reference
     # accumulates on device and reduces at epoch end,
@@ -545,12 +591,31 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
     for ibatch, g in enumerate(loader):
         if nbatch is not None and (ibatch + 1) * steps_per_item > nbatch:
             break
+        if ibatch < skip_first:
+            # mid-run resume: these dispatch units were already executed by
+            # the preempted run; set_epoch replayed the deterministic
+            # shuffle, so skipping them continues the exact batch stream.
+            # Preemption is still polled — a SIGTERM during a long replay
+            # must re-save (at the SAME position: everything up to
+            # skip_first was consumed by the previous run) instead of
+            # burning the grace window.
+            if train and preempt is not None and preempt.poll():
+                preempt.consumed = skip_first
+                break
+            continue
         if train:
+            if chaos is not None:
+                g = chaos.on_train_dispatch(g)
             state, metrics = step_fn(state, g)
             if telemetry is not None:
                 # zero-sync: device scalars + host timestamp are buffered;
                 # the one fetch happens in telemetry.flush_steps at epoch end
                 telemetry.on_step(metrics, g)
+            if guard is not None:
+                # buffers the device `skipped` flag; one device_get every
+                # poll_every dispatches — raises NonFiniteTrainingError
+                # after max_consecutive bad steps
+                guard.on_step(metrics, g)
             n_tasks = sum(1 for k in metrics if k.startswith("task_"))
             per_head = [metrics[f"task_{i}"] for i in range(n_tasks)]
         else:
@@ -565,6 +630,14 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
             total, tasks, n = total + loss_w, tasks + ph, n + ng
         if profiler is not None:
             profiler.step()
+        if train and preempt is not None:
+            if chaos is not None and chaos.preempt_now():
+                preempt.request()
+            if preempt.poll():
+                # stop at the batch boundary: the dispatched step's state is
+                # complete; record the step-within-epoch for the bundle
+                preempt.consumed = ibatch + 1
+                break
     return state, (None if total is None else (total, tasks, n))
 
 
@@ -596,6 +669,7 @@ def train_validate_test(
     profile_config: Optional[Dict[str, Any]] = None,
     mesh=None,
     telemetry=None,
+    resume_meta: Optional[Dict[str, Any]] = None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Epoch loop with LR plateau scheduling, early stopping, checkpointing.
 
@@ -611,6 +685,12 @@ def train_validate_test(
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     output_names = config_nn["Variables_of_interest"].get("output_names")
+    # fault-tolerance knobs (resilience/config.py): read BEFORE the step
+    # functions are built — the non-finite guard is a trace-time flag
+    from hydragnn_tpu.resilience import Chaos, ResilienceConfig
+
+    res_cfg = ResilienceConfig.from_training(training)
+    chaos = Chaos.from_env(training.get("Chaos"))
     # an explicit (ensemble-branch) mesh means other branches run disjoint
     # programs concurrently — global host collectives (telemetry cross-rank
     # reduction) would interleave with theirs and deadlock; remember before
@@ -707,7 +787,8 @@ def train_validate_test(
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
             zero_specs=zero_specs, steps=steps_per_dispatch,
-            telemetry_metrics=telemetry.enabled)
+            telemetry_metrics=telemetry.enabled,
+            nonfinite_guard=res_cfg.nonfinite_guard)
         eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes)
         _align_bucket_group(
             train_loader, n_local_devices * steps_per_dispatch)
@@ -767,7 +848,8 @@ def train_validate_test(
             train_step = jax.jit(
                 make_scan_train_step(model, cfg, opt_spec, output_names,
                                      steps_per_dispatch,
-                                     telemetry_metrics=telemetry.enabled),
+                                     telemetry_metrics=telemetry.enabled,
+                                     nonfinite_guard=res_cfg.nonfinite_guard),
                 donate_argnums=0)
             _align_bucket_group(train_loader, steps_per_dispatch)
             train_loader = DeviceStackLoader(
@@ -775,7 +857,8 @@ def train_validate_test(
         else:
             train_step = jax.jit(
                 make_train_step(model, cfg, opt_spec, output_names,
-                                telemetry_metrics=telemetry.enabled),
+                                telemetry_metrics=telemetry.enabled,
+                                nonfinite_guard=res_cfg.nonfinite_guard),
                 donate_argnums=0)
         if env_flag("HYDRAGNN_DEVICE_PREFETCH"):
             # async H2D of upcoming (stacked) batches — AFTER stacking, so
@@ -817,6 +900,29 @@ def train_validate_test(
             path=logs_dir, rank=rank)
         checkpointer.transform = consolidate
 
+    # -- resilience wiring (docs/RESILIENCE.md) -----------------------------
+    guard_monitor = None
+    if res_cfg.nonfinite_guard:
+        from hydragnn_tpu.resilience import NonFiniteGuardMonitor
+
+        guard_monitor = NonFiniteGuardMonitor(
+            max_consecutive=res_cfg.guard_max_consecutive,
+            poll_every=res_cfg.guard_poll_every,
+            steps_per_item=steps_per_dispatch,
+            dump_path=os.path.join(logs_dir, log_name,
+                                   "nonfinite_abort.json"),
+            telemetry=telemetry)
+    preempt = None
+    if res_cfg.preemption:
+        from hydragnn_tpu.resilience import PreemptionHandler
+
+        # cross-rank agreement uses GLOBAL host collectives — an ensemble
+        # branch (explicit sub-mesh) must not attempt them (same rule as
+        # the telemetry cross-rank reduction)
+        preempt = PreemptionHandler(
+            sync_every=res_cfg.preempt_sync_every,
+            cross_rank=(not explicit_mesh and world_size > 1)).install()
+
     # Orbax FULL-train-state checkpoint (step counter + params + batch stats
     # + opt state) every N epochs — beyond the reference's best-model pickle,
     # which restarts at epoch 0 (utils/model.py:58-103).  run_training's
@@ -846,8 +952,79 @@ def train_validate_test(
                          "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
 
+    # -- mid-run resume (resilience/resume.py) ------------------------------
+    # the bundle's items_consumed counts dispatch units of the FINAL wrapped
+    # train loader, so the pipeline shape must match the preempted run's —
+    # a silent mismatch would re-run or skip real optimizer steps
+    start_epoch = 0
+    skip_first = 0
+    if resume_meta:
+        rp = resume_meta.get("pipeline") or {}
+        if rp and (int(rp.get("steps_per_dispatch", steps_per_dispatch))
+                   != steps_per_dispatch
+                   or bool(rp.get("use_mesh_dp", use_mesh_dp))
+                   != bool(use_mesh_dp)):
+            raise ValueError(
+                f"resume bundle was saved with pipeline {rp} but this run "
+                f"built steps_per_dispatch={steps_per_dispatch}, "
+                f"use_mesh_dp={use_mesh_dp}; resume with the same pipeline "
+                "knobs (HYDRAGNN_STEPS_PER_DISPATCH etc.) for an exact "
+                "continuation")
+        start_epoch = int(resume_meta.get("epoch", 0))
+        skip_first = int(resume_meta.get("items_consumed", 0))
+        if resume_meta.get("scheduler"):
+            scheduler.load_state_dict(resume_meta["scheduler"])
+        if earlystopper is not None and resume_meta.get("earlystop"):
+            earlystopper.load_state_dict(resume_meta["earlystop"])
+        if checkpointer is not None and resume_meta.get("checkpointer"):
+            checkpointer.load_state_dict(resume_meta["checkpointer"])
+        for k, v in (resume_meta.get("history") or {}).items():
+            if k in history and isinstance(v, list):
+                history[k] = list(v)
+        lr = float(resume_meta.get("lr", lr))
+        telemetry.resume_counts(int(resume_meta.get("saved_step", 0)))
+        telemetry.health("resume_from", epoch=start_epoch,
+                         items=skip_first,
+                         step=resume_meta.get("saved_step"))
+
+    def _save_resume(epoch_i: int, items: int, reason: str) -> bool:
+        """Write the resume bundle (state + host control state); every
+        rank enters (the consolidate transform and orbax save are
+        collectives), rank 0 writes the meta."""
+        from hydragnn_tpu.resilience import resume_dir, save_resume_bundle
+
+        meta = {
+            "epoch": epoch_i,
+            "items_consumed": items,
+            "scheduler": scheduler.state_dict(),
+            "earlystop": (earlystopper.state_dict()
+                          if earlystopper is not None else None),
+            "checkpointer": (checkpointer.state_dict()
+                             if checkpointer is not None else None),
+            "history": {k: history[k]
+                        for k in ("train", "val", "test", "lr",
+                                  "epoch_time")},
+            "lr": lr,
+            "pipeline": {"steps_per_dispatch": steps_per_dispatch,
+                         "resident": bool(resident_on),
+                         "use_mesh_dp": bool(use_mesh_dp),
+                         "n_local_devices": n_local_devices},
+            "world_size": world_size,
+        }
+        ok = save_resume_bundle(
+            consolidate(state), meta, resume_dir(logs_dir, log_name),
+            rank=rank, retries=res_cfg.ckpt_retries,
+            backoff=res_cfg.ckpt_backoff, telemetry=telemetry,
+            chaos=chaos, reason=reason,
+            cross_rank=(not explicit_mesh and world_size > 1))
+        telemetry.health(
+            "walltime_save" if reason == "walltime" else "preempt_save",
+            epoch=epoch_i, items=items, ok=ok,
+            step=int(jax.device_get(state.step)))
+        return ok
+
     try:
-        for epoch in range(num_epoch):
+        for epoch in range(start_epoch, num_epoch):
             t0 = time.time()
             telemetry.begin_epoch(epoch)
             train_loader.set_epoch(epoch)
@@ -861,8 +1038,25 @@ def train_validate_test(
             state, train_acc = _run_epoch(
                 train_step, state, train_loader, True, profiler=profiler,
                 steps_per_item=steps_per_dispatch,
-                telemetry=telemetry if telemetry.enabled else None)
+                telemetry=telemetry if telemetry.enabled else None,
+                guard=guard_monitor, preempt=preempt, chaos=chaos,
+                skip_first=skip_first if epoch == start_epoch else 0)
             tr.stop("train")
+            if preempt is not None and preempt.stop_requested:
+                # preemption agreed mid-epoch: bundle the exact position
+                # (epoch + items consumed) and stop; `continue` resumes here
+                telemetry.flush_steps()
+                _save_resume(epoch, preempt.consumed, reason="preempt")
+                history["preempted"] = True
+                print_distributed(
+                    verbosity,
+                    f"Preempted at epoch {epoch} after {preempt.consumed} "
+                    "train dispatch(es); resume bundle saved")
+                break
+            if guard_monitor is not None:
+                # drain buffered skip flags before val/test; raises
+                # NonFiniteTrainingError past the consecutive-bad threshold
+                guard_monitor.flush()
             # HYDRAGNN_VALTEST=0 skips the val/test epochs (reference knob)
             valtest = bool(int(os.getenv("HYDRAGNN_VALTEST", "1")))
             val_acc = test_acc = None
@@ -945,14 +1139,28 @@ def train_validate_test(
             if orbax_every and (epoch + 1) % orbax_every == 0:
                 # EVERY process calls this: the ZeRO consolidation jit and
                 # orbax's CheckpointManager are both cross-process collectives —
-                # a rank-0 gate would deadlock multi-host runs.
+                # a rank-0 gate would deadlock multi-host runs.  Retried with
+                # backoff; a persistently failing filesystem warns and the
+                # run KEEPS TRAINING (a periodic checkpoint is not worth the
+                # run) — resilience/ckpt_io.py.
+                from hydragnn_tpu.resilience.ckpt_io import with_retries
                 from hydragnn_tpu.utils.checkpoint import save_checkpoint
 
-                save_checkpoint(consolidate(state), orbax_dir)
+                consolidated = consolidate(state)
+                with_retries(
+                    lambda: save_checkpoint(consolidated, orbax_dir),
+                    retries=res_cfg.ckpt_retries,
+                    backoff=res_cfg.ckpt_backoff,
+                    what="periodic full-state checkpoint",
+                    telemetry=telemetry, chaos=chaos, on_fail="warn",
+                    cross_rank=(not explicit_mesh and world_size > 1))
             if earlystopper is not None and earlystopper(val_loss):
                 print_distributed(verbosity, f"Early stopping at epoch {epoch}")
                 break
             # SLURM walltime graceful stop (reference train_validate_test.py:229-235)
+            # — now resumable: the full resume bundle is saved before
+            # breaking, so `continue` picks up at epoch+1 instead of losing
+            # everything since the last full_state_checkpoint epoch
             if os.getenv("SLURM_JOB_ID"):
                 from hydragnn_tpu.utils.slurm import check_remaining
 
@@ -960,7 +1168,19 @@ def train_validate_test(
                     print_distributed(
                         verbosity,
                         f"Stopping at epoch {epoch}: insufficient SLURM walltime")
+                    _save_resume(epoch + 1, 0, reason="walltime")
+                    history["preempted"] = True
                     break
+            # a signal delivered during val/test (or missed by the final
+            # mid-train sync point) is caught at the epoch boundary; every
+            # rank forces the agreement collective here, keeping it symmetric
+            if preempt is not None and preempt.poll(force=True):
+                _save_resume(epoch + 1, 0, reason="preempt")
+                history["preempted"] = True
+                print_distributed(
+                    verbosity,
+                    f"Preempted at end of epoch {epoch}; resume bundle saved")
+                break
 
     finally:
         # teardown runs on EVERY exit path — a crash mid-epoch must
@@ -968,6 +1188,17 @@ def train_validate_test(
         # manifest, close the sinks and unlatch the module-global
         # pipeline counters, or the next run in this process (HPO
         # trial, test) inherits stale telemetry state
+        if preempt is not None:
+            preempt.uninstall()
+        # release this run's cached orbax managers (background threads +
+        # handles) — an HPO loop's trials use fresh directories and would
+        # otherwise pin one manager per directory for the process lifetime
+        from hydragnn_tpu.resilience import resume as _resume
+        from hydragnn_tpu.utils.checkpoint import close_manager
+
+        close_manager(orbax_dir)
+        close_manager(os.path.join(
+            _resume.resume_dir(logs_dir, log_name), _resume.STATE_DIRNAME))
         profiler.disable()
         timer = tr.get("timer")
         telemetry.finalize(

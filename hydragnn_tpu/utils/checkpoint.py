@@ -5,37 +5,88 @@ hydragnn/utils/model.py:58-103, which saves only model+optimizer state and
 restarts at epoch 0), this saves the FULL train state — step counter, params,
 batch statistics, optimizer state — with orbax's async-capable, sharded-array
 aware format, so multi-host runs restore each shard in place.
+
+CheckpointManagers are cached per directory and reused across calls for the
+life of the process: constructing one is not free (directory scan, option
+validation, and on multi-host runs a barrier), and the old
+construct-save-close-per-call pattern also leaked the manager on the
+``restore_checkpoint`` not-found path.  ``close_manager``/``close_managers``
+release them explicitly (tests, or before deleting a checkpoint directory).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 
+_MANAGERS: Dict[str, Any] = {}
+
 
 def _manager(directory: str, max_to_keep: int = 3):
+    """Cached per-directory CheckpointManager (created on first use)."""
     import orbax.checkpoint as ocp
 
-    return ocp.CheckpointManager(
-        os.path.abspath(directory),
-        options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True),
-    )
+    key = os.path.abspath(directory)
+    mgr = _MANAGERS.get(key)
+    if mgr is None:
+        mgr = ocp.CheckpointManager(
+            key,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+        _MANAGERS[key] = mgr
+    return mgr
+
+
+def _reload(mgr) -> None:
+    """Refresh the manager's cached step listing from disk — another
+    process (a preempted run we are resuming after) may have written steps
+    this manager has never seen."""
+    reload_fn = getattr(mgr, "reload", None)
+    if callable(reload_fn):
+        try:
+            reload_fn()
+        except Exception:  # noqa: BLE001 — stale listing beats a crash
+            pass
+
+
+def close_manager(directory: str) -> None:
+    """Close and forget the cached manager for one directory (call before
+    deleting the directory out from under it)."""
+    mgr = _MANAGERS.pop(os.path.abspath(directory), None)
+    if mgr is not None:
+        try:
+            mgr.close()
+        except Exception:  # noqa: BLE001 — close is best-effort
+            pass
+
+
+def close_managers() -> None:
+    """Close every cached manager (test teardown / process shutdown)."""
+    for key in list(_MANAGERS):
+        close_manager(key)
 
 
 def save_checkpoint(state, directory: str, step: Optional[int] = None,
                     max_to_keep: int = 3) -> None:
-    """Save the full TrainState under ``directory/<step>``."""
+    """Save the full TrainState under ``directory/<step>``.
+
+    A duplicate step raises (orbax's behavior).  Deliberately NOT
+    delete-then-save: destroying the existing copy before the new one is
+    finalized would turn a failed re-save into data loss — callers that
+    can legitimately hit the same step twice (the resume bundle) skip the
+    redundant save instead (resilience/resume.py).
+    """
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, max_to_keep)
     step = int(state.step) if step is None else int(step)
+    _reload(mgr)
     mgr.save(step, args=ocp.args.StandardSave(
         {"state": jax.device_get(state)}))
     mgr.wait_until_finished()
-    mgr.close()
 
 
 def restore_checkpoint(state, directory: str,
@@ -44,21 +95,22 @@ def restore_checkpoint(state, directory: str,
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory)
-    step = mgr.latest_step() if step is None else int(step)
     if step is None:
+        _reload(mgr)
+        step = mgr.latest_step()
+    else:
+        step = int(step)
+    if step is None:
+        # the cached manager stays open for reuse — no per-call leak
         raise FileNotFoundError(f"No checkpoints under {directory}")
     restored = mgr.restore(
         step, args=ocp.args.StandardRestore({"state": state}))
-    mgr.close()
     return restored["state"]
 
 
 def latest_step(directory: str) -> Optional[int]:
-    import orbax.checkpoint as ocp
-
     if not os.path.isdir(directory):
         return None
     mgr = _manager(directory)
-    out = mgr.latest_step()
-    mgr.close()
-    return out
+    _reload(mgr)
+    return mgr.latest_step()

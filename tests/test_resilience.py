@@ -1,0 +1,600 @@
+"""Fault-tolerant training (hydragnn_tpu/resilience, docs/RESILIENCE.md):
+in-jit non-finite step guards on all three step paths, preemption-aware
+checkpointing with true mid-run resume (crash-and-resume bit-parity), and
+the chaos/fault-injection harness + checkpoint retry/degradation ladder.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.dataloader import GraphDataLoader, pad_spec_for
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.parallel.mesh import stack_batches
+from hydragnn_tpu.resilience import (
+    Chaos,
+    NonFiniteGuardMonitor,
+    NonFiniteTrainingError,
+    PreemptionHandler,
+    load_resume_bundle,
+    resume_dir,
+    with_retries,
+)
+from hydragnn_tpu.telemetry import MetricsLogger
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    make_scan_train_step,
+    make_train_step,
+    train_validate_test,
+)
+
+
+def _model():
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    return cfg, create_model(cfg)
+
+
+def _samples(n, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        pos = rng.rand(10, 3).astype(np.float32) * 2.0
+        x = rng.rand(10, 1).astype(np.float32)
+        ei = radius_graph(pos, 1.2, 10)
+        out.append(GraphSample(x=x, pos=pos, edge_index=ei,
+                               graph_y=x.sum(keepdims=True)[0], node_y=x))
+    return out
+
+
+def _batch(seed=0, n_graphs=4):
+    samples = _samples(n_graphs, seed)
+    return collate(samples, PadSpec.for_batch(n_graphs, 10, 90),
+                   [HeadSpec("e", "graph", 1)])
+
+
+def _nan_batch(b):
+    return b.replace(x=jnp.full(b.x.shape, jnp.nan, b.x.dtype))
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(jax.device_get(a))
+    lb = jax.tree_util.tree_leaves(jax.device_get(b))
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# In-jit non-finite guards: local jit, scanned-K, mesh-DP
+# ---------------------------------------------------------------------------
+
+
+def test_nonfinite_guard_local_skips_and_recovers():
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    b = _batch()
+    s0 = create_train_state(model, b, opt)
+    step = jax.jit(make_train_step(model, cfg, opt, nonfinite_guard=True))
+
+    s1, m1 = step(s0, _nan_batch(b))
+    assert float(m1["skipped"]) == 1.0
+    # skipped steps contribute NOTHING to epoch accumulators
+    assert float(m1["loss"]) == 0.0 and float(m1["num_graphs"]) == 0.0
+    # params, opt state and batch stats all revert; the step counter counts
+    # the ATTEMPT (dropout fold-in stays aligned with the batch stream)
+    assert _leaves_equal(s1.params, s0.params)
+    assert _leaves_equal(s1.opt_state, s0.opt_state)
+    assert int(s1.step) == 1
+
+    s2, m2 = step(s1, b)
+    assert float(m2["skipped"]) == 0.0
+    assert jnp.isfinite(m2["loss"])
+    assert not _leaves_equal(s2.params, s1.params)
+
+    # with telemetry metrics on, a skipped step's norms are sanitized —
+    # a raw NaN would poison the graph-weighted scan merge (NaN * 0)
+    tstep = jax.jit(make_train_step(model, cfg, opt,
+                                    telemetry_metrics=True,
+                                    nonfinite_guard=True))
+    _, mt = tstep(s0, _nan_batch(b))
+    for k in ("grad_norm", "param_norm", "update_norm"):
+        assert np.isfinite(float(mt[k])), k
+
+
+def test_nonfinite_guard_scan_counts_skipped_steps():
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    b0, b1 = _batch(seed=1), _batch(seed=2)
+    s0 = create_train_state(model, b0, opt)
+
+    # clean step then NaN step inside one scanned executable: the merged
+    # metrics count 1 skipped step, and the final params equal the params
+    # after the clean step alone
+    scan = jax.jit(make_scan_train_step(model, cfg, opt, None, 2,
+                                        nonfinite_guard=True))
+    s_scan, ms = scan(s0, stack_batches([b0, _nan_batch(b1)]))
+    assert float(ms["skipped"]) == 1.0
+    assert float(ms["num_graphs"]) == 4.0  # only the clean step's graphs
+
+    ref_step = jax.jit(make_train_step(model, cfg, opt,
+                                       nonfinite_guard=True))
+    s_ref, _ = ref_step(s0, b0)
+    assert _leaves_equal(s_scan.params, s_ref.params)
+
+
+def test_nonfinite_guard_mesh_dp_skips_whole_step():
+    from hydragnn_tpu.parallel.mesh import (
+        make_dp_train_step,
+        make_mesh,
+        replicate_state,
+    )
+
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    n_dev = len(jax.devices())
+    mesh = make_mesh()
+    batches = [_batch(seed=i) for i in range(n_dev)]
+    s0 = create_train_state(model, batches[0], opt)
+    step = make_dp_train_step(model, cfg, opt, mesh, nonfinite_guard=True)
+
+    # NaN on ONE device's shard: the gradient pmean spreads it, the
+    # replicated flag trips, and every replica keeps the old params
+    batches[0] = _nan_batch(batches[0])
+    s1, m = step(replicate_state(s0, mesh), stack_batches(batches))
+    assert float(m["skipped"]) == 1.0
+    assert float(m["num_graphs"]) == 0.0
+    assert _leaves_equal(s1.params, s0.params)
+
+    # clean stacked batch trains normally
+    clean = stack_batches([_batch(seed=10 + i) for i in range(n_dev)])
+    s2, m2 = step(s1, clean)
+    assert float(m2["skipped"]) == 0.0
+    assert not _leaves_equal(s2.params, s0.params)
+
+
+def test_guard_off_traces_unchanged_program():
+    """Disabled guard must be FREE: no finiteness ops, no skipped metric —
+    the traced program is the pre-guard program."""
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    b = _batch()
+    s0 = create_train_state(model, b, opt)
+    off = jax.jit(make_train_step(model, cfg, opt)).lower(s0, b).as_text()
+    on = jax.jit(make_train_step(model, cfg, opt, nonfinite_guard=True)
+                 ).lower(s0, b).as_text()
+    assert "is_finite" not in off
+    assert "is_finite" in on
+    _, m = jax.jit(make_train_step(model, cfg, opt))(s0, b)
+    assert "skipped" not in m
+
+
+def test_guard_monitor_aborts_with_diagnostic_dump(tmp_path):
+    dump = str(tmp_path / "nonfinite_abort.json")
+    tele = MetricsLogger.disabled()
+    mon = NonFiniteGuardMonitor(max_consecutive=3, poll_every=1,
+                                dump_path=dump, telemetry=tele)
+    b = _batch()
+    good = {"skipped": jnp.zeros(()), "loss": jnp.ones(()),
+            "grad_norm": jnp.ones(())}
+    bad = {"skipped": jnp.ones(()), "loss": jnp.full((), jnp.nan),
+           "grad_norm": jnp.full((), jnp.inf)}
+    mon.on_step(bad, b)
+    mon.on_step(good, b)  # streak broken
+    mon.on_step(bad, b)
+    mon.on_step(bad, b)
+    with pytest.raises(NonFiniteTrainingError):
+        mon.on_step(bad, b)
+    d = json.load(open(dump))
+    assert d["consecutive_bad_steps"] == 3
+    assert d["offending_batch_shape"]["x"] == list(b.x.shape)
+    assert len(d["history"]) == 5
+    assert any(h["skipped"] == 0 for h in d["history"])
+    assert tele.health_counts.get("nonfinite_abort") == 1
+
+
+# ---------------------------------------------------------------------------
+# trainer-level crash-and-resume bit-parity
+# ---------------------------------------------------------------------------
+
+
+class _Loaders:
+    """Deterministic loader triple rebuilt per run (shuffle replays from
+    set_epoch, so two runs over the same construction are identical)."""
+
+    def __init__(self, n_train=32, batch_size=8, seed=7):
+        self.heads = [HeadSpec("e", "graph", 1)]
+        all_s = _samples(n_train + 16, seed=5)
+        self.pad = pad_spec_for(all_s, batch_size)
+        self.mk = lambda split, shuffle: GraphDataLoader(
+            split, self.heads, batch_size, pad_spec=self.pad,
+            shuffle=shuffle, seed=seed)
+        self.train_s = all_s[:n_train]
+        self.val_s = all_s[n_train:n_train + 8]
+        self.test_s = all_s[n_train + 8:]
+
+    def __call__(self):
+        return (self.mk(self.train_s, True), self.mk(self.val_s, False),
+                self.mk(self.test_s, False))
+
+
+def _run(loaders, tmp_path, name, num_epoch=3, use_mesh_dp=False,
+         resume_meta=None, state=None, training_extra=None, lr=0.01):
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": lr})
+    train_l, val_l, test_l = loaders()
+    if state is None:
+        state = create_train_state(model, next(iter(train_l)), opt)
+    training = {"num_epoch": num_epoch, **(training_extra or {})}
+    return train_validate_test(
+        model, cfg, state, opt, train_l, val_l, test_l,
+        {"Training": training, "Variables_of_interest": {"output_names": ["e"]}},
+        log_name=name, logs_dir=str(tmp_path), use_mesh_dp=use_mesh_dp,
+        resume_meta=resume_meta)
+
+
+def _fresh_skeleton(loaders, lr=0.01):
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": lr})
+    train_l, _, _ = loaders()
+    return create_train_state(model, next(iter(train_l)), opt)
+
+
+@pytest.mark.parametrize("use_mesh_dp", [False, True],
+                         ids=["local", "mesh_dp"])
+def test_crash_and_resume_bit_parity(tmp_path, monkeypatch, use_mesh_dp):
+    """A run preempted at an arbitrary mid-epoch step and resumed must
+    produce params IDENTICAL to the uninterrupted run: the bundle restores
+    epoch/step/scheduler state and the resumed epoch replays the
+    deterministic shuffle, skipping already-seen dispatch units."""
+    monkeypatch.delenv("HYDRAGNN_CHAOS_PREEMPT_STEP", raising=False)
+    if use_mesh_dp:
+        # 8 virtual devices stack 8 micro-batches per dispatch unit
+        loaders = _Loaders(n_train=64, batch_size=4)
+        preempt_at = 3  # of 2 units/epoch x 3 epochs -> mid-epoch 1
+    else:
+        loaders = _Loaders(n_train=32, batch_size=8)
+        preempt_at = 6  # of 4 units/epoch x 3 epochs -> mid-epoch 1
+
+    state_a, hist_a = _run(loaders, tmp_path, "uninterrupted",
+                           use_mesh_dp=use_mesh_dp)
+    assert "preempted" not in hist_a
+
+    # chaos-simulated preemption: the handler flag is raised exactly as a
+    # SIGTERM would, at a deterministic dispatch index
+    monkeypatch.setenv("HYDRAGNN_CHAOS_PREEMPT_STEP", str(preempt_at))
+    state_b, hist_b = _run(loaders, tmp_path, "preempted",
+                           use_mesh_dp=use_mesh_dp)
+    assert hist_b.get("preempted") is True
+    monkeypatch.delenv("HYDRAGNN_CHAOS_PREEMPT_STEP")
+
+    rdir = resume_dir(str(tmp_path), "preempted")
+    bundle = load_resume_bundle(_fresh_skeleton(loaders), rdir)
+    assert bundle is not None
+    state_r, meta = bundle
+    assert meta["epoch"] == 1
+    assert meta["items_consumed"] == preempt_at - (2 if use_mesh_dp else 4)
+    state_c, hist_c = _run(loaders, tmp_path, "preempted",
+                           use_mesh_dp=use_mesh_dp,
+                           resume_meta=meta, state=state_r)
+    assert "preempted" not in hist_c
+    assert len(hist_c["val"]) == 3  # saved history + resumed epochs
+
+    assert _leaves_equal(state_c.params, state_a.params)
+    assert _leaves_equal(state_c.opt_state, state_a.opt_state)
+    assert int(jax.device_get(state_c.step)) == int(
+        jax.device_get(state_a.step))
+
+
+def test_walltime_stop_saves_bundle_and_resumes(tmp_path, monkeypatch):
+    """SLURM walltime exit saves the full resume bundle (satellite: no work
+    lost since the last full_state_checkpoint) and `continue` resumes at
+    the right epoch with bit parity."""
+    loaders = _Loaders()
+    state_a, _ = _run(loaders, tmp_path, "nowall")
+
+    import hydragnn_tpu.utils.slurm as slurm
+
+    calls = {"n": 0}
+
+    def fake_check(epoch_seconds, safety_factor=2.0):
+        calls["n"] += 1
+        return False  # never enough time for another epoch
+
+    monkeypatch.setenv("SLURM_JOB_ID", "12345")
+    monkeypatch.setattr(slurm, "check_remaining", fake_check)
+    state_b, hist_b = _run(loaders, tmp_path, "walled")
+    assert calls["n"] == 1 and hist_b.get("preempted") is True
+    assert len(hist_b["train"]) == 1  # stopped after epoch 0
+    monkeypatch.delenv("SLURM_JOB_ID")
+
+    bundle = load_resume_bundle(
+        _fresh_skeleton(loaders), resume_dir(str(tmp_path), "walled"))
+    assert bundle is not None
+    state_r, meta = bundle
+    assert meta["epoch"] == 1 and meta["items_consumed"] == 0
+    assert meta["reason"] == "walltime"
+    state_c, _ = _run(loaders, tmp_path, "walled", resume_meta=meta,
+                      state=state_r)
+    assert _leaves_equal(state_c.params, state_a.params)
+
+
+def test_chaos_nan_batch_skipped_and_run_converges(tmp_path, monkeypatch):
+    """An injected NaN batch is skipped (telemetry counts it via the
+    step_skipped health event) and the run converges on clean batches."""
+    monkeypatch.setenv("HYDRAGNN_CHAOS_NAN_STEP", "2")
+    monkeypatch.setenv("HYDRAGNN_TELEMETRY", "1")
+    monkeypatch.setenv("HYDRAGNN_TELEMETRY_SINKS", "jsonl")
+    tdir = str(tmp_path / "tele")
+    monkeypatch.setenv("HYDRAGNN_TELEMETRY_DIR", tdir)
+    loaders = _Loaders()
+    state, hist = _run(loaders, tmp_path, "nanrun", num_epoch=4,
+                       training_extra={"nonfinite_guard": 1})
+    assert all(np.isfinite(hist["train"]))
+    assert hist["train"][-1] < hist["train"][0]
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    records = [json.loads(l) for l in
+               open(os.path.join(tdir, "events.jsonl")) if l.strip()]
+    skipped = [r for r in records if r.get("event") == "health"
+               and r.get("kind") == "step_skipped"]
+    assert len(skipped) == 1
+    manifest = [r for r in records if r.get("event") == "manifest"][-1]
+    assert manifest["health"]["step_skipped"] == 1
+    steps = [r for r in records if r.get("event") == "step"]
+    assert sum(r.get("skipped", 0) for r in steps) == 1
+
+
+def test_all_nan_stream_aborts_with_dump(tmp_path, monkeypatch):
+    """N consecutive bad steps abort with a diagnostic dump; params stay
+    finite (every bad update was suppressed in-jit)."""
+    monkeypatch.setenv("HYDRAGNN_CHAOS_NAN_STEP", "1+")
+    loaders = _Loaders()
+    with pytest.raises(NonFiniteTrainingError, match="consecutive"):
+        _run(loaders, tmp_path, "allnan",
+             training_extra={"nonfinite_guard": 1,
+                             "guard_max_consecutive": 3,
+                             "guard_poll_every": 1})
+    dump = json.load(open(tmp_path / "allnan" / "nonfinite_abort.json"))
+    assert dump["consecutive_bad_steps"] >= 3
+    assert dump["history"][-1]["skipped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# preemption handler, chaos parsing, checkpoint I/O ladder
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_handler_sigterm_roundtrip():
+    h = PreemptionHandler().install()
+    try:
+        assert not h.poll()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.poll() and h.stop_requested
+    finally:
+        h.uninstall()
+    # handlers restored: a fresh handler starts clean
+    h2 = PreemptionHandler()
+    h2.request()
+    assert h2.poll()
+
+
+def test_chaos_parsing_and_one_shot_preempt(monkeypatch):
+    for var in ("HYDRAGNN_CHAOS_NAN_STEP", "HYDRAGNN_CHAOS_PREEMPT_STEP",
+                "HYDRAGNN_CHAOS_CKPT_FAILS"):
+        monkeypatch.delenv(var, raising=False)
+    assert Chaos.from_env() is None
+    assert Chaos.from_env({"nan_step": ""}) is None
+
+    c = Chaos.from_env({"nan_step": "2,4+", "preempt_step": 3,
+                        "ckpt_fails": 1})
+    b = _batch()
+    seen = []
+    for _ in range(5):
+        g = c.on_train_dispatch(b)
+        seen.append(bool(np.isnan(np.asarray(g.x)).any()))
+    assert seen == [False, True, False, True, True]
+    # fires exactly once, at/after the armed dispatch
+    assert c.preempt_now() and not c.preempt_now()
+    with pytest.raises(OSError, match="chaos"):
+        c.ckpt_attempt()
+    c.ckpt_attempt()  # budget exhausted -> clean
+
+
+def test_ckpt_retry_backoff_and_degradation():
+    tele = MetricsLogger.disabled()
+    calls = {"n": 0}
+
+    def ok_fn():
+        calls["n"] += 1
+
+    # two injected failures, then success on the third attempt
+    assert with_retries(ok_fn, retries=3, backoff=0.0, telemetry=tele,
+                        chaos=Chaos(ckpt_fails=2))
+    assert calls["n"] == 1
+    assert tele.health_counts["ckpt_retry"] == 2
+
+    def boom():
+        raise OSError("disk on fire")
+
+    # graceful degradation: warn, count, keep going
+    with pytest.warns(UserWarning, match="disk on fire"):
+        assert not with_retries(boom, retries=1, backoff=0.0,
+                                telemetry=tele, on_fail="warn")
+    assert tele.health_counts["ckpt_giveup"] == 1
+    with pytest.raises(OSError):
+        with_retries(boom, retries=0, backoff=0.0)
+
+
+def test_periodic_checkpoint_failure_degrades_not_crashes(tmp_path,
+                                                          monkeypatch):
+    """A filesystem that keeps failing must cost the checkpoints, not the
+    run (acceptance: warn and keep training)."""
+    monkeypatch.setenv("HYDRAGNN_CHAOS_CKPT_FAILS", "99")
+    loaders = _Loaders()
+    with pytest.warns(UserWarning, match="periodic full-state checkpoint"):
+        _, hist = _run(loaders, tmp_path, "degraded", num_epoch=2,
+                       training_extra={"full_state_checkpoint": 1,
+                                       "ckpt_backoff": 0.0})
+    assert len(hist["train"]) == 2  # trained through both epochs
+    from hydragnn_tpu.utils.checkpoint import latest_step
+
+    assert latest_step(str(tmp_path / "degraded" / "orbax")) is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager reuse + atomic writes + bundle validity
+# ---------------------------------------------------------------------------
+
+
+def _tiny_state():
+    from hydragnn_tpu.train.trainer import TrainState
+
+    return TrainState(
+        step=jnp.asarray(3, jnp.int32),
+        params={"w": jnp.arange(4, dtype=jnp.float32)},
+        batch_stats={"m": jnp.ones((2,), jnp.float32)},
+        opt_state={"mu": jnp.zeros((4,), jnp.float32)},
+    )
+
+
+def test_checkpoint_manager_reused_and_notfound_no_leak(tmp_path):
+    from hydragnn_tpu.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "orbax")
+    state = _tiny_state()
+    ckpt.save_checkpoint(state, d)
+    m1 = ckpt._manager(d)
+    ckpt.save_checkpoint(state, d, step=7)
+    assert ckpt._manager(d) is m1  # one manager per run, reused
+    restored = ckpt.restore_checkpoint(_tiny_state(), d)
+    assert _leaves_equal(restored, state)
+
+    empty = str(tmp_path / "empty")
+    for _ in range(3):
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_checkpoint(state, empty)
+    # the not-found path caches ONE reusable manager (the old code leaked
+    # an unclosed manager per call)
+    assert sum(1 for k in ckpt._MANAGERS if k == os.path.abspath(empty)) == 1
+    ckpt.close_manager(empty)
+    ckpt.close_manager(d)
+    assert os.path.abspath(d) not in ckpt._MANAGERS
+
+
+def test_save_state_atomic_preserves_previous_on_crash(tmp_path,
+                                                       monkeypatch):
+    from hydragnn_tpu.train import trainer
+
+    state = _tiny_state()
+    fname = trainer.save_state(state, "atomic", str(tmp_path))
+    import pickle
+
+    before = pickle.load(open(fname, "rb"))
+
+    from hydragnn_tpu.resilience import ckpt_io
+
+    def exploding_dump(payload, f):
+        f.write(b"partial garbage")
+        raise OSError("crash mid-write")
+
+    monkeypatch.setattr(ckpt_io.pickle, "dump", exploding_dump)
+    state2 = state.replace(step=jnp.asarray(99, jnp.int32))
+    with pytest.raises(OSError):
+        trainer.save_state(state2, "atomic", str(tmp_path))
+    after = pickle.load(open(fname, "rb"))
+    assert int(after["step"]) == int(before["step"]) == 3
+    # no temp litter
+    d = os.path.dirname(fname)
+    assert [f for f in os.listdir(d) if ".tmp." in f] == []
+
+
+def test_same_step_resave_keeps_bundle_valid(tmp_path):
+    """A resumed run preempted again before any optimizer step re-saves
+    the same step: the existing (identical) checkpoint must be reused,
+    never delete-then-rewritten — a failed rewrite would destroy the only
+    good copy."""
+    from hydragnn_tpu.resilience import save_resume_bundle
+
+    d = str(tmp_path / "resume")
+    state = _tiny_state()
+    assert save_resume_bundle(state, {"epoch": 1, "items_consumed": 0},
+                              d, backoff=0.0)
+    # second save at the same step with a checkpoint layer that ALWAYS
+    # fails: the state save is skipped entirely (no delete, no write) and
+    # only the meta is rewritten, so the bundle stays valid
+    assert save_resume_bundle(state, {"epoch": 1, "items_consumed": 0},
+                              d, backoff=0.0, chaos=Chaos(ckpt_fails=99),
+                              reason="walltime")
+    bundle = load_resume_bundle(_tiny_state(), d)
+    assert bundle is not None
+    _, meta = bundle
+    assert meta["reason"] == "walltime" and meta["saved_step"] == 3
+
+
+def test_preempt_polled_during_resume_replay():
+    """A signal arriving while the resumed epoch replays (skips) already-
+    consumed items must stop at the SAME position, not wait for the
+    replay to finish."""
+    from hydragnn_tpu.train.trainer import _run_epoch
+
+    h = PreemptionHandler()
+    h.request()
+    consumed = {"n": 0}
+
+    class Loader:
+        def __iter__(self):
+            def gen():
+                for _ in range(6):
+                    consumed["n"] += 1
+                    yield _batch()
+            return gen()
+
+    def never_step(state, g):  # pragma: no cover - must not be reached
+        raise AssertionError("stepped during replay preemption")
+
+    _run_epoch(never_step, None, Loader(), True, preempt=h, skip_first=4)
+    assert h.stop_requested and h.consumed == 4
+    assert consumed["n"] == 1  # stopped at the first replayed item
+
+
+def test_torn_resume_bundle_is_ignored(tmp_path):
+    """meta written but state checkpoint missing/mismatched (a save that
+    died between the two writes) must fall back, not half-restore."""
+    d = str(tmp_path / "resume")
+    os.makedirs(d)
+    with open(os.path.join(d, "resume_meta.json"), "w") as f:
+        json.dump({"epoch": 1, "items_consumed": 2, "saved_step": 42}, f)
+    with pytest.warns(UserWarning, match="inconsistent"):
+        assert load_resume_bundle(_tiny_state(), d) is None
+
+
+def test_config_finalize_writes_resilience_defaults():
+    from hydragnn_tpu.config.config import DatasetStats, finalize
+
+    config = {"NeuralNetwork": {
+        "Architecture": {"model_type": "SAGE", "hidden_dim": 8,
+                         "num_conv_layers": 2, "output_heads": {}},
+        "Variables_of_interest": {"type": ["graph"], "output_index": [0],
+                                  "output_dim": [1],
+                                  "input_node_features": [0]},
+        "Training": {"num_epoch": 1, "batch_size": 4},
+    }}
+    out = finalize(config, DatasetStats(num_nodes_sample=10,
+                                        graph_size_variable=False))
+    tr = out["NeuralNetwork"]["Training"]
+    assert tr["nonfinite_guard"] == 0
+    assert tr["preemption"] == 1
+    assert tr["guard_max_consecutive"] == 5
+    assert tr["ckpt_retries"] == 3

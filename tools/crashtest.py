@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""crashtest: one-command kill-and-resume harness with a parity verdict.
+
+Proves the resilience contract end-to-end with a REAL signal: spawn a
+training run, SIGTERM it mid-epoch (the preemption handler saves a resume
+bundle and exits gracefully), resume it from the bundle, and compare the
+final params bit-for-bit against an uninterrupted run of the same config.
+
+Usage:
+    python tools/crashtest.py [--workdir DIR] [--epochs 6]
+        [--kill-delay 1.0]     seconds after the first epoch line to SIGTERM
+        [--chaos-step K]       deterministic injected preemption at train
+                               dispatch K instead of a wall-clock SIGTERM
+        [--mesh]               run the mesh-DP path (local devices)
+
+Exit code 0 and "PARITY PASS" when the resumed run's params are identical
+to the uninterrupted run's; non-zero otherwise.  Runs anywhere (CPU ok);
+each phase is a subprocess so the victim really dies and the resume really
+starts from a cold process (fresh jit caches, fresh orbax managers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# child: one training phase (baseline | victim | resume)
+# ---------------------------------------------------------------------------
+
+
+def _build(n_train: int, batch_size: int, epochs: int, mesh: bool):
+    import numpy as np
+
+    from hydragnn_tpu.data.dataloader import GraphDataLoader, pad_spec_for
+    from hydragnn_tpu.graph.batch import GraphSample, HeadSpec
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state
+
+    rng = np.random.RandomState(11)
+    samples = []
+    for _ in range(n_train + 16):
+        pos = rng.rand(12, 3).astype(np.float32) * 2.0
+        x = rng.rand(12, 1).astype(np.float32)
+        ei = radius_graph(pos, 1.2, 12)
+        samples.append(GraphSample(x=x, pos=pos, edge_index=ei,
+                                   graph_y=x.sum(keepdims=True)[0],
+                                   node_y=x))
+    heads = [HeadSpec("e", "graph", 1)]
+    pad = pad_spec_for(samples, batch_size)
+    mk = lambda split, shuffle: GraphDataLoader(  # noqa: E731
+        split, heads, batch_size, pad_spec=pad, shuffle=shuffle, seed=13)
+    loaders = (mk(samples[:n_train], True),
+               mk(samples[n_train:n_train + 8], False),
+               mk(samples[n_train + 8:], False))
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 0.01})
+    state = create_train_state(model, next(iter(loaders[0])), opt)
+    return model, cfg, opt, state, loaders
+
+
+def run_child(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+
+    from hydragnn_tpu.resilience import load_resume_bundle, resume_dir
+    from hydragnn_tpu.train.trainer import train_validate_test
+
+    n_train = 8 * args.batch_size if args.mesh else 6 * args.batch_size
+    model, cfg, opt, state, loaders = _build(
+        n_train, args.batch_size, args.epochs, args.mesh)
+    logs_dir = os.path.join(args.workdir, "logs")
+    log_name = "crashtest" if args.mode != "baseline" else "baseline"
+
+    resume_meta = None
+    if args.mode == "resume":
+        bundle = load_resume_bundle(state,
+                                    resume_dir(logs_dir, "crashtest"))
+        if bundle is None:
+            print("crashtest child: NO RESUME BUNDLE FOUND", flush=True)
+            return 3
+        state, resume_meta = bundle
+        print(f"crashtest child: resuming from epoch "
+              f"{resume_meta['epoch']} item "
+              f"{resume_meta['items_consumed']}", flush=True)
+
+    train_l, val_l, test_l = loaders
+    if args.epoch_sleep > 0 and args.mode == "victim":
+        # widen the mid-epoch window so the parent's SIGTERM lands there
+        class SlowLoader:
+            def __init__(self, loader, dt):
+                self.loader, self.dt = loader, dt
+
+            def set_epoch(self, e):
+                self.loader.set_epoch(e)
+
+            def __len__(self):
+                return len(self.loader)
+
+            def __iter__(self):
+                for b in self.loader:
+                    time.sleep(self.dt)
+                    yield b
+
+        train_l = SlowLoader(train_l, args.epoch_sleep)
+
+    state, history = train_validate_test(
+        model, cfg, state, opt, train_l, val_l, test_l,
+        {"Training": {"num_epoch": args.epochs},
+         "Variables_of_interest": {"output_names": ["e"]}},
+        log_name=log_name, verbosity=1, logs_dir=logs_dir,
+        use_mesh_dp=args.mesh, resume_meta=resume_meta)
+
+    final = os.path.join(args.workdir, f"{args.mode}_final.pk")
+    with open(final, "wb") as f:
+        pickle.dump(jax.device_get(
+            {"params": state.params, "opt_state": state.opt_state,
+             "step": state.step}), f)
+    print(f"crashtest child: {args.mode} done "
+          f"(preempted={bool(history.get('preempted'))}, "
+          f"epochs={len(history['train'])})", flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestrate baseline -> victim (killed) -> resume -> compare
+# ---------------------------------------------------------------------------
+
+
+def _spawn(args, mode, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               **(extra_env or {}))
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--mode", mode, "--workdir", args.workdir,
+           "--epochs", str(args.epochs),
+           "--batch-size", str(args.batch_size),
+           "--epoch-sleep", str(args.epoch_sleep)]
+    if args.mesh:
+        cmd.append("--mesh")
+    return subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _drain(proc, prefix):
+    for line in proc.stdout:
+        print(f"  [{prefix}] {line.rstrip()}")
+    return proc.wait()
+
+
+def run_parent(args) -> int:
+    os.makedirs(args.workdir, exist_ok=True)
+    print(f"crashtest: workdir {args.workdir}")
+
+    print("crashtest: phase 1/3 — uninterrupted baseline")
+    rc = _drain(_spawn(args, "baseline"), "baseline")
+    if rc != 0:
+        print(f"crashtest: baseline FAILED rc={rc}")
+        return rc
+
+    if args.chaos_step:
+        print(f"crashtest: phase 2/3 — victim with injected preemption at "
+              f"dispatch {args.chaos_step}")
+        victim = _spawn(args, "victim", extra_env={
+            "HYDRAGNN_CHAOS_PREEMPT_STEP": str(args.chaos_step)})
+        rc = _drain(victim, "victim")
+    else:
+        print("crashtest: phase 2/3 — victim, SIGTERM "
+              f"{args.kill_delay:.1f}s after its first epoch line")
+        victim = _spawn(args, "victim")
+        killed = False
+        for line in victim.stdout:
+            print(f"  [victim] {line.rstrip()}")
+            if not killed and line.lstrip().startswith("Epoch:"):
+                time.sleep(args.kill_delay)
+                victim.send_signal(signal.SIGTERM)
+                killed = True
+                print("  [parent] SIGTERM sent")
+        rc = victim.wait()
+        if not killed:
+            print("crashtest: victim finished before the kill — raise "
+                  "--epochs or --epoch-sleep")
+            return 4
+    if rc != 0:
+        print(f"crashtest: victim FAILED rc={rc} (expected graceful exit)")
+        return rc
+
+    bundle_meta = os.path.join(args.workdir, "logs", "crashtest", "resume",
+                               "resume_meta.json")
+    if not os.path.exists(bundle_meta):
+        print("crashtest: FAIL — victim exited without a resume bundle")
+        return 5
+
+    print("crashtest: phase 3/3 — resume from the bundle")
+    rc = _drain(_spawn(args, "resume"), "resume")
+    if rc != 0:
+        print(f"crashtest: resume FAILED rc={rc}")
+        return rc
+
+    import numpy as np
+
+    with open(os.path.join(args.workdir, "baseline_final.pk"), "rb") as f:
+        base = pickle.load(f)
+    with open(os.path.join(args.workdir, "resume_final.pk"), "rb") as f:
+        res = pickle.load(f)
+
+    import jax
+
+    lb = jax.tree_util.tree_leaves(base["params"])
+    lr_ = jax.tree_util.tree_leaves(res["params"])
+    mismatch = [i for i, (a, b) in enumerate(zip(lb, lr_))
+                if not np.array_equal(np.asarray(a), np.asarray(b))]
+    steps = (int(base["step"]), int(res["step"]))
+    if not mismatch and steps[0] == steps[1]:
+        print(f"crashtest: PARITY PASS — {len(lb)} param leaves identical, "
+              f"step {steps[0]} == {steps[1]}")
+        return 0
+    print(f"crashtest: PARITY FAIL — {len(mismatch)}/{len(lb)} param "
+          f"leaves differ, steps {steps[0]} vs {steps[1]}")
+    return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="/tmp/hydragnn_crashtest")
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--kill-delay", type=float, default=1.0)
+    ap.add_argument("--epoch-sleep", type=float, default=0.3,
+                    help="victim-only per-batch sleep widening the "
+                         "mid-epoch kill window")
+    ap.add_argument("--chaos-step", type=int, default=0,
+                    help="use injected preemption at this dispatch instead "
+                         "of a real SIGTERM (fully deterministic)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="exercise the mesh-DP path")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--mode", choices=("baseline", "victim", "resume"),
+                    default="baseline", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        return run_child(args)
+    return run_parent(args)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())

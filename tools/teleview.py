@@ -88,6 +88,34 @@ def step_rows(steps: List[Dict[str, Any]]) -> str:
                          "graphs/s", "pad_n%", "pad_e%", "mfu%"])
 
 
+def health_section(health: List[Dict[str, Any]],
+                   manifests: List[Dict[str, Any]]) -> str:
+    """Resilience health events (docs/RESILIENCE.md): skipped steps,
+    preemption saves, resumes, checkpoint retries.  Counts come from the
+    manifest when one exists (it tallies even sink-less ranks' events),
+    falling back to counting the health records themselves."""
+    counts: Dict[str, int] = {}
+    for m in manifests[-1:]:
+        counts = dict(m.get("health") or {})
+    if not counts:
+        # no manifest (run killed before finalize): rebuild the tally from
+        # the records; `count` carries multi-step events (K skipped steps
+        # in one scanned dispatch emit a single record with count=K)
+        for r in health:
+            k = str(r.get("kind"))
+            counts[k] = counts.get(k, 0) + int(r.get("count", 1) or 1)
+    lines = ["  " + "  ".join(f"{k}={counts[k]}" for k in sorted(counts))]
+    for r in health[-10:]:
+        kind = r.get("kind")
+        where = []
+        for f in ("epoch", "step", "items", "attempt", "what", "ok",
+                  "error", "consecutive"):
+            if r.get(f) is not None:
+                where.append(f"{f}={r[f]}")
+        lines.append(f"  {kind}: " + "  ".join(where))
+    return "\n".join(lines)
+
+
 def epoch_rows(epochs: List[Dict[str, Any]]) -> str:
     rows = []
     for r in epochs:
@@ -119,6 +147,7 @@ def main(argv=None) -> int:
     steps = [r for r in records if r.get("event") == "step"]
     epochs = [r for r in records if r.get("event") == "epoch"]
     manifests = [r for r in records if r.get("event") == "manifest"]
+    health = [r for r in records if r.get("event") == "health"]
 
     if args.json:
         sel = epochs if args.epochs else steps[-args.tail:] + epochs
@@ -127,13 +156,16 @@ def main(argv=None) -> int:
         return 0
 
     print(f"{path}: {len(steps)} step, {len(epochs)} epoch, "
-          f"{len(manifests)} manifest record(s)")
+          f"{len(health)} health, {len(manifests)} manifest record(s)")
     if steps and not args.epochs:
         print("\nlast steps:")
         print(step_rows(steps[-args.tail:]))
     if epochs:
         print("\nepochs:")
         print(epoch_rows(epochs))
+    if health or any(m.get("health") for m in manifests):
+        print("\nhealth:")
+        print(health_section(health, manifests))
     if manifests:
         m = manifests[-1]
         print(f"\nmanifest: run {m.get('run_id')}  "
