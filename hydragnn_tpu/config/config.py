@@ -169,6 +169,29 @@ def finalize(
     config.setdefault("Telemetry", {})
     for k, v in _telemetry_defaults().items():
         config["Telemetry"].setdefault(k, v)
+    # top-level Serving section (docs/SERVING.md): same contract — the
+    # saved config.json is what `python -m hydragnn_tpu.serve` later
+    # loads, so write the knob defaults back AND the dataset-derived
+    # per-graph worst case (the one piece of bucket sizing the serve-time
+    # process cannot know without the training data); env knobs overlay
+    # at ServingConfig.from_section.  Validation happens in the
+    # ServingConfig dataclass on every construction path.
+    from hydragnn_tpu.serve.config import serving_defaults
+
+    config.setdefault("Serving", {})
+    for k, v in serving_defaults().items():
+        config["Serving"].setdefault(k, v)
+    # unconditional, like edge_length_norm: the per-graph worst case is
+    # THIS run's dataset provenance — a value inherited from a reused
+    # config.json would size the serving buckets for the OLD dataset
+    # and 413-reject valid graphs (serve-time overrides go through
+    # HYDRAGNN_SERVE_MAX_NODES/_EDGES or editing the saved config)
+    if dataset_stats.max_nodes:
+        config["Serving"]["max_nodes_per_graph"] = int(
+            dataset_stats.max_nodes)
+    if dataset_stats.max_edges:
+        config["Serving"]["max_edges_per_graph"] = int(
+            dataset_stats.max_edges)
     # resilience knobs live in Training (they steer the trainer's step
     # builders and epoch driver); same defaults-written-back contract, env
     # knobs overlay at ResilienceConfig.from_training (docs/RESILIENCE.md)

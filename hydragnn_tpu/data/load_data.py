@@ -87,9 +87,32 @@ def dataset_loading_and_splitting(
         host_allreduce(np.zeros(1))  # barrier: wait for rank-0 serialization
 
     train_r, val_r, test_r = load_serialized_splits(config)
-    trainset = transform_raw_samples(train_r, config)
-    valset = transform_raw_samples(val_r, config)
-    testset = transform_raw_samples(test_r, config)
+    # ONE transform call over the concatenated splits: length edge
+    # features then share a single normalization constant (the
+    # reference's global all_reduce(MAX) semantics) instead of one
+    # per-split max, and that constant is recorded so the saved config's
+    # Serving section makes the online server normalize request edges
+    # identically (serve/server.py:sample_from_json)
+    tf_stats: Dict[str, Any] = {}
+    allsets = transform_raw_samples(
+        train_r + val_r + test_r, config, stats=tf_stats)
+    n_tr, n_va = len(train_r), len(val_r)
+    trainset = allsets[:n_tr]
+    valset = allsets[n_tr:n_tr + n_va]
+    testset = allsets[n_tr + n_va:]
+    if tf_stats.get("edge_build_max_neighbours"):
+        # ditto: the serve-time radius-graph rebuild must use the cap
+        # the transform used, not the PNA-finalized max_neighbours
+        config.setdefault("Serving", {})["edge_build_max_neighbours"] = (
+            tf_stats["edge_build_max_neighbours"])
+    if tf_stats.get("edge_length_norm"):
+        # unconditional: THIS run's features were normalized with THIS
+        # constant — a stale value inherited from a reused config.json
+        # would make the server normalize request edges with the wrong
+        # divisor (the HYDRAGNN_SERVE_EDGE_NORM env knob still overrides
+        # at serve time)
+        config.setdefault("Serving", {})["edge_length_norm"] = (
+            tf_stats["edge_length_norm"])
 
     need_deg = config["NeuralNetwork"]["Architecture"]["model_type"] == "PNA"
     stats = DatasetStats.from_samples(

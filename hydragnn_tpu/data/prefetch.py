@@ -24,6 +24,36 @@ from typing import Iterator, Optional
 from hydragnn_tpu.telemetry import pipeline as tele_pipe
 
 
+def drain_bounded_queue(q, sentinel, stop, on_item=None) -> None:
+    """Leak-safe shutdown of a bounded producer/consumer queue (the ONE
+    idiom shared by the prefetch loaders and the serving micro-batcher):
+    signal ``stop``, then swallow in-flight items on a daemon thread until
+    ``sentinel`` arrives, so a producer blocked on ``q.put`` can finish
+    and exit instead of leaking its thread (and whatever its items pin).
+
+    ``on_item`` releases per-item resources the abandonment would
+    otherwise leak (e.g. failing a pending request future so its waiter
+    unblocks).  Error-propagating producers may wrap the sentinel as
+    ``(sentinel, err)``; both forms terminate the drain.
+    """
+    stop.set()
+
+    def run():
+        while True:
+            item = q.get()
+            if item is sentinel or (
+                    isinstance(item, tuple) and len(item) == 2
+                    and item[0] is sentinel):
+                break
+            if on_item is not None:
+                try:
+                    on_item(item)
+                except Exception:  # noqa: BLE001 — release is best-effort
+                    pass
+
+    threading.Thread(target=run, daemon=True).start()
+
+
 def _make_stage(sharding=None):
     """Device-staging function shared by DevicePrefetcher and
     ResidentDeviceLoader: a jitted identity whose argument-ingest transfer
@@ -83,21 +113,6 @@ class DevicePrefetcher:
         self.sharding = sharding
         self._stage = None
 
-    @staticmethod
-    def _drain(q, done, stop):
-        """Unblock an abandoned producer: signal stop, then swallow the at
-        most `prefetch` items still in flight until the sentinel arrives."""
-        stop.set()
-
-        def run():
-            while True:
-                item = q.get()
-                if item is done or (
-                        isinstance(item, tuple) and len(item) == 2
-                        and item[0] is done):
-                    break
-        threading.Thread(target=run, daemon=True).start()
-
     def set_epoch(self, epoch: int) -> None:
         if hasattr(self.loader, "set_epoch"):
             self.loader.set_epoch(epoch)
@@ -151,7 +166,7 @@ class DevicePrefetcher:
             # abandoned mid-epoch (HYDRAGNN_MAX_NUM_BATCH caps): stop the
             # producer so the rest of the epoch is NOT collated/transferred
             # in the background
-            self._drain(q, done, stop)
+            drain_bounded_queue(q, done, stop)
             raise
 
 
@@ -327,7 +342,7 @@ class PrefetchLoader:
             # batch, or HYDRAGNN_MAX_NUM_BATCH): stop the producer so the
             # rest of the epoch is not collated in the background, then
             # drain the few in-flight items so it can exit
-            DevicePrefetcher._drain(q, done, stop)
+            drain_bounded_queue(q, done, stop)
             raise
 
 

@@ -43,6 +43,7 @@ def transform_raw_samples(
     records: Sequence[RawSample],
     config: Dict[str, Any],
     world_max_edge_length: Optional[float] = None,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> List[GraphSample]:
     """Build GraphSamples per the config's Architecture + Variables sections.
 
@@ -50,6 +51,12 @@ def transform_raw_samples(
     max (parity with the reference's all_reduce(MAX) edge normalization,
     serialized_dataset_loader.py:148-164); single-host callers leave it None
     and the local max is used.
+
+    ``stats``, when given, receives ``edge_length_norm`` — the
+    normalization constant actually applied to length edge features.
+    The data pipeline persists it into the config's ``Serving`` section
+    so the online server can normalize request edges identically
+    (hydragnn_tpu/serve/server.py:sample_from_json).
     """
     nn_sec = config["NeuralNetwork"]
     arch = nn_sec["Architecture"]
@@ -89,6 +96,13 @@ def transform_raw_samples(
 
     norm = world_max_edge_length if world_max_edge_length else max_len
     norm = norm or 1.0
+    if stats is not None:
+        if edge_feature_names:
+            stats["edge_length_norm"] = float(norm)
+        # the neighbor cap ACTUALLY used for graph building — finalize
+        # later overwrites arch.max_neighbours for PNA (degree-histogram
+        # length), so the saved config alone can't reproduce this build
+        stats["edge_build_max_neighbours"] = int(max_neigh)
 
     out: List[GraphSample] = []
     for rec, pos, edge_index, lengths in built:

@@ -16,13 +16,8 @@ from hydragnn_tpu.config.config import get_log_name_config
 from hydragnn_tpu.data.load_data import dataset_loading_and_splitting
 from hydragnn_tpu.models.base import ModelConfig
 from hydragnn_tpu.models.create import create_model
-from hydragnn_tpu.train.optimizer import select_optimizer
-from hydragnn_tpu.train.trainer import (
-    create_train_state,
-    load_state,
-    make_eval_step,
-    test,
-)
+from hydragnn_tpu.serve.engine import load_inference_state
+from hydragnn_tpu.train.trainer import make_eval_step, test
 
 
 @functools.singledispatch
@@ -57,11 +52,10 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
 
     cfg = ModelConfig.from_config(config["NeuralNetwork"])
     model = create_model(cfg)
-    opt_spec = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
-    example = next(iter(test_loader))
-    state = create_train_state(model, example, opt_spec, seed=seed)
-    log_name = get_log_name_config(config)
-    state = load_state(state, log_name, logs_dir)
+    # inference-only restore: params + batch_stats straight from the
+    # checkpoint — no optimizer init, no throwaway full train state
+    # (shared with the serving engine, hydragnn_tpu/serve/engine.py)
+    state = load_inference_state(config, logs_dir)
 
     eval_step = jax.jit(make_eval_step(model, cfg))
     error, tasks_error, true_values, predicted_values = test(
@@ -81,6 +75,8 @@ def _(config: dict, logs_dir: str = "./logs/", seed: int = 0):
     viz = config.get("Visualization", {})
     if viz.get("create_plots") and rank == 0:
         from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+        log_name = get_log_name_config(config)
 
         var = config["NeuralNetwork"]["Variables_of_interest"]
         names = var.get("output_names",

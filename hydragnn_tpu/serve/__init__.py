@@ -1,0 +1,44 @@
+"""Online inference serving subsystem (docs/SERVING.md).
+
+Checkpoint -> :func:`load_inference_state` (params + batch_stats, no
+optimizer) -> :class:`InferenceEngine` (bucketed AOT compile cache) ->
+:class:`MicroBatcher` (fill-or-deadline dynamic micro-batching) ->
+:class:`InferenceServer` (stdlib HTTP: /predict, /healthz, /metrics,
+graceful SIGTERM drain).  ``python -m hydragnn_tpu.serve`` runs a server
+from a trained run's saved config.json.
+
+Exports resolve lazily (PEP 562): ``config.finalize`` imports
+``serve.config`` for the written-back Serving defaults, and that must
+not drag the engine/server stack (flax, http.server, the trainer) into
+every config-only caller.
+"""
+
+_EXPORTS = {
+    "BatcherClosedError": "hydragnn_tpu.serve.batcher",
+    "MicroBatcher": "hydragnn_tpu.serve.batcher",
+    "QueueFullError": "hydragnn_tpu.serve.batcher",
+    "ServingConfig": "hydragnn_tpu.serve.config",
+    "serving_defaults": "hydragnn_tpu.serve.config",
+    "BucketOverflowError": "hydragnn_tpu.serve.engine",
+    "InferenceEngine": "hydragnn_tpu.serve.engine",
+    "InferenceState": "hydragnn_tpu.serve.engine",
+    "load_inference_state": "hydragnn_tpu.serve.engine",
+    "InferenceServer": "hydragnn_tpu.serve.server",
+    "sample_from_json": "hydragnn_tpu.serve.server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module 'hydragnn_tpu.serve' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

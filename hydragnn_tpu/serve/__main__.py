@@ -1,0 +1,61 @@
+"""Serve a trained run over HTTP.
+
+    python -m hydragnn_tpu.serve --config logs/<run>/config.json \
+        [--logs-dir ./logs/] [--host H] [--port P]
+
+``--config`` is the FINALIZED config run_training saved next to the
+checkpoint (it carries output dims, head layout and the written-back
+``Serving`` section).  Per-graph bucket sizing must be present —
+``Serving.max_nodes_per_graph``/``max_edges_per_graph`` in the config or
+the ``HYDRAGNN_SERVE_MAX_NODES``/``HYDRAGNN_SERVE_MAX_EDGES`` env knobs.
+Telemetry env knobs (HYDRAGNN_TELEMETRY=1 etc.) give the server a JSONL
+event log viewable with tools/teleview.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", required=True,
+                    help="finalized config.json from a trained run's log dir")
+    ap.add_argument("--logs-dir", default="./logs/",
+                    help="logs root holding the checkpoint (default ./logs/)")
+    ap.add_argument("--host", default=None, help="bind host override")
+    ap.add_argument("--port", type=int, default=None,
+                    help="bind port override")
+    args = ap.parse_args(argv)
+
+    with open(args.config) as f:
+        config = json.load(f)
+
+    from hydragnn_tpu.serve import InferenceEngine, InferenceServer, \
+        ServingConfig
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    serving = ServingConfig.from_section(config.get("Serving"))
+    if args.host is not None:
+        serving.host = args.host
+    if args.port is not None:
+        serving.port = args.port
+    telemetry = MetricsLogger.from_env(run_name="serve")
+    engine = InferenceEngine.from_config(
+        config, logs_dir=args.logs_dir, serving=serving, telemetry=telemetry)
+    server = InferenceServer(engine, serving=serving)
+    print(f"serving on http://{serving.host}:{server.port}  "
+          f"(buckets: {[p.num_graphs - 1 for p in engine.pad_specs]}, "
+          f"max_wait {serving.max_wait_ms} ms) — SIGTERM drains gracefully",
+          flush=True)
+    try:
+        server.run()
+    finally:
+        telemetry.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

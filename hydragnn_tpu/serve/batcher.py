@@ -1,0 +1,319 @@
+"""Dynamic micro-batcher: accumulate requests until a bucket fills or a
+deadline fires.
+
+Online traffic arrives one graph at a time; the accelerator wants padded
+batches.  The batcher bridges the two with the classic
+fill-or-deadline policy:
+
+- requests land in a BOUNDED thread-safe queue (beyond ``max_queue`` the
+  submit is rejected — backpressure instead of unbounded latency);
+- a single worker thread groups consecutive requests until either the
+  group would no longer fit the largest bucket (``full`` flush — zero
+  added latency beyond the step time) or ``max_wait_ms`` has elapsed
+  since the OLDEST request in the group was enqueued (``deadline``
+  flush — the latency bound);
+- each flush picks the smallest bucket that fits (minimum padding
+  waste), runs one engine prediction, and resolves the per-request
+  futures.
+
+Why one worker: JAX dispatch is serialized per device anyway, and a
+single consumer keeps request ordering and makes the shutdown drain
+trivially correct.  Shutdown reuses the bounded-queue drain idiom shared
+with the prefetch loaders (data/prefetch.py:drain_bounded_queue): a
+sentinel closes the stream FIFO, so everything enqueued before close is
+served, and the force path fails leftover futures instead of leaking
+blocked clients.
+
+Telemetry: request_enqueued / batch_flushed / deadline_flush health
+events through the shared MetricsLogger (docs/TELEMETRY.md "Serving
+events"); fill % and padding % ride the batch_flushed records.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from hydragnn_tpu.data.prefetch import drain_bounded_queue
+from hydragnn_tpu.graph.batch import GraphSample
+
+_SENTINEL = object()
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue is at capacity (HTTP layer: 503)."""
+
+
+class BatcherClosedError(RuntimeError):
+    """Submit after close, or the request was dropped by a forced
+    shutdown."""
+
+
+class _Request:
+    __slots__ = ("sample", "future", "t_enq")
+
+    def __init__(self, sample: GraphSample):
+        self.sample = sample
+        self.future: Future = Future()
+        self.t_enq = time.perf_counter()
+
+
+class MicroBatcher:
+    def __init__(self, engine, max_wait_ms: float = 20.0,
+                 max_queue: int = 1024, telemetry=None):
+        self.engine = engine
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(max_queue)))
+        self.telemetry = telemetry if telemetry is not None \
+            else engine.telemetry
+        self._stop = threading.Event()    # force-exit signal (no drain)
+        self._closed = threading.Event()  # no new submits
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._n = {"requests": 0, "rejected": 0, "batches": 0,
+                   "full_flushes": 0, "deadline_flushes": 0,
+                   "drain_flushes": 0, "errors": 0}
+        self._fill_sum = 0.0
+        self._pad_nodes_sum = 0.0
+        self._predict_ms_sum = 0.0
+        self._predict_ms_max = 0.0
+
+    # -- producer side -------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="micro-batcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def submit(self, sample: GraphSample) -> Future:
+        """Enqueue one request; the returned future resolves to the
+        engine's per-sample result dict ``{head_name: array}``."""
+        if self._closed.is_set():
+            raise BatcherClosedError("batcher is shut down")
+        # reject single requests that can never be batched
+        if not self.engine.fits([sample]):
+            from hydragnn_tpu.serve.engine import BucketOverflowError
+
+            raise BucketOverflowError(
+                f"graph with {sample.num_nodes} nodes / {sample.num_edges} "
+                "edges exceeds the largest serving bucket")
+        req = _Request(sample)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._lock:
+                self._n["rejected"] += 1
+            raise QueueFullError(
+                f"request queue at capacity ({self._q.maxsize})") from None
+        if self._closed.is_set() and self._thread is None:
+            # raced close(): the worker is already gone and its final
+            # sweep may have run before our put — fail fast (the caller
+            # sees the exception through the future) instead of letting
+            # the client wait out its timeout
+            self._sweep_leftovers()
+            return req.future
+        with self._lock:
+            self._n["requests"] += 1
+        self.telemetry.health("request_enqueued", depth=self._q.qsize())
+        return req.future
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self) -> None:
+        pending: Optional[_Request] = None  # didn't fit the last group
+        while not self._stop.is_set():
+            if pending is not None:
+                first, pending = pending, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    if self._closed.is_set():
+                        break
+                    continue
+                if first is _SENTINEL:
+                    break
+            group = [first]
+            # running totals for O(1) admission (re-summing the group
+            # per arrival would be O(n^2) per flush on the hot path)
+            g_nodes = first.sample.num_nodes
+            g_edges = first.sample.num_edges
+            top = self.engine.pad_specs[-1]
+            deadline = first.t_enq + self.max_wait_s
+            reason = "deadline"
+            got_sentinel = False
+            while True:
+                if self._stop.is_set() or self._closed.is_set():
+                    # draining: serve what we have NOW, don't wait out
+                    # the deadline
+                    reason = "drain"
+                    break
+                if len(group) >= self.engine.max_batch_graphs:
+                    reason = "full"
+                    break
+                remaining = deadline - time.perf_counter()
+                try:
+                    if remaining > 0:
+                        item = self._q.get(timeout=remaining)
+                    else:
+                        # deadline already passed (the queue backed up
+                        # while we served earlier batches): keep
+                        # gathering whatever is ALREADY queued without
+                        # blocking, so a backlog still forms full
+                        # buckets instead of degenerate size-1 flushes
+                        item = self._q.get_nowait()
+                except queue.Empty:
+                    reason = "deadline"
+                    break
+                if item is _SENTINEL:
+                    got_sentinel = True
+                    reason = "drain"
+                    break
+                # largest-bucket bounds, same slot conventions as
+                # engine.select_bucket (collate reserves one node slot
+                # and the padding-graph slot)
+                if (g_nodes + item.sample.num_nodes > top.num_nodes - 1
+                        or g_edges + item.sample.num_edges > top.num_edges):
+                    pending = item
+                    reason = "full"
+                    break
+                group.append(item)
+                g_nodes += item.sample.num_nodes
+                g_edges += item.sample.num_edges
+            self._flush(group, reason)
+            if got_sentinel:
+                break
+        if pending is not None:
+            self._fail(pending)
+
+    def _flush(self, group: List[_Request], reason: str) -> None:
+        samples = [r.sample for r in group]
+        t0 = time.perf_counter()
+        try:
+            spec = self.engine.select_bucket(samples)
+            results = self.engine.predict_samples(samples)
+        except Exception as e:  # noqa: BLE001 — surfaced per request
+            with self._lock:
+                self._n["errors"] += 1
+                self._n["batches"] += 1
+            self.telemetry.health("batch_error", n=len(group),
+                                  error=repr(e))
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        predict_ms = (time.perf_counter() - t0) * 1e3
+        for r, res in zip(group, results):
+            if not r.future.done():
+                r.future.set_result(res)
+        fill_pct = 100.0 * len(group) / max(spec.num_graphs - 1, 1)
+        real_nodes = sum(s.num_nodes for s in samples)
+        pad_nodes_pct = 100.0 * (1.0 - real_nodes / max(spec.num_nodes, 1))
+        wait_ms = (t0 - group[0].t_enq) * 1e3
+        with self._lock:
+            self._n["batches"] += 1
+            self._n[f"{reason}_flushes"] += 1
+            self._fill_sum += fill_pct
+            self._pad_nodes_sum += pad_nodes_pct
+            self._predict_ms_sum += predict_ms
+            self._predict_ms_max = max(self._predict_ms_max, predict_ms)
+        self.telemetry.health(
+            "batch_flushed", n=len(group), reason=reason,
+            fill_pct=round(fill_pct, 2),
+            pad_nodes_pct=round(pad_nodes_pct, 2),
+            wait_ms=round(wait_ms, 3), predict_ms=round(predict_ms, 3))
+        if reason == "deadline":
+            self.telemetry.health("deadline_flush", n=len(group),
+                                  wait_ms=round(wait_ms, 3))
+
+    def _fail(self, item) -> None:
+        if isinstance(item, _Request) and not item.future.done():
+            item.future.set_exception(
+                BatcherClosedError("batcher closed before the request was "
+                                   "served"))
+
+    def _sweep_leftovers(self) -> None:
+        """Fail any request still queued after the worker exited (a
+        submit racing close() can land one behind the drain sentinel)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not _SENTINEL:
+                self._fail(item)
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop accepting requests and shut the worker down.
+
+        ``drain=True``: a sentinel closes the queue FIFO — everything
+        enqueued before the close is flushed (immediately, not waiting
+        out deadlines) and answered; bounded by ``timeout``.  On timeout
+        (or ``drain=False``) the shared drain helper unblocks any stuck
+        producer and fails leftover futures so no client waits forever.
+        """
+        if self._closed.is_set() and self._thread is None:
+            return
+        self._closed.set()
+        t = self._thread
+        if t is None:
+            # never started: fail whatever was queued
+            drain_bounded_queue(self._q, _SENTINEL, self._stop,
+                                on_item=self._fail)
+            self._q.put(_SENTINEL)
+            return
+        if drain:
+            try:
+                self._q.put(_SENTINEL, timeout=1.0)
+            except queue.Full:
+                pass  # worker is behind; the force path below cleans up
+            t.join(timeout=timeout)
+        if not drain or t.is_alive():
+            # force path: stop flag + sentinel wake a blocked worker; it
+            # drain-flushes its current group and exits at the next check
+            self._stop.set()
+            try:
+                self._q.put_nowait(_SENTINEL)
+            except queue.Full:
+                pass  # a full queue means the worker has items to wake on
+            t.join(timeout=max(1.0, self.max_wait_s + 1.0))
+            if t.is_alive():
+                # worker is stuck inside a long predict: hand the queue
+                # to the background drain helper (leak-safe shutdown —
+                # same idiom as the prefetch loaders).  TWO sentinels:
+                # the stuck worker, if it ever revives, may consume one
+                # — the second still terminates the drain daemon (any
+                # leftover sentinel is swallowed by the final sweep).
+                drain_bounded_queue(self._q, _SENTINEL, self._stop,
+                                    on_item=self._fail)
+                self._q.put(_SENTINEL)
+                self._q.put(_SENTINEL)
+        self._thread = None
+        # catch stragglers a racing submit slipped behind the sentinel
+        # (also consumes stray sentinels left in the queue)
+        self._sweep_leftovers()
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            nb = self._n["batches"]
+            ok = max(nb - self._n["errors"], 0)
+            return {
+                **self._n,
+                "queue_depth": self._q.qsize(),
+                "max_wait_ms": self.max_wait_s * 1e3,
+                "avg_fill_pct": (self._fill_sum / ok) if ok else 0.0,
+                "avg_pad_nodes_pct": (self._pad_nodes_sum / ok) if ok
+                                     else 0.0,
+                "avg_predict_ms": (self._predict_ms_sum / ok) if ok else 0.0,
+                "max_predict_ms": self._predict_ms_max,
+            }

@@ -1,0 +1,155 @@
+"""Serving knobs: ``Serving`` config section keys + env overrides.
+
+Same layering as telemetry (telemetry/logger.py:TelemetryConfig) and
+resilience (resilience/config.py): the dataclass is the single default
+source, config.finalize writes the defaults back into the saved
+config.json, and a user-set ``HYDRAGNN_SERVE_*`` env knob wins over the
+config so a deployed server can be retuned without a config edit.
+
+The bucket ladder is the serving analog of the training loader's
+``bucket_pad_specs``: a short sorted list of batch capacities, each
+compiled once at startup (AOT warmup), so steady-state traffic never
+recompiles — the same static-shape discipline that makes the train step
+compile exactly once per bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from hydragnn_tpu.utils.env import env_int
+
+
+def _parse_buckets(v) -> Tuple[int, ...]:
+    if isinstance(v, str):
+        v = [x.strip() for x in v.split(",") if x.strip()]
+    return tuple(int(x) for x in v)
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Parsed ``Serving`` config section + env knobs (env wins).
+
+    Env knobs: HYDRAGNN_SERVE_BUCKETS (comma list of batch capacities),
+    HYDRAGNN_SERVE_MAX_NODES / HYDRAGNN_SERVE_MAX_EDGES (per-graph
+    worst case, sizes the bucket PadSpecs), HYDRAGNN_SERVE_EDGE_NORM,
+    HYDRAGNN_SERVE_MAX_WAIT_MS, HYDRAGNN_SERVE_QUEUE,
+    HYDRAGNN_SERVE_HOST, HYDRAGNN_SERVE_PORT, HYDRAGNN_SERVE_DRAIN_S.
+    """
+
+    # batch-capacity ladder (graphs per bucket), ascending; each entry
+    # becomes one precompiled PadSpec bucket
+    buckets: Tuple[int, ...] = (1, 4, 16)
+    # per-graph worst case used to size the bucket PadSpecs; 0 = unset
+    # (must come from config/env/dataset before an engine can be built)
+    max_nodes_per_graph: int = 0
+    max_edges_per_graph: int = 0
+    # the neighbor cap the TRAINING transform built graphs with (raw
+    # config value or its 100 default) — for PNA, finalize overwrites
+    # Architecture.max_neighbours with the degree-histogram length, so
+    # the server must not rebuild graphs from that.  0 = fall back to
+    # the model config's value.  Written by the data pipeline.
+    edge_build_max_neighbours: int = 0
+    # the training dataset's max edge length — the normalization constant
+    # of length edge features (edge_attr = lengths / norm in
+    # data/transform.py).  0 = unset: requests to edge-feature models
+    # must then carry a pre-normalized edge_attr.  Written into the
+    # saved config.json by the data pipeline.
+    edge_length_norm: float = 0.0
+    # micro-batching: flush when a bucket fills or this deadline fires
+    max_wait_ms: float = 20.0
+    # bounded request queue; submits beyond this are rejected (503)
+    max_queue: int = 1024
+    host: str = "127.0.0.1"
+    port: int = 8808
+    # graceful-shutdown budget: how long close() waits for the queue to
+    # drain before failing the leftovers
+    drain_timeout_s: float = 10.0
+
+    def __post_init__(self):
+        self.buckets = _parse_buckets(self.buckets)
+        if not self.buckets or any(int(b) < 1 for b in self.buckets):
+            raise ValueError(
+                f"Serving.buckets must be positive batch capacities, "
+                f"got {self.buckets!r}")
+        if tuple(sorted(self.buckets)) != self.buckets:
+            raise ValueError(
+                f"Serving.buckets must be ascending, got {self.buckets!r}")
+        if len(set(self.buckets)) != len(self.buckets):
+            raise ValueError(
+                f"Serving.buckets must be unique, got {self.buckets!r}")
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"Serving.max_wait_ms must be >= 0, got {self.max_wait_ms}")
+        if self.max_queue < 1:
+            raise ValueError(
+                f"Serving.max_queue must be >= 1, got {self.max_queue}")
+        if not (0 <= int(self.port) <= 65535):
+            raise ValueError(f"Serving.port out of range: {self.port}")
+
+    @classmethod
+    def from_section(cls,
+                     section: Optional[Dict[str, Any]]) -> "ServingConfig":
+        s = dict(section or {})
+        d = cls()
+        cfg = cls(
+            buckets=_parse_buckets(s.get("buckets", d.buckets)),
+            max_nodes_per_graph=int(s.get("max_nodes_per_graph",
+                                          d.max_nodes_per_graph)),
+            max_edges_per_graph=int(s.get("max_edges_per_graph",
+                                          d.max_edges_per_graph)),
+            edge_build_max_neighbours=int(s.get(
+                "edge_build_max_neighbours", d.edge_build_max_neighbours)),
+            edge_length_norm=float(s.get("edge_length_norm",
+                                         d.edge_length_norm)),
+            max_wait_ms=float(s.get("max_wait_ms", d.max_wait_ms)),
+            max_queue=int(s.get("max_queue", d.max_queue)),
+            host=str(s.get("host", d.host)),
+            port=int(s.get("port", d.port)),
+            drain_timeout_s=float(s.get("drain_timeout_s",
+                                        d.drain_timeout_s)),
+        )
+        if "HYDRAGNN_SERVE_BUCKETS" in os.environ:
+            cfg.buckets = _parse_buckets(os.environ["HYDRAGNN_SERVE_BUCKETS"])
+        if "HYDRAGNN_SERVE_MAX_NODES" in os.environ:
+            cfg.max_nodes_per_graph = env_int("HYDRAGNN_SERVE_MAX_NODES", 0)
+        if "HYDRAGNN_SERVE_MAX_EDGES" in os.environ:
+            cfg.max_edges_per_graph = env_int("HYDRAGNN_SERVE_MAX_EDGES", 0)
+        if "HYDRAGNN_SERVE_EDGE_NORM" in os.environ:
+            cfg.edge_length_norm = float(
+                os.environ["HYDRAGNN_SERVE_EDGE_NORM"])
+        if "HYDRAGNN_SERVE_MAX_WAIT_MS" in os.environ:
+            cfg.max_wait_ms = float(os.environ["HYDRAGNN_SERVE_MAX_WAIT_MS"])
+        if "HYDRAGNN_SERVE_QUEUE" in os.environ:
+            cfg.max_queue = env_int("HYDRAGNN_SERVE_QUEUE", d.max_queue)
+        if "HYDRAGNN_SERVE_HOST" in os.environ:
+            cfg.host = os.environ["HYDRAGNN_SERVE_HOST"]
+        if "HYDRAGNN_SERVE_PORT" in os.environ:
+            cfg.port = env_int("HYDRAGNN_SERVE_PORT", d.port)
+        if "HYDRAGNN_SERVE_DRAIN_S" in os.environ:
+            cfg.drain_timeout_s = float(os.environ["HYDRAGNN_SERVE_DRAIN_S"])
+        # re-validate after the env overlay (the dataclass validated the
+        # config values; env strings can be just as wrong)
+        cfg.__post_init__()
+        return cfg
+
+
+def serving_defaults() -> Dict[str, Any]:
+    """Top-level ``Serving`` section defaults written back by
+    config.finalize, so a saved config.json documents the run's serving
+    settings (docs/SERVING.md)."""
+    d = ServingConfig()
+    return {
+        "buckets": ",".join(str(b) for b in d.buckets),
+        "max_nodes_per_graph": d.max_nodes_per_graph,
+        "max_edges_per_graph": d.max_edges_per_graph,
+        "edge_build_max_neighbours": d.edge_build_max_neighbours,
+        "edge_length_norm": d.edge_length_norm,
+        "max_wait_ms": d.max_wait_ms,
+        "max_queue": d.max_queue,
+        "host": d.host,
+        "port": d.port,
+        "drain_timeout_s": d.drain_timeout_s,
+    }

@@ -1,0 +1,381 @@
+"""Online inference engine: checkpoint -> bucketed AOT-compiled eval.
+
+Three properties distinguish this from the offline ``run_prediction``
+evaluator:
+
+- **Inference-only state.**  :func:`load_inference_state` reads the
+  checkpoint pickle straight into params + batch_stats — no optimizer
+  init, no training-dataset rebuild (the reference pattern rebuilt the
+  ENTIRE train state just to run a forward pass;
+  run_prediction now calls this same function).
+
+- **Bucketed AOT executable cache.**  The engine precompiles the eval
+  step for a ladder of PadSpec buckets at startup (``warmup``) and keeps
+  the compiled executables keyed by bucket shape, with hit/miss
+  counters.  Steady-state traffic therefore NEVER recompiles: every
+  request batch is padded to one of the known buckets and dispatched
+  straight to a cached executable — the same static-shape discipline
+  that makes the train step compile once per bucket.
+
+- **Bit-identical outputs.**  The compiled program is exactly the
+  ``make_eval_step`` program ``run_prediction`` jits, fed batches built
+  by the same ``collate`` — so for the same checkpoint, the same graphs
+  and the same PadSpec, predictions match run_prediction bit for bit
+  (tier-1 parity test in tests/test_serve.py).
+
+Buffer donation: on accelerator backends the request batch's device
+buffers are donated to the executable (they are fresh per request and
+dead after the call); CPU has no donation support, so the flag is
+dropped there to keep smoke runs warning-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+from flax import struct
+
+from hydragnn_tpu.config.config import (
+    get_log_name_config,
+    head_specs_from_config,
+)
+from hydragnn_tpu.graph.batch import (
+    GraphBatch,
+    GraphSample,
+    HeadSpec,
+    PadSpec,
+    collate,
+)
+from hydragnn_tpu.models.base import ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.serve.config import ServingConfig
+from hydragnn_tpu.train.trainer import make_eval_step
+
+
+class BucketOverflowError(ValueError):
+    """The request (or batch) exceeds the largest configured bucket."""
+
+
+def load_inference_state(config, logs_dir: str = "./logs/"):
+    """Load a run's checkpoint into an inference-only state.
+
+    Reads the single-file checkpoint ``run_training`` saves
+    (``logs/<log_name>/<log_name>.pk``) and keeps only what a forward
+    pass needs — params + batch_stats (+ the step counter for
+    provenance).  No optimizer state is constructed and no dataset is
+    loaded, unlike the old eval path that built a full train state
+    (optimizer init included) just to overwrite it.
+
+    ``config`` is a config dict (raw or finalized — the log name uses
+    only raw fields) or a path to one.  Returns an :class:`InferenceState`
+    whose ``params``/``batch_stats`` attributes satisfy every eval-side
+    consumer of a TrainState (``make_eval_step``, ``test``).
+    """
+    import jax.numpy as jnp
+
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    log_name = get_log_name_config(config)
+    fname = os.path.join(logs_dir, log_name, f"{log_name}.pk")
+    with open(fname, "rb") as f:
+        payload = pickle.load(f)
+    return InferenceState(
+        step=jnp.asarray(payload["step"]),
+        params=payload["params"],
+        batch_stats=payload["batch_stats"],
+    )
+
+
+# flax.struct so the state is a pytree (jit-traceable like TrainState)
+@struct.dataclass
+class InferenceState:
+    """Eval-only slice of a TrainState: no optimizer state."""
+
+    step: Any
+    params: Any
+    batch_stats: Any
+
+
+class InferenceEngine:
+    """Checkpointed model + bucketed compile cache + output unpacking.
+
+    Thread-safe for concurrent ``predict_samples`` calls (the compile
+    cache and counters are lock-guarded; JAX execution itself is
+    thread-safe), though the intended topology is ONE MicroBatcher
+    worker feeding it (serve/batcher.py).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        state: InferenceState,
+        head_specs: Sequence[HeadSpec],
+        pad_specs: Sequence[PadSpec],
+        serving: Optional[ServingConfig] = None,
+        telemetry=None,
+        y_minmax: Optional[Sequence[Sequence[float]]] = None,
+        post_collate=None,
+        pbc: bool = False,
+    ):
+        import jax
+
+        self.cfg = cfg
+        self.model = create_model(cfg)
+        # stage the weights on device ONCE: the pickled state is host
+        # numpy, and passing it per call would re-upload the full param
+        # tree H2D on every request batch (state is argument 0 — never
+        # donated — so the staged buffers live for the engine lifetime)
+        self.state = jax.device_put(state)
+        self.head_specs = list(head_specs)
+        if not pad_specs:
+            raise ValueError("InferenceEngine needs at least one PadSpec "
+                             "bucket")
+        self.pad_specs = sorted(pad_specs, key=lambda p: (p.num_nodes,
+                                                          p.num_edges,
+                                                          p.num_graphs))
+        self.serving = serving or ServingConfig()
+        if telemetry is None:
+            from hydragnn_tpu.telemetry import MetricsLogger
+
+            telemetry = MetricsLogger.disabled()
+        self.telemetry = telemetry
+        self.y_minmax = y_minmax
+        self.post_collate = post_collate
+        # periodic models need cell-aware neighbor lists the HTTP layer
+        # cannot rebuild — the server rejects edge_index-less requests
+        self.pbc = bool(pbc)
+        # donate the per-request batch buffers (fresh every call, dead
+        # after it); CPU has no donation — drop the flag so smoke tests
+        # don't spray "donated buffers were not usable" warnings
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._eval = jax.jit(make_eval_step(self.model, cfg),
+                             donate_argnums=donate)
+        self._compiled: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._warmup_compiles = 0
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, config, logs_dir: str = "./logs/",
+                    serving: Optional[ServingConfig] = None,
+                    telemetry=None, state: Optional[InferenceState] = None,
+                    post_collate=None) -> "InferenceEngine":
+        """Build from a FINALIZED config (e.g. the config.json that
+        run_training saved next to the checkpoint) + the checkpoint it
+        points at."""
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        arch = config["NeuralNetwork"]["Architecture"]
+        if "output_dim" not in arch or "input_dim" not in arch:
+            raise ValueError(
+                "InferenceEngine.from_config needs a FINALIZED config "
+                "(output_dim/input_dim present) — use the config.json "
+                "run_training saved in the log directory")
+        cfg = ModelConfig.from_config(config["NeuralNetwork"])
+        if cfg.model_type == "DimeNet" and post_collate is None:
+            # DimeNet consumes a static padded triplet table attached at
+            # collate time (data/load_data.py sizes it from the training
+            # dataset); that sizing is not yet persisted into the saved
+            # config, so config-only serving would crash in warmup with
+            # a missing dn_idx_i extra — fail loud and early instead
+            raise ValueError(
+                "DimeNet serving needs the triplet-table post_collate "
+                "hook: construct InferenceEngine directly with "
+                "post_collate=add_dimenet_extras(...) (config-only "
+                "DimeNet serving is open follow-on work)")
+        if state is None:
+            state = load_inference_state(config, logs_dir)
+        serving = serving or ServingConfig.from_section(config.get("Serving"))
+        if serving.max_nodes_per_graph < 1 or serving.max_edges_per_graph < 1:
+            raise ValueError(
+                "serving bucket sizing needs the per-graph worst case: set "
+                "Serving.max_nodes_per_graph / max_edges_per_graph (or "
+                "HYDRAGNN_SERVE_MAX_NODES / HYDRAGNN_SERVE_MAX_EDGES)")
+        pad_specs = [
+            PadSpec.for_batch(b, serving.max_nodes_per_graph,
+                              serving.max_edges_per_graph)
+            for b in serving.buckets
+        ]
+        var = config["NeuralNetwork"]["Variables_of_interest"]
+        y_minmax = var.get("y_minmax") if var.get("denormalize_output") \
+            else None
+        return cls(cfg, state, head_specs_from_config(config), pad_specs,
+                   serving=serving, telemetry=telemetry, y_minmax=y_minmax,
+                   post_collate=post_collate,
+                   pbc=bool(arch.get("periodic_boundary_conditions")))
+
+    # -- bucket selection ----------------------------------------------------
+
+    def _needs(self, samples: Sequence[GraphSample]):
+        return (len(samples),
+                sum(s.num_nodes for s in samples),
+                sum(s.num_edges for s in samples))
+
+    def select_bucket(self, samples: Sequence[GraphSample]) -> PadSpec:
+        """Smallest bucket that fits (min padding waste; same rule as the
+        training loader's ``_pick_spec`` plus the graph-count bound)."""
+        ng, nn, ne = self._needs(samples)
+        for spec in self.pad_specs:
+            if (spec.num_graphs - 1 >= ng and spec.num_nodes - 1 >= nn
+                    and spec.num_edges >= ne):
+                return spec
+        raise BucketOverflowError(
+            f"batch of {ng} graphs / {nn} nodes / {ne} edges exceeds the "
+            f"largest bucket (graphs {self.pad_specs[-1].num_graphs - 1}, "
+            f"nodes {self.pad_specs[-1].num_nodes - 1}, "
+            f"edges {self.pad_specs[-1].num_edges})")
+
+    def fits(self, samples: Sequence[GraphSample]) -> bool:
+        """Does this group fit SOME bucket (the batcher's accumulate-more
+        check)?"""
+        ng, nn, ne = self._needs(samples)
+        top = self.pad_specs[-1]
+        return (top.num_graphs - 1 >= ng and top.num_nodes - 1 >= nn
+                and top.num_edges >= ne)
+
+    @property
+    def max_batch_graphs(self) -> int:
+        return self.pad_specs[-1].num_graphs - 1
+
+    # -- compile cache -------------------------------------------------------
+
+    def _zero_sample(self) -> GraphSample:
+        """One-node self-loop dummy whose collated batch has the same
+        pytree structure as request batches (feature dims, edge_attr
+        presence) — what warmup lowers against."""
+        ea = (np.zeros((1, self.cfg.edge_dim), np.float32)
+              if self.cfg.use_edge_attr else None)
+        return GraphSample(
+            x=np.zeros((1, self.cfg.input_dim), np.float32),
+            pos=np.zeros((1, 3), np.float32),
+            edge_index=np.zeros((2, 1), np.int32),
+            edge_attr=ea,
+        )
+
+    def _collate(self, samples: Sequence[GraphSample],
+                 spec: PadSpec) -> GraphBatch:
+        batch = collate(samples, spec, self.head_specs)
+        if self.post_collate is not None:
+            batch = self.post_collate(batch)
+        if "edge_perm_sender" in batch.extras:
+            # volatile extra: the fused-backend marker attaches per batch
+            # (sorted-receiver check) — request-dependent keys would break
+            # the compiled executable's fixed input structure, so serving
+            # always takes the XLA aggregation path
+            extras = dict(batch.extras)
+            extras.pop("edge_perm_sender")
+            batch = batch.replace(extras=extras)
+        return batch
+
+    def _executable(self, spec: PadSpec, batch: Optional[GraphBatch] = None,
+                    warmup: bool = False):
+        """Compiled eval executable for one bucket; compiles AOT on first
+        sighting (counted as warmup or cache_miss), cache hit thereafter."""
+        key = (spec.num_nodes, spec.num_edges, spec.num_graphs)
+        with self._lock:
+            exe = self._compiled.get(key)
+            if exe is not None:
+                if not warmup:
+                    self._hits += 1
+                return exe
+            if warmup:
+                self._warmup_compiles += 1
+            else:
+                self._misses += 1
+        if not warmup:
+            self.telemetry.health(
+                "cache_miss", nodes=spec.num_nodes, edges=spec.num_edges,
+                graphs=spec.num_graphs)
+        # compile OUTSIDE the lock: a bucket compile takes seconds, and
+        # cache_stats() (-> /healthz, /metrics) takes the same lock — a
+        # liveness probe must not block behind XLA.  Concurrent callers
+        # may race-compile the same bucket; first insert wins.
+        if batch is None:
+            batch = self._collate([self._zero_sample()], spec)
+        exe = self._eval.lower(self.state, batch).compile()
+        with self._lock:
+            return self._compiled.setdefault(key, exe)
+
+    def warmup(self) -> int:
+        """AOT-compile every configured bucket (server startup); returns
+        the number of executables compiled."""
+        for spec in self.pad_specs:
+            self._executable(spec, warmup=True)
+        return len(self._compiled)
+
+    def cache_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "warmup_compiles": self._warmup_compiles,
+                "hit_rate": (self._hits / total) if total else 1.0,
+                "compiled_buckets": len(self._compiled),
+                "buckets": [
+                    {"graphs": p.num_graphs - 1, "nodes": p.num_nodes,
+                     "edges": p.num_edges}
+                    for p in self.pad_specs
+                ],
+            }
+
+    # -- prediction ----------------------------------------------------------
+
+    def predict_arrays(
+        self, samples: Sequence[GraphSample]
+    ) -> List[np.ndarray]:
+        """One padded forward pass; per-head arrays with padding stripped
+        and denormalization applied — graph heads ``[n_graphs, dim]``,
+        node heads ``[total_real_nodes, dim]``.  Row order matches
+        ``run_prediction``'s masked concatenation exactly (the parity
+        contract)."""
+        spec = self.select_bucket(samples)
+        batch = self._collate(samples, spec)
+        exe = self._executable(spec, batch=batch)
+        m = exe(self.state, batch)
+        outputs = m["outputs"]
+        n_graphs = len(samples)
+        n_nodes = sum(s.num_nodes for s in samples)
+        arrays: List[np.ndarray] = []
+        for ih, h in enumerate(self.head_specs):
+            out = np.asarray(outputs[ih])
+            n = n_graphs if h.type == "graph" else n_nodes
+            # gaussian_nll heads emit [mean, log_sigma] at 2x the head
+            # width — the prediction is the mean block (same slice as
+            # trainer.test)
+            arr = out[:n, : h.dim]
+            if self.y_minmax is not None:
+                ymin = float(self.y_minmax[ih][0])
+                ymax = float(self.y_minmax[ih][1])
+                # identical expression to postprocess.output_denormalize
+                arr = np.asarray(arr) * (ymax - ymin) + ymin
+            arrays.append(arr)
+        return arrays
+
+    def predict_samples(
+        self, samples: Sequence[GraphSample]
+    ) -> List[Dict[str, np.ndarray]]:
+        """Per-request results: one ``{head_name: array}`` dict per input
+        sample (graph heads ``[dim]``, node heads ``[n_nodes, dim]``) —
+        what the micro-batcher hands back to each request future."""
+        arrays = self.predict_arrays(samples)
+        node_offs = np.cumsum([0] + [s.num_nodes for s in samples])
+        results: List[Dict[str, np.ndarray]] = [dict() for _ in samples]
+        for ih, h in enumerate(self.head_specs):
+            arr = arrays[ih]
+            for i in range(len(samples)):
+                if h.type == "graph":
+                    results[i][h.name] = arr[i]
+                else:
+                    results[i][h.name] = arr[node_offs[i]:node_offs[i + 1]]
+        return results

@@ -1,0 +1,355 @@
+"""Stdlib HTTP front-end for the inference engine.
+
+``ThreadingHTTPServer`` + ``BaseHTTPRequestHandler`` — no web framework
+(the container bakes in no server deps, and the hot path is the engine,
+not the transport).  One handler thread per connection; every handler
+funnels into the single MicroBatcher worker, so concurrency is bounded
+and ordering is sane.
+
+Endpoints:
+
+- ``POST /predict`` — JSON graph in, per-head predictions out::
+
+      {"x": [[...feat...], ...], "pos": [[x,y,z], ...],
+       "edge_index": [[senders...], [receivers...]],   # optional
+       "edge_attr": [[...], ...]}                      # models with edge features
+
+  ``edge_index`` may be omitted when the model config carries a radius —
+  the server builds the neighbor list exactly like the training
+  transform (graph/neighborlist.py:radius_graph).  Response::
+
+      {"heads": {head_name: [...]}, "num_nodes": N, "latency_ms": ...}
+
+  Errors: 400 malformed/invalid graph, 413 graph exceeds the largest
+  bucket, 503 request queue full (backpressure), 504 timed out in queue.
+
+- ``GET /healthz`` — liveness + warmup state.
+- ``GET /metrics`` — engine compile-cache stats, batcher stats,
+  telemetry health-event tally (the JSON the load generator
+  tools/servebench.py scrapes).
+
+Graceful shutdown: ``run()`` installs the SIGTERM/SIGINT machinery from
+resilience/preempt.py (the same signal->flag->poll pattern the trainer
+uses, second Ctrl-C escape hatch included), stops accepting, then drains
+the request queue so every accepted request is answered before exit.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+# py3.10: concurrent.futures.TimeoutError is not yet the builtin one
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.serve.batcher import (
+    BatcherClosedError,
+    MicroBatcher,
+    QueueFullError,
+)
+from hydragnn_tpu.serve.config import ServingConfig
+from hydragnn_tpu.serve.engine import BucketOverflowError, InferenceEngine
+
+
+# hard ceiling on request bodies, checked BEFORE reading the stream: a
+# graph that fits any plausible bucket is far below this, and an
+# unbounded read would let one oversized POST balloon the process
+MAX_REQUEST_BYTES = 16 << 20
+
+
+def sample_from_json(obj: Dict[str, Any], cfg,
+                     edge_length_norm: float = 0.0,
+                     pbc: bool = False,
+                     build_max_neighbours: int = 0) -> GraphSample:
+    """Validate + convert one request body into a host-side GraphSample
+    (the same numpy dtypes collate expects).
+
+    Server-side graph building mirrors ``transform_raw_samples``
+    EXACTLY: float64 positions into ``radius_graph``, the transform's
+    defaults for radius (5.0) and max_neighbours (100), and — for models
+    with length edge features — ``edge_lengths / edge_length_norm`` where
+    the norm is the TRAINING dataset's max edge length (persisted into
+    the saved config's ``Serving.edge_length_norm`` by the data
+    pipeline; a client-supplied ``edge_attr`` must already be normalized
+    the same way).  Rotational-invariance datasets are the exception:
+    the training transform rotates positions onto principal axes, which
+    the server does not replay — pre-normalize such requests.
+    """
+    if not isinstance(obj, dict):
+        raise ValueError("request body must be a JSON object")
+    if "x" not in obj or "pos" not in obj:
+        raise ValueError("request needs 'x' (node features) and 'pos' "
+                         "(node positions)")
+    x = np.asarray(obj["x"], dtype=np.float32)
+    if x.ndim == 1:
+        x = x[:, None]
+    if x.ndim != 2:
+        raise ValueError(
+            f"'x' must be [n_nodes, features], got shape {list(x.shape)}")
+    # float64 for graph building (the transform's precision); cast to
+    # f32 only for the stored sample, exactly like transform_raw_samples
+    pos64 = np.asarray(obj["pos"], dtype=np.float64)
+    if pos64.ndim != 2 or pos64.shape[1] != 3:
+        raise ValueError(
+            f"'pos' must be [n_nodes, 3], got {list(pos64.shape)}")
+    if x.shape[0] != pos64.shape[0]:
+        raise ValueError(f"'x' has {x.shape[0]} nodes but 'pos' has "
+                         f"{pos64.shape[0]}")
+    if x.shape[0] < 1:
+        raise ValueError("empty graph")
+    if x.shape[1] != cfg.input_dim:
+        raise ValueError(f"'x' feature dim {x.shape[1]} != model input_dim "
+                         f"{cfg.input_dim}")
+    if obj.get("edge_index") is not None:
+        ei = np.asarray(obj["edge_index"], dtype=np.int32)
+        if ei.ndim != 2 or ei.shape[0] != 2:
+            raise ValueError("'edge_index' must be [2, n_edges]")
+        if ei.size and (ei.min() < 0 or ei.max() >= x.shape[0]):
+            raise ValueError("'edge_index' references nodes out of range")
+    elif pbc:
+        # periodic models build edges with radius_graph_pbc over a cell
+        # the request doesn't carry — an open-boundary build here would
+        # silently drop every cross-boundary edge
+        raise ValueError(
+            "this model was trained with periodic boundary conditions: "
+            "the server cannot rebuild the periodic neighbor list — send "
+            "'edge_index' computed client-side (graph/neighborlist.py:"
+            "radius_graph_pbc)")
+    else:
+        # the training transform's graph build, defaults included
+        # (transform_raw_samples: radius `or 5.0`, max_neighbours
+        # `or 100`, float64 positions).  ``build_max_neighbours`` is the
+        # cap the transform ACTUALLY used (persisted by the data
+        # pipeline) — cfg.max_neighbours is finalize-overwritten for
+        # PNA (degree-histogram length) and would truncate differently
+        from hydragnn_tpu.graph.neighborlist import radius_graph
+
+        cap = int(build_max_neighbours or cfg.max_neighbours or 100)
+        ei = radius_graph(pos64, float(cfg.radius or 5.0),
+                          max_neighbours=cap)
+    ea = None
+    if obj.get("edge_attr") is not None:
+        if obj.get("edge_index") is None:
+            # a client cannot know the server-side radius_graph's edge
+            # ORDER — a count-matching edge_attr would silently assign
+            # each edge another edge's feature
+            raise ValueError("'edge_attr' requires the matching "
+                             "'edge_index' in the same request")
+        if not cfg.use_edge_attr:
+            # an unexpected edge_attr would collate a batch whose pytree
+            # differs from the warmed executables' and fail the whole
+            # flushed group — reject THIS request instead
+            raise ValueError("this model does not consume edge features: "
+                             "drop 'edge_attr' from the request")
+        ea = np.asarray(obj["edge_attr"], dtype=np.float32)
+        if ea.ndim == 1:
+            ea = ea[:, None]
+        if ea.ndim != 2 or ea.shape[0] != ei.shape[1]:
+            raise ValueError(f"'edge_attr' must be [{ei.shape[1]}, "
+                             f"{cfg.edge_dim}], got {list(ea.shape)}")
+        if ea.shape[1] != int(cfg.edge_dim or 0):
+            raise ValueError(f"'edge_attr' has {ea.shape[1]} features but "
+                             f"the model expects {cfg.edge_dim}")
+    if cfg.use_edge_attr and ea is None:
+        if pbc:
+            # training lengths are minimum-image distances from
+            # radius_graph_pbc; the open-boundary Euclidean distance is
+            # wrong for every cross-boundary edge — require the client's
+            raise ValueError(
+                "this periodic model consumes edge features: send "
+                "'edge_attr' computed client-side (minimum-image "
+                "lengths / edge_length_norm)")
+        if edge_length_norm and edge_length_norm > 0:
+            # length edge features, normalized with the training run's
+            # constant — identical arithmetic to transform_raw_samples
+            from hydragnn_tpu.graph.neighborlist import edge_lengths
+
+            ea = (edge_lengths(pos64, ei)
+                  / edge_length_norm).astype(np.float32)
+        else:
+            raise ValueError(
+                "this model consumes edge features: send 'edge_attr' "
+                "normalized like training, or serve with "
+                "Serving.edge_length_norm (written into config.json by "
+                "training runs) so the server can compute it")
+    return GraphSample(x=x, pos=pos64.astype(np.float32), edge_index=ei,
+                       edge_attr=ea)
+
+
+def _result_to_json(res: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    return {name: np.asarray(arr).tolist() for name, arr in res.items()}
+
+
+class InferenceServer:
+    """Engine + batcher + ThreadingHTTPServer, wired for graceful drain."""
+
+    def __init__(self, engine: InferenceEngine,
+                 serving: Optional[ServingConfig] = None,
+                 batcher: Optional[MicroBatcher] = None,
+                 request_timeout_s: float = 30.0):
+        self.engine = engine
+        self.serving = serving or engine.serving
+        self.batcher = batcher or MicroBatcher(
+            engine, max_wait_ms=self.serving.max_wait_ms,
+            max_queue=self.serving.max_queue, telemetry=engine.telemetry)
+        self.request_timeout_s = float(request_timeout_s)
+        self._t0 = time.time()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # socket timeout: a client declaring Content-Length N but
+            # sending fewer bytes must not pin its handler thread (and
+            # fd) forever — the stdlib catches socket.timeout and reaps
+            # the connection
+            timeout = 30.0
+
+            # quiet: no per-request stderr lines (telemetry carries the
+            # signal); override to keep test output clean
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path == "/healthz":
+                    self._reply(200, server.health())
+                elif self.path == "/metrics":
+                    self._reply(200, server.metrics())
+                else:
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):  # noqa: N802 — stdlib API
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+                    return
+                t0 = time.perf_counter()
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n < 0:
+                        # rfile.read(-1) would read until EOF — the
+                        # unbounded buffering the cap exists to prevent
+                        self._reply(400, {"error": "invalid Content-Length"})
+                        return
+                    if n > MAX_REQUEST_BYTES:
+                        self._reply(413, {
+                            "error": f"request body {n} bytes exceeds the "
+                                     f"{MAX_REQUEST_BYTES}-byte limit"})
+                        return
+                    obj = json.loads(self.rfile.read(n) or b"{}")
+                    sample = sample_from_json(
+                        obj, server.engine.cfg,
+                        edge_length_norm=server.serving.edge_length_norm,
+                        pbc=server.engine.pbc,
+                        build_max_neighbours=(
+                            server.serving.edge_build_max_neighbours))
+                except (ValueError, TypeError, IndexError, KeyError,
+                        json.JSONDecodeError) as e:
+                    # malformed payloads must answer 400, never escape
+                    # into the stdlib handler (dropped connection)
+                    self._reply(400, {"error": str(e)})
+                    return
+                try:
+                    fut = server.batcher.submit(sample)
+                    res = fut.result(timeout=server.request_timeout_s)
+                except BucketOverflowError as e:
+                    self._reply(413, {"error": str(e)})
+                    return
+                except QueueFullError as e:
+                    self._reply(503, {"error": str(e)})
+                    return
+                except BatcherClosedError as e:
+                    self._reply(503, {"error": str(e)})
+                    return
+                except (_FutureTimeout, TimeoutError):
+                    self._reply(504, {"error": "request timed out"})
+                    return
+                except Exception as e:  # noqa: BLE001 — engine failure
+                    self._reply(500, {"error": repr(e)})
+                    return
+                self._reply(200, {
+                    "heads": _result_to_json(res),
+                    "num_nodes": int(sample.num_nodes),
+                    "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                })
+
+        self.httpd = ThreadingHTTPServer(
+            (self.serving.host, int(self.serving.port)), Handler)
+        # ephemeral-port support (port 0): the bound port is the real one
+        self.port = int(self.httpd.server_address[1])
+        self._serve_thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        """AOT warmup (compile every bucket BEFORE accepting traffic, so
+        no request ever pays a compile), then serve in the background."""
+        n = self.engine.warmup()
+        self.batcher.start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-serve", daemon=True)
+        self._serve_thread.start()
+        self.engine.telemetry.health(
+            "serve_start", port=self.port, buckets=n,
+            max_wait_ms=self.serving.max_wait_ms)
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop accepting, then drain (or fail) the pending queue."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self.httpd.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+        self.batcher.close(drain=drain,
+                           timeout=self.serving.drain_timeout_s)
+        self.httpd.server_close()
+        self.engine.telemetry.health(
+            "serve_drain", drained=bool(drain),
+            served=self.batcher.stats()["batches"])
+
+    def run(self, poll_s: float = 0.05) -> None:
+        """Blocking serve loop with graceful SIGTERM/SIGINT handling —
+        the resilience/preempt.py signal->flag->poll machinery (second
+        Ctrl-C raises KeyboardInterrupt, the operator's escape hatch)."""
+        from hydragnn_tpu.resilience import PreemptionHandler
+
+        handler = PreemptionHandler(cross_rank=False).install()
+        self.start()
+        try:
+            while not handler.poll():
+                time.sleep(poll_s)
+        finally:
+            handler.uninstall()
+            self.shutdown(drain=True)
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        cache = self.engine.cache_stats()
+        return {
+            "status": "ok",
+            "uptime_s": round(time.time() - self._t0, 3),
+            "compiled_buckets": cache["compiled_buckets"],
+            "queue_depth": self.batcher.stats()["queue_depth"],
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "uptime_s": round(time.time() - self._t0, 3),
+            "engine": self.engine.cache_stats(),
+            "batcher": self.batcher.stats(),
+            "health_events": self.engine.telemetry.health_counts,
+        }

@@ -30,6 +30,7 @@ import collections
 import dataclasses
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -206,9 +207,13 @@ class MetricsLogger:
         self._flops_cache: Dict[tuple, Optional[float]] = {}
         self._mfu_broken = False
         self._dispatch_base: Dict[str, int] = {}
-        # resilience health-event tally (step_skipped, preempt_save,
-        # resume_from, ckpt_retry, ...) — folded into the manifest
+        # resilience/serving health-event tally (step_skipped,
+        # preempt_save, request_enqueued, ...) — folded into the manifest.
+        # Lock-guarded: the trainer is single-threaded, but the serving
+        # HTTP layer calls health() from per-connection handler threads
+        # (an unlocked read-modify-write would drop counts under load)
         self._health_counts: Dict[str, int] = {}
+        self._health_lock = threading.Lock()
         if self.enabled and self.rank == 0:
             self.sinks = build_sinks(
                 self.cfg.sinks, self.out_dir, self.run_id,
@@ -287,20 +292,26 @@ class MetricsLogger:
         sinks when any exist.  ``count=`` in fields bumps the tally by more
         than one (e.g. K skipped steps in one scanned dispatch)."""
         n = int(fields.pop("count", 1))
-        self._health_counts[kind] = self._health_counts.get(kind, 0) + n
-        self._emit({
-            "event": "health",
-            "kind": kind,
-            "count": n,
-            "run_id": self.run_id,
-            "rank": self.rank,
-            "t": time.time(),
-            **fields,
-        })
+        with self._health_lock:
+            # the emit rides the same lock: serving calls health() from
+            # concurrent handler threads, and the JSONL sink's shared
+            # text stream is not thread-safe — unlocked writes could
+            # interleave into garbled lines
+            self._health_counts[kind] = self._health_counts.get(kind, 0) + n
+            self._emit({
+                "event": "health",
+                "kind": kind,
+                "count": n,
+                "run_id": self.run_id,
+                "rank": self.rank,
+                "t": time.time(),
+                **fields,
+            })
 
     @property
     def health_counts(self) -> Dict[str, int]:
-        return dict(self._health_counts)
+        with self._health_lock:
+            return dict(self._health_counts)
 
     def resume_counts(self, global_step: int) -> None:
         """Continue the step/dispatch numbering of a preempted run so the

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""servebench: closed-loop load generator for the serving subsystem.
+
+    python tools/servebench.py --selftest                 # self-hosted bench
+    python tools/servebench.py --url http://host:port \
+        [--concurrency 4] [--requests 200] [--nodes 12] \
+        [--out BENCH_serve.json]
+
+Closed loop: each of ``--concurrency`` workers POSTs random graphs to
+``/predict`` back-to-back (next request only after the previous
+response), so offered load adapts to service rate — the standard way to
+measure latency without coordinated-omission artifacts from an open-loop
+generator outrunning the server.
+
+``--selftest`` builds a tiny fresh-initialized model + server in-process
+on an ephemeral port (no checkpoint needed), benches it, and shuts it
+down — the zero-setup smoke path CI and future perf PRs track.
+
+Reported (and emitted as BENCH_serve-style JSON): throughput,
+p50/p95/p99/max latency, batch fill %, compile-cache hit rate, flush
+reasons, and an SLO check — every request should complete within
+``max_wait_ms`` (the batching deadline) + up to two predict times (the
+in-flight batch ahead of it + its own) + a transport allowance; with the
+AOT warmup the steady-state cache-hit rate must be 100%.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def random_graph(rng: np.random.RandomState, max_nodes: int,
+                 input_dim: int = 1) -> Dict[str, Any]:
+    n = int(rng.randint(3, max(4, max_nodes + 1)))
+    pos = (rng.rand(n, 3) * 2.0).astype(np.float32)
+    x = rng.rand(n, input_dim).astype(np.float32)
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+
+    ei = radius_graph(pos, 1.2, max_neighbours=8)
+    return {"x": x.tolist(), "pos": pos.tolist(),
+            "edge_index": np.asarray(ei).tolist()}
+
+
+def _post(url: str, obj: Dict[str, Any], timeout: float = 60.0):
+    body = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def _get(url: str, path: str, timeout: float = 10.0):
+    return json.loads(
+        urllib.request.urlopen(url + path, timeout=timeout).read())
+
+
+def run_bench(url: str, concurrency: int, requests_total: int,
+              max_nodes: int, input_dim: int = 1) -> Dict[str, Any]:
+    per_worker = max(1, requests_total // max(1, concurrency))
+    latencies: List[float] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+
+    def worker(wid: int):
+        rng = np.random.RandomState(1000 + wid)
+        for _ in range(per_worker):
+            graph = random_graph(rng, max_nodes, input_dim)
+            t0 = time.perf_counter()
+            try:
+                _post(url, graph)
+            except Exception as e:  # noqa: BLE001 — tallied, not fatal
+                with lock:
+                    errors.append(repr(e))
+                continue
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+
+    lat = np.asarray(sorted(latencies)) if latencies else np.zeros(1)
+    metrics = _get(url, "/metrics")
+    eng = metrics.get("engine", {})
+    bat = metrics.get("batcher", {})
+    max_wait_ms = float(bat.get("max_wait_ms", 0.0))
+    max_predict_ms = float(bat.get("max_predict_ms", 0.0))
+    # latency bound: batching deadline + the in-flight batch ahead + this
+    # request's own predict + transport allowance
+    bound_ms = max_wait_ms + 2.0 * max_predict_ms + 50.0
+    hits, misses = int(eng.get("hits", 0)), int(eng.get("misses", 0))
+    result = {
+        "bench": "serve",
+        "config": {
+            "url": url,
+            "concurrency": concurrency,
+            "requests_per_worker": per_worker,
+            "max_nodes": max_nodes,
+        },
+        "ok_requests": len(latencies),
+        "errors": len(errors),
+        "error_samples": errors[:3],
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(len(latencies) / wall_s, 2) if wall_s else 0,
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat, 50)), 3),
+            "p95": round(float(np.percentile(lat, 95)), 3),
+            "p99": round(float(np.percentile(lat, 99)), 3),
+            "max": round(float(lat.max()), 3),
+        },
+        "batch_fill_pct": round(float(bat.get("avg_fill_pct", 0.0)), 2),
+        "pad_nodes_pct": round(float(bat.get("avg_pad_nodes_pct", 0.0)), 2),
+        "flushes": {
+            "full": int(bat.get("full_flushes", 0)),
+            "deadline": int(bat.get("deadline_flushes", 0)),
+            "drain": int(bat.get("drain_flushes", 0)),
+        },
+        "cache": {
+            "hits": hits,
+            "misses": misses,
+            "warmup_compiles": int(eng.get("warmup_compiles", 0)),
+            "hit_rate_post_warmup": round(
+                hits / (hits + misses), 4) if (hits + misses) else 1.0,
+        },
+        "slo": {
+            "max_wait_ms": max_wait_ms,
+            "max_predict_ms": round(max_predict_ms, 3),
+            "bound_ms": round(bound_ms, 3),
+            "max_latency_ms": round(float(lat.max()), 3),
+            # a bench where requests FAILED must not pass on the trivial
+            # latencies of the successes (or of nothing at all)
+            "ok": bool(latencies and not errors
+                       and float(lat.max()) <= bound_ms and misses == 0),
+        },
+    }
+    return result
+
+
+def _selftest_server():
+    """Tiny fresh-initialized SAGE model behind a local server on an
+    ephemeral port — no checkpoint, no dataset."""
+    import jax
+
+    from hydragnn_tpu.graph.batch import (
+        GraphSample, HeadSpec, PadSpec, collate)
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.serve import (
+        InferenceEngine, InferenceServer, InferenceState, ServingConfig)
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    example = collate(
+        [GraphSample(x=np.zeros((1, 1)), pos=np.zeros((1, 3)),
+                     edge_index=np.zeros((2, 1), np.int32))],
+        PadSpec.for_batch(1, 16, 64), [HeadSpec("energy", "graph", 1)])
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    serving = ServingConfig(buckets=(1, 4, 16), max_nodes_per_graph=16,
+                            max_edges_per_graph=128, max_wait_ms=10.0,
+                            port=0)
+    pads = [PadSpec.for_batch(b, serving.max_nodes_per_graph,
+                              serving.max_edges_per_graph)
+            for b in serving.buckets]
+    engine = InferenceEngine(cfg, state, [HeadSpec("energy", "graph", 1)],
+                             pads, serving=serving)
+    server = InferenceServer(engine, serving=serving)
+    server.start()
+    return server
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="server base URL (e.g. http://127.0.0.1:8808)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="spin up an in-process tiny-model server and "
+                         "bench it")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=200,
+                    help="total requests across workers (default 200)")
+    ap.add_argument("--nodes", type=int, default=12,
+                    help="max nodes per random request graph")
+    ap.add_argument("--input-dim", type=int, default=1,
+                    help="node feature dim of request graphs (match the "
+                         "served model)")
+    ap.add_argument("--out", default="BENCH_serve.json",
+                    help="output JSON path (default BENCH_serve.json)")
+    args = ap.parse_args(argv)
+
+    server = None
+    url = args.url
+    if args.selftest or url is None:
+        server = _selftest_server()
+        url = f"http://127.0.0.1:{server.port}"
+        print(f"selftest server on {url}", flush=True)
+    try:
+        result = run_bench(url.rstrip("/"), args.concurrency, args.requests,
+                           args.nodes, args.input_dim)
+    finally:
+        if server is not None:
+            server.shutdown()
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print(f"\nwrote {args.out}")
+    slo = result["slo"]
+    print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: max latency "
+          f"{slo['max_latency_ms']} ms vs bound {slo['bound_ms']} ms, "
+          f"cache hit rate {result['cache']['hit_rate_post_warmup']:.2%} "
+          "post-warmup")
+    return 0 if slo["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,10 +109,54 @@ def health_section(health: List[Dict[str, Any]],
         kind = r.get("kind")
         where = []
         for f in ("epoch", "step", "items", "attempt", "what", "ok",
-                  "error", "consecutive"):
+                  "error", "consecutive",
+                  # serving events (docs/SERVING.md)
+                  "n", "reason", "fill_pct", "wait_ms", "predict_ms",
+                  "depth", "port", "served"):
             if r.get(f) is not None:
                 where.append(f"{f}={r[f]}")
         lines.append(f"  {kind}: " + "  ".join(where))
+    return "\n".join(lines)
+
+
+# serving event kinds (docs/TELEMETRY.md "Serving events"): emitted by
+# hydragnn_tpu/serve through the same MetricsLogger.health spine
+_SERVING_KINDS = ("request_enqueued", "batch_flushed", "deadline_flush",
+                  "cache_miss", "batch_error", "serve_start", "serve_drain")
+
+
+def serving_section(health: List[Dict[str, Any]],
+                    manifests: List[Dict[str, Any]]) -> str:
+    """Derived serving stats: event counts plus batch fill %, padding %,
+    wait/predict times averaged over the batch_flushed records, and the
+    deadline-vs-full flush split — the at-a-glance answer to "is the
+    batcher filling buckets or timing out, and did anything recompile"."""
+    counts: Dict[str, int] = {}
+    for m in manifests[-1:]:
+        counts = {k: v for k, v in (m.get("health") or {}).items()
+                  if k in _SERVING_KINDS}
+    if not counts:
+        for r in health:
+            k = str(r.get("kind"))
+            if k in _SERVING_KINDS:
+                counts[k] = counts.get(k, 0) + int(r.get("count", 1) or 1)
+    lines = ["  " + "  ".join(f"{k}={counts[k]}" for k in sorted(counts))]
+    flushed = [r for r in health if r.get("kind") == "batch_flushed"]
+    if flushed:
+        def _avg(key):
+            vals = [float(r[key]) for r in flushed if r.get(key) is not None]
+            return sum(vals) / len(vals) if vals else 0.0
+
+        n_deadline = sum(1 for r in flushed if r.get("reason") == "deadline")
+        lines.append(
+            f"  batches {len(flushed)}  "
+            f"fill {_avg('fill_pct'):.1f}%  pad_n {_avg('pad_nodes_pct'):.1f}%  "
+            f"wait {_avg('wait_ms'):.2f}ms  predict {_avg('predict_ms'):.2f}ms  "
+            f"deadline-flush {100.0 * n_deadline / len(flushed):.0f}%")
+    n_miss = counts.get("cache_miss", 0)
+    if n_miss:
+        lines.append(f"  WARNING {n_miss} steady-state compile(s) — a "
+                     "request shape missed the warmed bucket ladder")
     return "\n".join(lines)
 
 
@@ -166,6 +210,11 @@ def main(argv=None) -> int:
     if health or any(m.get("health") for m in manifests):
         print("\nhealth:")
         print(health_section(health, manifests))
+    if any(r.get("kind") in _SERVING_KINDS for r in health) or any(
+            k in _SERVING_KINDS for m in manifests
+            for k in (m.get("health") or {})):
+        print("\nserving:")
+        print(serving_section(health, manifests))
     if manifests:
         m = manifests[-1]
         print(f"\nmanifest: run {m.get('run_id')}  "
