@@ -25,7 +25,13 @@ and this package makes each one survivable AND testable:
 crash-and-resume tests are built on (NaN batches at step k, simulated
 preemption at step k, checkpoint I/O failures) — gated by
 ``HYDRAGNN_CHAOS_*`` env knobs or a ``Training.Chaos`` config section,
-inert otherwise.
+inert otherwise.  :class:`~hydragnn_tpu.resilience.chaos.ServeChaos`
+extends the same discipline to the SERVING stack (predict latency,
+predict exceptions, corrupted hot-reload candidates via
+``HYDRAGNN_CHAOS_SERVE_*``), and
+:mod:`~hydragnn_tpu.resilience.breaker` provides the consecutive-failure
+circuit breaker the serving predict path trips under persistent faults
+(docs/SERVING.md "Overload behavior").
 
 Health events (``step_skipped``, ``preempt_save``, ``resume_from``,
 ``ckpt_retry``, ...) flow through the telemetry spine
@@ -34,7 +40,11 @@ docs/RESILIENCE.md for knobs and invariants.
 """
 
 from hydragnn_tpu.resilience.config import ResilienceConfig  # noqa: F401
-from hydragnn_tpu.resilience.chaos import Chaos  # noqa: F401
+from hydragnn_tpu.resilience.breaker import (  # noqa: F401
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from hydragnn_tpu.resilience.chaos import Chaos, ServeChaos  # noqa: F401
 from hydragnn_tpu.resilience.ckpt_io import (  # noqa: F401
     atomic_write_json,
     atomic_write_pickle,

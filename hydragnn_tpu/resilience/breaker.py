@@ -1,0 +1,165 @@
+"""Circuit breaker: fail fast while a dependency is persistently broken.
+
+The serving predict path (serve/batcher.py) is the first consumer: a
+wedged or crashing compiled executable must not let every request wait
+out its full timeout — after ``threshold`` consecutive failures the
+breaker *opens* and callers fail immediately (HTTP 503 + Retry-After)
+instead of queueing behind a dead device.  After ``cooldown_s`` the
+breaker goes *half-open*: traffic is admitted again and the next
+recorded outcome decides — success closes the breaker, failure re-opens
+it and restarts the cooldown.  This is the serving-side analog of the
+retry/giveup ladder in :mod:`~hydragnn_tpu.resilience.ckpt_io`: bounded
+optimism, explicit degradation, telemetry on every transition.
+
+State machine::
+
+    closed --[threshold consecutive failures]--> open
+    open   --[cooldown elapsed, next allow()]--> half_open
+    half_open --[success]--> closed
+    half_open --[failure]--> open (cooldown restarts)
+
+Transitions emit ``breaker_open`` / ``breaker_half_open`` /
+``breaker_close`` health events through the shared telemetry spine
+(docs/TELEMETRY.md "Serving events").  ``threshold=0`` disables the
+breaker entirely (always allows, records nothing).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class BreakerOpenError(RuntimeError):
+    """The circuit breaker is open: fail fast instead of queueing.
+
+    ``retry_after_s`` is the remaining cooldown — what the HTTP layer
+    puts in the ``Retry-After`` header of its 503.
+    """
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with a timed half-open probe.
+
+    Thread-safe: ``allow`` is called per admission (request submit AND
+    batch flush), ``record_success``/``record_failure`` once per flush
+    outcome.  ``on_open`` (if given) runs on every transition INTO the
+    open state, outside the internal lock — the server uses it to roll
+    back a just-reloaded checkpoint (serve/server.py).
+    """
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0,
+                 what: str = "predict", telemetry=None,
+                 on_open: Optional[Callable[[], None]] = None):
+        self.threshold = max(0, int(threshold))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.what = what
+        self.telemetry = telemetry
+        self.on_open = on_open
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._opens = 0
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def time_to_retry(self) -> float:
+        """Seconds until an open breaker will admit a probe (0 when not
+        open)."""
+        with self._lock:
+            if self._state != "open":
+                return 0.0
+            return max(0.0, self.cooldown_s
+                       - (time.monotonic() - self._opened_at))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "opens": self._opens,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+            }
+        out["time_to_retry_s"] = round(self.time_to_retry(), 3)
+        return out
+
+    # -- transitions ---------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May traffic proceed right now?
+
+        closed/half-open: yes.  Open: no — unless the cooldown has
+        elapsed, in which case the breaker moves to half-open and THIS
+        caller becomes the probe.
+        """
+        if self.threshold == 0:
+            return True
+        emit_half_open = False
+        with self._lock:
+            if self._state == "open":
+                if time.monotonic() - self._opened_at >= self.cooldown_s:
+                    self._state = "half_open"
+                    emit_half_open = True
+                else:
+                    return False
+        if emit_half_open and self.telemetry is not None:
+            self.telemetry.health("breaker_half_open", what=self.what)
+        return True
+
+    def record_success(self) -> None:
+        if self.threshold == 0:
+            return
+        emit_close = False
+        with self._lock:
+            self._consecutive = 0
+            if self._state != "closed":
+                self._state = "closed"
+                emit_close = True
+        if emit_close and self.telemetry is not None:
+            self.telemetry.health("breaker_close", what=self.what)
+
+    def record_failure(self) -> None:
+        if self.threshold == 0:
+            return
+        tripped = False
+        with self._lock:
+            self._consecutive += 1
+            # a half-open probe failure re-opens immediately; a closed
+            # breaker opens on the threshold'th consecutive failure
+            if (self._state == "half_open"
+                    or (self._state != "open"
+                        and self._consecutive >= self.threshold)):
+                self._state = "open"
+                self._opened_at = time.monotonic()
+                self._opens += 1
+                tripped = True
+            consecutive = self._consecutive
+        if tripped:
+            if self.telemetry is not None:
+                self.telemetry.health("breaker_open", what=self.what,
+                                      consecutive=consecutive,
+                                      cooldown_s=self.cooldown_s)
+            if self.on_open is not None:
+                self.on_open()
+
+    def reset(self, to: str = "half_open") -> None:
+        """Operator/rollback override: re-admit traffic without waiting
+        out the cooldown.  ``to="half_open"`` (default) lets the next
+        flush outcome confirm recovery; ``to="closed"`` clears fully."""
+        if to not in ("half_open", "closed"):
+            raise ValueError(f"reset target must be half_open|closed: {to}")
+        with self._lock:
+            self._state = to
+            self._consecutive = 0

@@ -27,6 +27,23 @@ counted across epochs; a scanned-K dispatch counts once):
                                every dispatch from 4 on)
   HYDRAGNN_CHAOS_PREEMPT_STEP  "7"  — request preemption after dispatch 7
   HYDRAGNN_CHAOS_CKPT_FAILS    "2"  — fail the first 2 ckpt attempts
+
+The SERVING side (hydragnn_tpu/serve) has its own injector,
+:class:`ServeChaos`, driving the overload/breaker/reload tier-1 tests
+(tests/test_serve_robustness.py) through ``HYDRAGNN_CHAOS_SERVE_*``
+knobs (flush indices are 1-based over attempted predict flushes):
+
+  HYDRAGNN_CHAOS_SERVE_PREDICT_MS      "250" | "250@3+"  — sleep 250 ms
+                               inside the predict path (every flush, or
+                               only the flushes matching the step spec
+                               after "@") so the watchdog/deadline
+                               machinery sees real slowness
+  HYDRAGNN_CHAOS_SERVE_FAIL_STEP       "2" | "2,5" | "3+"  — raise from
+                               the predict path at those flushes
+  HYDRAGNN_CHAOS_SERVE_RELOAD_CORRUPT  "1"  — corrupt the params of the
+                               first n hot-reload candidate checkpoints
+                               with NaN (reload validation must reject
+                               and roll back)
 """
 
 from __future__ import annotations
@@ -130,3 +147,103 @@ class Chaos:
                 f"chaos: injected checkpoint I/O failure "
                 f"({self.ckpt_fails - self._ckpt_fails_left}/"
                 f"{self.ckpt_fails})")
+
+
+def _parse_ms_spec(spec: str) -> Tuple[float, Set[int], Optional[int]]:
+    """'250' / '250@3+' / '250@2,5' -> (ms, explicit flushes, from)."""
+    spec = str(spec)
+    if "@" in spec:
+        ms, _, steps = spec.partition("@")
+        s, frm = _parse_nan_spec(steps)
+        return float(ms), s, frm
+    # no step spec: every flush from the first on
+    return float(spec), set(), 1
+
+
+class ServeChaos:
+    """Fault injector for the serving stack (serve/batcher.py,
+    serve/engine.py): predict latency, predict exceptions, and corrupted
+    hot-reload candidates.  Flush indices are 1-based over attempted
+    predict flushes; construction mirrors :class:`Chaos` (env knobs
+    overlay an optional ``Serving.Chaos`` config dict, None when nothing
+    is armed — zero production overhead)."""
+
+    def __init__(self, predict_ms: float = 0.0,
+                 lat_steps: Set[int] = frozenset(),
+                 lat_from: Optional[int] = None,
+                 fail_steps: Set[int] = frozenset(),
+                 fail_from: Optional[int] = None,
+                 reload_corrupt: int = 0):
+        self.predict_ms = float(predict_ms)
+        self.lat_steps = set(lat_steps)
+        self.lat_from = lat_from
+        self.fail_steps = set(fail_steps)
+        self.fail_from = fail_from
+        self.reload_corrupt = int(reload_corrupt)
+        self._flush = 0
+        self._corrupt_left = self.reload_corrupt
+        self.injected_latency = 0
+        self.injected_failures = 0
+        self.injected_corruptions = 0
+
+    @classmethod
+    def from_env(cls, section: Optional[Dict[str, Any]] = None
+                 ) -> Optional["ServeChaos"]:
+        """HYDRAGNN_CHAOS_SERVE_* env knobs overlaying an optional
+        ``Serving.Chaos`` dict (env wins); None when nothing is armed."""
+        s = dict(section or {})
+        lat = os.environ.get("HYDRAGNN_CHAOS_SERVE_PREDICT_MS",
+                             str(s.get("predict_ms", "") or ""))
+        fail = os.environ.get("HYDRAGNN_CHAOS_SERVE_FAIL_STEP",
+                              str(s.get("fail_step", "") or ""))
+        corrupt = os.environ.get("HYDRAGNN_CHAOS_SERVE_RELOAD_CORRUPT",
+                                 str(s.get("reload_corrupt", "") or ""))
+        ms, lat_steps, lat_from = _parse_ms_spec(lat) if lat else (
+            0.0, set(), None)
+        fail_steps, fail_from = _parse_nan_spec(fail) if fail else (
+            set(), None)
+        n_corrupt = int(corrupt) if corrupt else 0
+        if ms <= 0 and not fail_steps and fail_from is None \
+                and n_corrupt <= 0:
+            return None
+        return cls(ms, lat_steps, lat_from, fail_steps, fail_from, n_corrupt)
+
+    def _armed(self, steps: Set[int], frm: Optional[int]) -> bool:
+        if self._flush in steps:
+            return True
+        return frm is not None and self._flush >= frm
+
+    def on_predict(self) -> None:
+        """Count one attempted flush; inject latency and/or raise if
+        armed.  Runs INSIDE the batcher's watchdog thread, so injected
+        latency exercises the real predict-timeout path."""
+        import time
+
+        self._flush += 1
+        if self.predict_ms > 0 and self._armed(self.lat_steps,
+                                               self.lat_from):
+            self.injected_latency += 1
+            time.sleep(self.predict_ms / 1e3)
+        if self._armed(self.fail_steps, self.fail_from):
+            self.injected_failures += 1
+            raise RuntimeError(
+                f"chaos: injected predict failure at flush {self._flush}")
+
+    def on_reload_state(self, state):
+        """Corrupt a hot-reload candidate's params with NaN while
+        injected corruptions remain (reload validation must catch it)."""
+        if self._corrupt_left <= 0:
+            return state
+        self._corrupt_left -= 1
+        self.injected_corruptions += 1
+        import jax
+        import numpy as np
+
+        def _nan(a):
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.floating):
+                return np.full(a.shape, np.nan, a.dtype)
+            return a
+
+        return state.replace(
+            params=jax.tree_util.tree_map(_nan, state.params))

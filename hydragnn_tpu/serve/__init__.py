@@ -1,11 +1,14 @@
 """Online inference serving subsystem (docs/SERVING.md).
 
 Checkpoint -> :func:`load_inference_state` (params + batch_stats, no
-optimizer) -> :class:`InferenceEngine` (bucketed AOT compile cache) ->
-:class:`MicroBatcher` (fill-or-deadline dynamic micro-batching) ->
-:class:`InferenceServer` (stdlib HTTP: /predict, /healthz, /metrics,
-graceful SIGTERM drain).  ``python -m hydragnn_tpu.serve`` runs a server
-from a trained run's saved config.json.
+optimizer) -> :class:`InferenceEngine` (bucketed AOT compile cache, hot
+reload with golden-batch validation + rollback) -> :class:`MicroBatcher`
+(fill-or-deadline dynamic micro-batching, deadline-based load shedding,
+predict watchdog + circuit breaker) -> :class:`InferenceServer` (stdlib
+HTTP: /predict, /reload, /healthz, /metrics, graceful SIGTERM drain).
+``python -m hydragnn_tpu.serve`` runs a server from a trained run's
+saved config.json.  Overload semantics: docs/SERVING.md "Overload
+behavior & operational runbook".
 
 Exports resolve lazily (PEP 562): ``config.finalize`` imports
 ``serve.config`` for the written-back Serving defaults, and that must
@@ -15,13 +18,17 @@ every config-only caller.
 
 _EXPORTS = {
     "BatcherClosedError": "hydragnn_tpu.serve.batcher",
+    "DeadlineExpiredError": "hydragnn_tpu.serve.batcher",
     "MicroBatcher": "hydragnn_tpu.serve.batcher",
+    "PredictTimeoutError": "hydragnn_tpu.serve.batcher",
     "QueueFullError": "hydragnn_tpu.serve.batcher",
+    "RequestShedError": "hydragnn_tpu.serve.batcher",
     "ServingConfig": "hydragnn_tpu.serve.config",
     "serving_defaults": "hydragnn_tpu.serve.config",
     "BucketOverflowError": "hydragnn_tpu.serve.engine",
     "InferenceEngine": "hydragnn_tpu.serve.engine",
     "InferenceState": "hydragnn_tpu.serve.engine",
+    "ReloadValidationError": "hydragnn_tpu.serve.engine",
     "load_inference_state": "hydragnn_tpu.serve.engine",
     "InferenceServer": "hydragnn_tpu.serve.server",
     "sample_from_json": "hydragnn_tpu.serve.server",

@@ -28,6 +28,13 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default=None, help="bind host override")
     ap.add_argument("--port", type=int, default=None,
                     help="bind port override")
+    ap.add_argument("--reload-watch", default=None, metavar="CKPT",
+                    help="hot-reload this checkpoint file whenever its "
+                         "mtime changes (validated + rollback-protected; "
+                         "see docs/SERVING.md)")
+    ap.add_argument("--reload-watch-s", type=float, default=None,
+                    help="file-watch poll interval in seconds "
+                         "(default 5 when --reload-watch is set)")
     args = ap.parse_args(argv)
 
     with open(args.config) as f:
@@ -42,6 +49,14 @@ def main(argv=None) -> int:
         serving.host = args.host
     if args.port is not None:
         serving.port = args.port
+    if args.reload_watch is not None:
+        serving.reload_watch_path = args.reload_watch
+        # CLI interval > configured (config/env) interval > 5 s default
+        serving.reload_watch_s = args.reload_watch_s \
+            if args.reload_watch_s is not None \
+            else (serving.reload_watch_s or 5.0)
+    elif args.reload_watch_s is not None:
+        serving.reload_watch_s = args.reload_watch_s
     telemetry = MetricsLogger.from_env(run_name="serve")
     engine = InferenceEngine.from_config(
         config, logs_dir=args.logs_dir, serving=serving, telemetry=telemetry)

@@ -24,9 +24,21 @@ sentinel closes the stream FIFO, so everything enqueued before close is
 served, and the force path fails leftover futures instead of leaking
 blocked clients.
 
-Telemetry: request_enqueued / batch_flushed / deadline_flush health
-events through the shared MetricsLogger (docs/TELEMETRY.md "Serving
-events"); fill % and padding % ride the batch_flushed records.
+Overload safety (docs/SERVING.md "Overload behavior"): every request
+may carry a DEADLINE (queue wait + service).  Requests that provably
+cannot meet it are shed at submit time (``RequestShedError`` -> HTTP 429
+with a Retry-After derived from the measured drain rate), and entries
+whose deadline expired while queued are skipped before batch formation
+(``DeadlineExpiredError``) so one slow burst cannot poison subsequent
+batches.  Each predict flush runs under a WATCHDOG thread
+(``predict_timeout_s``); timeouts and exceptions feed the circuit
+breaker (resilience/breaker.py), which fails submits AND queued flushes
+fast while open.
+
+Telemetry: request_enqueued / batch_flushed / deadline_flush /
+request_shed / deadline_expired / predict_timeout health events through
+the shared MetricsLogger (docs/TELEMETRY.md "Serving events"); fill %
+and padding % ride the batch_flushed records.
 """
 
 from __future__ import annotations
@@ -39,6 +51,7 @@ from typing import Any, Dict, List, Optional
 
 from hydragnn_tpu.data.prefetch import drain_bounded_queue
 from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.resilience.breaker import BreakerOpenError
 
 _SENTINEL = object()
 
@@ -52,34 +65,113 @@ class BatcherClosedError(RuntimeError):
     shutdown."""
 
 
-class _Request:
-    __slots__ = ("sample", "future", "t_enq")
+class RequestShedError(RuntimeError):
+    """Load shed: the request cannot meet its deadline (HTTP 429).
 
-    def __init__(self, sample: GraphSample):
+    ``retry_after_s`` estimates when the queue will have drained —
+    what the HTTP layer puts in the 429's ``Retry-After`` header.
+    """
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class DeadlineExpiredError(RequestShedError):
+    """The request's deadline expired while it waited in the queue."""
+
+
+class PredictTimeoutError(RuntimeError):
+    """A predict flush exceeded the watchdog timeout (HTTP 504)."""
+
+
+class _WatchdogWorker:
+    """One persistent daemon thread running predict jobs for the
+    batcher's watchdog.  A job is a ``{"samples", "done", ...}`` box;
+    the worker fills ``res``/``err`` and sets ``done``.  ``retire()``
+    makes the thread exit after its current (possibly stuck) call —
+    used when a timeout abandons it."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._retired = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="predict-watchdog", daemon=True)
+        self._thread.start()
+
+    def run(self, samples) -> Dict[str, Any]:
+        box: Dict[str, Any] = {"samples": samples,
+                               "done": threading.Event()}
+        self._inbox.put(box)
+        return box
+
+    def retire(self) -> None:
+        self._retired.set()
+        self._inbox.put(None)  # wake it if it is idle
+
+    def _loop(self) -> None:
+        while not self._retired.is_set():
+            box = self._inbox.get()
+            if box is None or self._retired.is_set():
+                return
+            try:
+                box["res"] = self._fn(box["samples"])
+            except BaseException as e:  # noqa: BLE001 — re-raised by caller
+                box["err"] = e
+            finally:
+                box["done"].set()
+
+
+class _Request:
+    __slots__ = ("sample", "future", "t_enq", "deadline")
+
+    def __init__(self, sample: GraphSample,
+                 deadline: Optional[float] = None):
         self.sample = sample
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter time, or None
 
 
 class MicroBatcher:
     def __init__(self, engine, max_wait_ms: float = 20.0,
-                 max_queue: int = 1024, telemetry=None):
+                 max_queue: int = 1024, telemetry=None,
+                 default_deadline_ms: float = 0.0,
+                 predict_timeout_s: float = 0.0,
+                 breaker=None, chaos=None):
         self.engine = engine
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(max_queue)))
         self.telemetry = telemetry if telemetry is not None \
             else engine.telemetry
+        # 0 = deadlines disabled unless the caller passes one per submit
+        self.default_deadline_s = max(0.0, float(default_deadline_ms)) / 1e3
+        # 0 = no watchdog (predict runs inline on the worker thread)
+        self.predict_timeout_s = max(0.0, float(predict_timeout_s))
+        self.breaker = breaker  # resilience.breaker.CircuitBreaker or None
+        self.chaos = chaos      # resilience.chaos.ServeChaos or None
         self._stop = threading.Event()    # force-exit signal (no drain)
         self._closed = threading.Event()  # no new submits
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._n = {"requests": 0, "rejected": 0, "batches": 0,
                    "full_flushes": 0, "deadline_flushes": 0,
-                   "drain_flushes": 0, "errors": 0}
+                   "drain_flushes": 0, "errors": 0,
+                   "shed": 0, "expired": 0, "predict_timeouts": 0,
+                   "breaker_fastfails": 0}
         self._fill_sum = 0.0
         self._pad_nodes_sum = 0.0
         self._predict_ms_sum = 0.0
         self._predict_ms_max = 0.0
+        # EWMA of served requests/second over flush cycles — the drain
+        # rate behind admission-shed decisions and Retry-After hints —
+        # and of per-flush predict seconds (a request's deadline covers
+        # queue wait AND service, so admission must budget both)
+        self._rate_ewma: Optional[float] = None
+        self._predict_ewma_s: Optional[float] = None
+        # lazily-started persistent watchdog helper (worker thread only)
+        self._watchdog: Optional[_WatchdogWorker] = None
 
     # -- producer side -------------------------------------------------------
 
@@ -90,11 +182,41 @@ class MicroBatcher:
             self._thread.start()
         return self
 
-    def submit(self, sample: GraphSample) -> Future:
+    # -- load shedding -------------------------------------------------------
+
+    def _est_wait_s(self, depth: int) -> Optional[float]:
+        """Estimated queue-drain time for ``depth`` requests at the
+        measured service rate; None before any rate sample exists (cold
+        start never sheds — there is nothing to base the estimate on)."""
+        r = self._rate_ewma
+        if r is None or r <= 0:
+            return None
+        return depth / r
+
+    def retry_after_s(self) -> float:
+        """How long a rejected client should back off: the estimated
+        drain time of the current queue (>= 1 s, so 429/503 responses
+        always carry a meaningful Retry-After)."""
+        est = self._est_wait_s(max(1, self._q.qsize()))
+        return max(1.0, est if est is not None else 1.0)
+
+    def submit(self, sample: GraphSample,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one request; the returned future resolves to the
-        engine's per-sample result dict ``{head_name: array}``."""
+        engine's per-sample result dict ``{head_name: array}``.
+
+        ``deadline_s`` is this request's total budget (queue wait +
+        service) from now; None uses the configured default, and a
+        default of 0 means no deadline.  A request whose deadline the
+        current backlog provably exceeds is shed HERE — before it ever
+        occupies a queue slot (``RequestShedError`` -> 429).
+        """
         if self._closed.is_set():
             raise BatcherClosedError("batcher is shut down")
+        if self.breaker is not None and not self.breaker.allow():
+            raise BreakerOpenError(
+                "predict path is circuit-broken — failing fast",
+                retry_after_s=self.breaker.time_to_retry())
         # reject single requests that can never be batched
         if not self.engine.fits([sample]):
             from hydragnn_tpu.serve.engine import BucketOverflowError
@@ -102,7 +224,30 @@ class MicroBatcher:
             raise BucketOverflowError(
                 f"graph with {sample.num_nodes} nodes / {sample.num_edges} "
                 "edges exceeds the largest serving bucket")
-        req = _Request(sample)
+        if deadline_s is None and self.default_deadline_s > 0:
+            deadline_s = self.default_deadline_s
+        deadline = None
+        if deadline_s is not None:
+            deadline = time.perf_counter() + max(0.0, float(deadline_s))
+            # admission control: if draining the CURRENT backlog plus
+            # this request's own service time already consumes its whole
+            # budget, shed now (429 + Retry-After) instead of queueing a
+            # guaranteed timeout
+            est = self._est_wait_s(self._q.qsize() + 1)
+            if est is not None:
+                est += self._predict_ewma_s or 0.0
+            if est is not None and est > max(0.0, float(deadline_s)):
+                with self._lock:
+                    self._n["shed"] += 1
+                self.telemetry.health(
+                    "request_shed", depth=self._q.qsize(),
+                    est_wait_ms=round(est * 1e3, 1),
+                    deadline_ms=round(float(deadline_s) * 1e3, 1))
+                raise RequestShedError(
+                    f"queue drain estimate {est * 1e3:.0f} ms exceeds the "
+                    f"request deadline {float(deadline_s) * 1e3:.0f} ms",
+                    retry_after_s=max(1.0, est))
+        req = _Request(sample, deadline=deadline)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -124,6 +269,38 @@ class MicroBatcher:
 
     # -- worker --------------------------------------------------------------
 
+    def _expired(self, req: "_Request",
+                 now: Optional[float] = None) -> bool:
+        if req.deadline is None:
+            return False
+        # budget semantics: the deadline covers queue wait AND service.
+        # An entry whose remaining budget cannot cover one predict would
+        # only ever deliver a late, useless answer — shed it now so its
+        # bucket slot goes to a request that can still make it.
+        if now is None:
+            now = time.perf_counter()
+        return now + (self._predict_ewma_s or 0.0) > req.deadline
+
+    def _shed_expired(self, reqs: List["_Request"]) -> None:
+        """Fail requests whose deadline expired in the queue — skipped
+        BEFORE batch formation so a stale burst can't poison the batch
+        that follows it."""
+        if not reqs:
+            return
+        now = time.perf_counter()
+        retry = self.retry_after_s()
+        for r in reqs:
+            if not r.future.done():
+                r.future.set_exception(DeadlineExpiredError(
+                    f"deadline expired after {(now - r.t_enq) * 1e3:.0f} ms "
+                    "in queue", retry_after_s=retry))
+        with self._lock:
+            self._n["expired"] += len(reqs)
+        self.telemetry.health(
+            "deadline_expired", count=len(reqs),
+            waited_ms=round((now - reqs[0].t_enq) * 1e3, 1),
+            depth=self._q.qsize())
+
     def _run(self) -> None:
         pending: Optional[_Request] = None  # didn't fit the last group
         while not self._stop.is_set():
@@ -138,6 +315,11 @@ class MicroBatcher:
                     continue
                 if first is _SENTINEL:
                     break
+            if self._expired(first):
+                # never anchor a group (and its max_wait) on a request
+                # that is already dead
+                self._shed_expired([first])
+                continue
             group = [first]
             # running totals for O(1) admission (re-summing the group
             # per arrival would be O(n^2) per flush on the hot path)
@@ -191,23 +373,107 @@ class MicroBatcher:
         if pending is not None:
             self._fail(pending)
 
+    def _predict(self, samples: List[GraphSample]):
+        """The guarded predict body (runs on the watchdog thread when a
+        timeout is configured): chaos injection first, so injected
+        latency/failures exercise the real timeout/breaker paths."""
+        if self.chaos is not None:
+            self.chaos.on_predict()
+        return self.engine.predict_samples(samples)
+
+    def _predict_watched(self, samples: List[GraphSample]):
+        """Run the predict under the watchdog: a PERSISTENT helper
+        thread computes while the worker waits at most
+        ``predict_timeout_s`` — one long-lived thread, not a spawn per
+        flush (the timeout is the rare exception; the hot path should
+        not pay thread create/teardown every batch).  On timeout the
+        helper is ABANDONED (Python threads can't be killed): it is
+        retired so it exits after its stuck call eventually returns,
+        a fresh helper takes over on the next flush, and any late
+        result is discarded (futures already failed)."""
+        if self.predict_timeout_s <= 0:
+            return self._predict(samples)
+        if self._watchdog is None:
+            self._watchdog = _WatchdogWorker(self._predict)
+        box = self._watchdog.run(samples)
+        if not box["done"].wait(self.predict_timeout_s):
+            self._watchdog.retire()
+            self._watchdog = None
+            raise PredictTimeoutError(
+                f"predict exceeded the {self.predict_timeout_s:.3g} s "
+                f"watchdog for a {len(samples)}-graph flush")
+        if "err" in box:
+            raise box["err"]
+        return box["res"]
+
     def _flush(self, group: List[_Request], reason: str) -> None:
+        # deadline skip at flush time: entries can expire while the
+        # group waited out max_wait_ms — drop them here so the batch
+        # only carries requests that can still use the answer
+        now = time.perf_counter()
+        dead, live = [], []
+        for r in group:
+            (dead if self._expired(r, now) else live).append(r)
+        if dead:
+            self._shed_expired(dead)
+        group = live
+        if not group:
+            return
+        # circuit breaker fail-fast: while open, queued work is answered
+        # immediately with 503s instead of feeding a known-broken predict
+        # path (allow() also performs the open -> half-open transition,
+        # making this flush the recovery probe)
+        if self.breaker is not None and not self.breaker.allow():
+            retry = self.breaker.time_to_retry()
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(BreakerOpenError(
+                        "predict path is circuit-broken — failing fast",
+                        retry_after_s=retry))
+            with self._lock:
+                self._n["breaker_fastfails"] += len(group)
+            return
         samples = [r.sample for r in group]
         t0 = time.perf_counter()
         try:
             spec = self.engine.select_bucket(samples)
-            results = self.engine.predict_samples(samples)
+            results = self._predict_watched(samples)
         except Exception as e:  # noqa: BLE001 — surfaced per request
             with self._lock:
                 self._n["errors"] += 1
                 self._n["batches"] += 1
-            self.telemetry.health("batch_error", n=len(group),
-                                  error=repr(e))
+                if isinstance(e, PredictTimeoutError):
+                    self._n["predict_timeouts"] += 1
+            if isinstance(e, PredictTimeoutError):
+                self.telemetry.health(
+                    "predict_timeout", n=len(group),
+                    timeout_s=self.predict_timeout_s)
+            else:
+                self.telemetry.health("batch_error", n=len(group),
+                                      error=repr(e))
+            if self.breaker is not None:
+                self.breaker.record_failure()
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(e)
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         predict_ms = (time.perf_counter() - t0) * 1e3
+        # drain-rate EWMA from BUSY time only (requests served per
+        # predict second): under overload — the only regime where the
+        # estimate gates admission — the worker is predict-bound, so
+        # this matches true throughput; under trickle traffic it
+        # overestimates, which is SAFE (an idle-gap-based rate would
+        # collapse toward zero after a quiet minute and admission would
+        # then shed every default-deadline request forever, with no
+        # flush ever running to recover the estimate)
+        predict_s = max(predict_ms / 1e3, 1e-6)
+        self._predict_ewma_s = predict_s if self._predict_ewma_s is None \
+            else 0.7 * self._predict_ewma_s + 0.3 * predict_s
+        inst = len(group) / predict_s
+        self._rate_ewma = inst if self._rate_ewma is None \
+            else 0.7 * self._rate_ewma + 0.3 * inst
         for r, res in zip(group, results):
             if not r.future.done():
                 r.future.set_result(res)
@@ -297,6 +563,9 @@ class MicroBatcher:
                 self._q.put(_SENTINEL)
                 self._q.put(_SENTINEL)
         self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.retire()
+            self._watchdog = None
         # catch stragglers a racing submit slipped behind the sentinel
         # (also consumes stray sentinels left in the queue)
         self._sweep_leftovers()
@@ -311,6 +580,8 @@ class MicroBatcher:
                 **self._n,
                 "queue_depth": self._q.qsize(),
                 "max_wait_ms": self.max_wait_s * 1e3,
+                "drain_rate_rps": round(self._rate_ewma, 2)
+                                  if self._rate_ewma else 0.0,
                 "avg_fill_pct": (self._fill_sum / ok) if ok else 0.0,
                 "avg_pad_nodes_pct": (self._pad_nodes_sum / ok) if ok
                                      else 0.0,

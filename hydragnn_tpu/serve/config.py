@@ -36,7 +36,11 @@ class ServingConfig:
     HYDRAGNN_SERVE_MAX_NODES / HYDRAGNN_SERVE_MAX_EDGES (per-graph
     worst case, sizes the bucket PadSpecs), HYDRAGNN_SERVE_EDGE_NORM,
     HYDRAGNN_SERVE_MAX_WAIT_MS, HYDRAGNN_SERVE_QUEUE,
-    HYDRAGNN_SERVE_HOST, HYDRAGNN_SERVE_PORT, HYDRAGNN_SERVE_DRAIN_S.
+    HYDRAGNN_SERVE_HOST, HYDRAGNN_SERVE_PORT, HYDRAGNN_SERVE_DRAIN_S,
+    and the overload/robustness knobs HYDRAGNN_SERVE_DEADLINE_MS,
+    HYDRAGNN_SERVE_PREDICT_TIMEOUT_S, HYDRAGNN_SERVE_BREAKER_THRESHOLD,
+    HYDRAGNN_SERVE_BREAKER_COOLDOWN_S, HYDRAGNN_SERVE_RELOAD_WATCH,
+    HYDRAGNN_SERVE_RELOAD_WATCH_S (docs/SERVING.md "Overload behavior").
     """
 
     # batch-capacity ladder (graphs per bucket), ascending; each entry
@@ -67,6 +71,33 @@ class ServingConfig:
     # graceful-shutdown budget: how long close() waits for the queue to
     # drain before failing the leftovers
     drain_timeout_s: float = 10.0
+    # default per-request deadline (queue wait + service); a client
+    # `timeout_ms` body field / X-Timeout-Ms header overrides it.
+    # Requests whose deadline expires in the queue are SHED (429 +
+    # Retry-After) before batch formation.  0 = deadlines disabled.
+    request_deadline_ms: float = 10_000.0
+    # watchdog around each compiled predict call; a flush exceeding it
+    # fails (504) and counts toward the breaker.  0 = no watchdog.
+    predict_timeout_s: float = 30.0
+    # circuit breaker: consecutive failed/timed-out flushes that trip
+    # the open state (fail fast with 503, /healthz "degraded");
+    # 0 disables the breaker
+    breaker_threshold: int = 5
+    # open -> half-open probe delay
+    breaker_cooldown_s: float = 5.0
+    # post-reload probation: a breaker trip within this many seconds of
+    # a hot checkpoint swap rolls the engine back to the previous state
+    reload_probation_s: float = 60.0
+    # optional checkpoint file watch: a changed mtime hot-reloads the
+    # file (with golden-batch validation + rollback); "" = off
+    reload_watch_path: str = ""
+    # watch poll interval; 0 = watch disabled even if a path is set
+    reload_watch_s: float = 0.0
+    # POST /reload trust boundary: pickle.load of a client-named path is
+    # code execution, so non-loopback clients may only reload when this
+    # allowlisted checkpoint directory is set AND the path resolves
+    # inside it ("" = loopback clients only)
+    reload_root: str = ""
 
     def __post_init__(self):
         self.buckets = _parse_buckets(self.buckets)
@@ -88,6 +119,17 @@ class ServingConfig:
                 f"Serving.max_queue must be >= 1, got {self.max_queue}")
         if not (0 <= int(self.port) <= 65535):
             raise ValueError(f"Serving.port out of range: {self.port}")
+        for name in ("request_deadline_ms", "predict_timeout_s",
+                     "breaker_cooldown_s", "reload_probation_s",
+                     "reload_watch_s"):
+            if float(getattr(self, name)) < 0:
+                raise ValueError(
+                    f"Serving.{name} must be >= 0, "
+                    f"got {getattr(self, name)}")
+        if int(self.breaker_threshold) < 0:
+            raise ValueError(
+                f"Serving.breaker_threshold must be >= 0 (0 disables), "
+                f"got {self.breaker_threshold}")
 
     @classmethod
     def from_section(cls,
@@ -110,6 +152,21 @@ class ServingConfig:
             port=int(s.get("port", d.port)),
             drain_timeout_s=float(s.get("drain_timeout_s",
                                         d.drain_timeout_s)),
+            request_deadline_ms=float(s.get("request_deadline_ms",
+                                            d.request_deadline_ms)),
+            predict_timeout_s=float(s.get("predict_timeout_s",
+                                          d.predict_timeout_s)),
+            breaker_threshold=int(s.get("breaker_threshold",
+                                        d.breaker_threshold)),
+            breaker_cooldown_s=float(s.get("breaker_cooldown_s",
+                                           d.breaker_cooldown_s)),
+            reload_probation_s=float(s.get("reload_probation_s",
+                                           d.reload_probation_s)),
+            reload_watch_path=str(s.get("reload_watch_path",
+                                        d.reload_watch_path)),
+            reload_watch_s=float(s.get("reload_watch_s",
+                                       d.reload_watch_s)),
+            reload_root=str(s.get("reload_root", d.reload_root)),
         )
         if "HYDRAGNN_SERVE_BUCKETS" in os.environ:
             cfg.buckets = _parse_buckets(os.environ["HYDRAGNN_SERVE_BUCKETS"])
@@ -130,6 +187,25 @@ class ServingConfig:
             cfg.port = env_int("HYDRAGNN_SERVE_PORT", d.port)
         if "HYDRAGNN_SERVE_DRAIN_S" in os.environ:
             cfg.drain_timeout_s = float(os.environ["HYDRAGNN_SERVE_DRAIN_S"])
+        if "HYDRAGNN_SERVE_DEADLINE_MS" in os.environ:
+            cfg.request_deadline_ms = float(
+                os.environ["HYDRAGNN_SERVE_DEADLINE_MS"])
+        if "HYDRAGNN_SERVE_PREDICT_TIMEOUT_S" in os.environ:
+            cfg.predict_timeout_s = float(
+                os.environ["HYDRAGNN_SERVE_PREDICT_TIMEOUT_S"])
+        if "HYDRAGNN_SERVE_BREAKER_THRESHOLD" in os.environ:
+            cfg.breaker_threshold = env_int(
+                "HYDRAGNN_SERVE_BREAKER_THRESHOLD", d.breaker_threshold)
+        if "HYDRAGNN_SERVE_BREAKER_COOLDOWN_S" in os.environ:
+            cfg.breaker_cooldown_s = float(
+                os.environ["HYDRAGNN_SERVE_BREAKER_COOLDOWN_S"])
+        if "HYDRAGNN_SERVE_RELOAD_WATCH" in os.environ:
+            cfg.reload_watch_path = os.environ["HYDRAGNN_SERVE_RELOAD_WATCH"]
+        if "HYDRAGNN_SERVE_RELOAD_WATCH_S" in os.environ:
+            cfg.reload_watch_s = float(
+                os.environ["HYDRAGNN_SERVE_RELOAD_WATCH_S"])
+        if "HYDRAGNN_SERVE_RELOAD_ROOT" in os.environ:
+            cfg.reload_root = os.environ["HYDRAGNN_SERVE_RELOAD_ROOT"]
         # re-validate after the env overlay (the dataclass validated the
         # config values; env strings can be just as wrong)
         cfg.__post_init__()
@@ -152,4 +228,12 @@ def serving_defaults() -> Dict[str, Any]:
         "host": d.host,
         "port": d.port,
         "drain_timeout_s": d.drain_timeout_s,
+        "request_deadline_ms": d.request_deadline_ms,
+        "predict_timeout_s": d.predict_timeout_s,
+        "breaker_threshold": d.breaker_threshold,
+        "breaker_cooldown_s": d.breaker_cooldown_s,
+        "reload_probation_s": d.reload_probation_s,
+        "reload_watch_path": d.reload_watch_path,
+        "reload_watch_s": d.reload_watch_s,
+        "reload_root": d.reload_root,
     }

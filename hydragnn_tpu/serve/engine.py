@@ -27,6 +27,19 @@ Buffer donation: on accelerator backends the request batch's device
 buffers are donated to the executable (they are fresh per request and
 dead after the call); CPU has no donation support, so the flag is
 dropped there to keep smoke runs warning-free.
+
+**Hot reload.**  :meth:`InferenceEngine.reload_state` swaps a new
+checkpoint in WITHOUT a restart and without re-paying AOT warmup: the
+cached executables are specialized on the state's avals (shapes/dtypes),
+not its values, so any structurally-identical checkpoint runs through
+them unchanged.  A candidate is VALIDATED first — pytree structure +
+leaf shape/dtype parity with the live state, then a replay of the golden
+batch captured at startup whose outputs must be finite (and whose drift
+vs the recorded outputs is reported) — and only then atomically swapped;
+the previous state is retained for instant :meth:`rollback` when
+validation fails or the circuit breaker trips right after the swap
+(serve/server.py wires that).  In-flight flushes hold a snapshot of the
+old state, so a reload drops zero requests.
 """
 
 from __future__ import annotations
@@ -35,6 +48,7 @@ import json
 import os
 import pickle
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -59,6 +73,11 @@ from hydragnn_tpu.train.trainer import make_eval_step
 
 class BucketOverflowError(ValueError):
     """The request (or batch) exceeds the largest configured bucket."""
+
+
+class ReloadValidationError(RuntimeError):
+    """A hot-reload candidate failed validation (structure mismatch or
+    non-finite golden-batch outputs); the live state was NOT swapped."""
 
 
 def load_inference_state(config, logs_dir: str = "./logs/"):
@@ -130,8 +149,10 @@ class InferenceEngine:
         # stage the weights on device ONCE: the pickled state is host
         # numpy, and passing it per call would re-upload the full param
         # tree H2D on every request batch (state is argument 0 — never
-        # donated — so the staged buffers live for the engine lifetime)
-        self.state = jax.device_put(state)
+        # donated — so the staged buffers live for the engine lifetime).
+        # _canon_state normalizes the step leaf so hot-reload candidates
+        # always match the compiled executables' avals.
+        self.state = self._canon_state(state)
         self.head_specs = list(head_specs)
         if not pad_specs:
             raise ValueError("InferenceEngine needs at least one PadSpec "
@@ -161,6 +182,31 @@ class InferenceEngine:
         self._hits = 0
         self._misses = 0
         self._warmup_compiles = 0
+        # hot-reload machinery: previous state kept for instant rollback,
+        # golden-batch reference outputs recorded at warmup
+        self._reload_lock = threading.Lock()
+        self._prev_state = None
+        self._prev_golden: Optional[List[np.ndarray]] = None
+        self._golden: Optional[List[np.ndarray]] = None
+        self._reload_t: Optional[float] = None
+        self._reloads = 0
+        self._reload_failures = 0
+        self._rollbacks = 0
+
+    @staticmethod
+    def _canon_state(state: "InferenceState"):
+        """Device-staged state with a CANONICAL step leaf (strong int32):
+        pickled checkpoints carry int / np.int64 / weak-typed steps, and
+        an aval mismatch on any leaf would make the AOT-compiled
+        executables reject an otherwise-valid hot-reload candidate."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.device_put(InferenceState(
+            step=jnp.int32(int(np.asarray(state.step))),
+            params=state.params,
+            batch_stats=state.batch_stats,
+        ))
 
     # -- construction --------------------------------------------------------
 
@@ -302,16 +348,149 @@ class InferenceEngine:
         # may race-compile the same bucket; first insert wins.
         if batch is None:
             batch = self._collate([self._zero_sample()], spec)
-        exe = self._eval.lower(self.state, batch).compile()
+        # snapshot: a concurrent hot reload must not swap the state
+        # between aval capture and compile
+        state = self.state
+        exe = self._eval.lower(state, batch).compile()
         with self._lock:
             return self._compiled.setdefault(key, exe)
 
     def warmup(self) -> int:
-        """AOT-compile every configured bucket (server startup); returns
-        the number of executables compiled."""
+        """AOT-compile every configured bucket (server startup), then
+        capture the golden batch + reference outputs that hot-reload
+        validation replays; returns the number of executables
+        compiled."""
         for spec in self.pad_specs:
             self._executable(spec, warmup=True)
+        self._golden = self._golden_outputs(self.state)
         return len(self._compiled)
+
+    # -- hot reload ----------------------------------------------------------
+
+    def _golden_outputs(self, state) -> List[np.ndarray]:
+        """Replay the golden batch (a freshly-collated dummy in the
+        smallest bucket — re-collated per call because accelerator
+        backends DONATE the batch buffers) through the already-compiled
+        executable with ``state``."""
+        spec = self.pad_specs[0]
+        batch = self._collate([self._zero_sample()], spec)
+        exe = self._executable(spec, batch=batch, warmup=True)
+        m = exe(state, batch)
+        return [np.asarray(o) for o in m["outputs"]]
+
+    def validate_state(self, state: "InferenceState") -> Dict[str, Any]:
+        """Validate a DEVICE-STAGED hot-reload candidate against the
+        live state: pytree structure + leaf shape/dtype parity, then a
+        golden-batch replay whose outputs must be all-finite.  Returns
+        the validation report (golden outputs + drift vs the recorded
+        reference); raises :class:`ReloadValidationError` otherwise."""
+        import jax
+
+        cur = jax.tree_util.tree_leaves_with_path(
+            (self.state.params, self.state.batch_stats))
+        new = jax.tree_util.tree_leaves_with_path(
+            (state.params, state.batch_stats))
+        def _sig(leaf):
+            # dtype without np.asarray: that would D2H-copy every leaf
+            dt = getattr(leaf, "dtype", None)
+            return np.shape(leaf), dt if dt is not None \
+                else np.asarray(leaf).dtype
+        if len(cur) != len(new) or any(
+                pc != pn or _sig(lc) != _sig(ln)
+                for (pc, lc), (pn, ln) in zip(cur, new)):
+            raise ReloadValidationError(
+                "candidate checkpoint's param/batch_stats tree does not "
+                "match the served model (structure, shape or dtype) — "
+                "reload needs a checkpoint from the same architecture")
+        try:
+            outs = self._golden_outputs(state)
+        except Exception as e:  # noqa: BLE001 — any replay failure rejects
+            raise ReloadValidationError(
+                f"golden-batch replay failed: {e!r}") from e
+        if not all(np.isfinite(o).all() for o in outs):
+            raise ReloadValidationError(
+                "candidate checkpoint produced non-finite golden-batch "
+                "outputs (corrupt or incompatible weights)")
+        delta = 0.0
+        if self._golden is not None:
+            delta = max(
+                (float(np.max(np.abs(o - g))) if o.size else 0.0)
+                for o, g in zip(outs, self._golden))
+        return {"golden_max_delta": delta, "outputs": outs}
+
+    def reload_state(self, state: "InferenceState",
+                     source: str = "api") -> Dict[str, Any]:
+        """Validate ``state`` and atomically swap it in; the previous
+        state is retained for :meth:`rollback`.  In-flight predictions
+        hold a snapshot of the old state, so no request is dropped.
+        Raises :class:`ReloadValidationError` (live state untouched) on
+        a bad candidate."""
+        with self._reload_lock:
+            staged = self._canon_state(state)
+            try:
+                report = self.validate_state(staged)
+            except ReloadValidationError as e:
+                self._reload_failures += 1
+                self.telemetry.health(
+                    "reload_rollback", reason="validation", source=source,
+                    error=str(e)[:200])
+                raise
+            outs = report.pop("outputs")
+            self._prev_state, self.state = self.state, staged
+            self._prev_golden, self._golden = self._golden, outs
+            self._reload_t = time.monotonic()
+            self._reloads += 1
+            self.telemetry.health(
+                "reload_ok", source=source,
+                step=int(np.asarray(staged.step)),
+                golden_max_delta=round(report["golden_max_delta"], 9))
+            return {"step": int(np.asarray(staged.step)), **report}
+
+    def reload_from_checkpoint(self, path: str, chaos=None,
+                               source: str = "api") -> Dict[str, Any]:
+        """Load a checkpoint pickle (the ``run_training`` format:
+        ``{step, params, batch_stats}``) and hot-swap it via
+        :meth:`reload_state`.  ``chaos`` (a ServeChaos or None) lets the
+        fault harness corrupt the candidate to exercise rollback."""
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        state = InferenceState(
+            step=payload.get("step", 0),
+            params=payload["params"],
+            batch_stats=payload.get("batch_stats", {}),
+        )
+        if chaos is not None:
+            state = chaos.on_reload_state(state)
+        return self.reload_state(state, source=source)
+
+    def rollback(self, reason: str = "breaker_trip") -> bool:
+        """Instantly restore the pre-reload state (False when there is
+        nothing to roll back to)."""
+        with self._reload_lock:
+            if self._prev_state is None:
+                return False
+            self.state, self._prev_state = self._prev_state, None
+            self._golden, self._prev_golden = self._prev_golden, None
+            self._reload_t = None
+            self._rollbacks += 1
+            self.telemetry.health("reload_rollback", reason=reason)
+            return True
+
+    def in_probation(self, probation_s: float) -> bool:
+        """Is the engine inside the post-reload probation window (a
+        breaker trip now should auto-rollback)?"""
+        return (self._reload_t is not None
+                and self._prev_state is not None
+                and time.monotonic() - self._reload_t
+                < max(0.0, float(probation_s)))
+
+    def reload_stats(self) -> Dict[str, Any]:
+        return {
+            "reloads": self._reloads,
+            "reload_failures": self._reload_failures,
+            "rollbacks": self._rollbacks,
+            "can_rollback": self._prev_state is not None,
+        }
 
     def cache_stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -342,7 +521,10 @@ class InferenceEngine:
         spec = self.select_bucket(samples)
         batch = self._collate(samples, spec)
         exe = self._executable(spec, batch=batch)
-        m = exe(self.state, batch)
+        # snapshot: a hot reload swapping self.state mid-call must not
+        # hand this flush two different param trees
+        state = self.state
+        m = exe(state, batch)
         outputs = m["outputs"]
         n_graphs = len(samples)
         n_nodes = sum(s.num_nodes for s in samples)

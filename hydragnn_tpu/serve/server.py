@@ -20,11 +20,28 @@ Endpoints:
 
       {"heads": {head_name: [...]}, "num_nodes": N, "latency_ms": ...}
 
-  Errors: 400 malformed/invalid graph, 413 graph exceeds the largest
-  bucket, 503 request queue full (backpressure), 504 timed out in queue.
+  Requests may carry a deadline (``timeout_ms`` body field or
+  ``X-Timeout-Ms`` header; server default ``Serving.request_deadline_ms``).
 
-- ``GET /healthz`` — liveness + warmup state.
-- ``GET /metrics`` — engine compile-cache stats, batcher stats,
+  Errors: 400 malformed/invalid graph, 413 graph exceeds the largest
+  bucket, 429 shed under overload (deadline unmeetable or expired in
+  queue; ``Retry-After`` derived from the measured drain rate), 503
+  request queue full or circuit breaker open (``Retry-After`` set),
+  504 timed out (client wait or predict watchdog).
+
+- ``POST /reload`` — hot checkpoint reload: ``{"checkpoint": path}``
+  loads the pickle into a fresh state, validates it against the golden
+  batch, and atomically swaps it in (409 + automatic rollback to the
+  previous state when validation fails) — zero dropped requests.  A
+  file watch (``Serving.reload_watch_path``/``reload_watch_s``) can
+  trigger the same path on checkpoint mtime changes.  Trust boundary:
+  unpickling a client-named path is code execution, so non-loopback
+  clients are refused (403) unless ``Serving.reload_root`` allowlists a
+  checkpoint directory the path must resolve into.
+- ``GET /healthz`` — liveness + warmup state; ``status`` degrades to
+  ``"degraded"`` while the circuit breaker is open/half-open.
+- ``GET /metrics`` — engine compile-cache stats, batcher stats
+  (incl. shed/expired/timeout counters), breaker + reload state,
   telemetry health-event tally (the JSON the load generator
   tools/servebench.py scrapes).
 
@@ -37,6 +54,8 @@ the request queue so every accepted request is answered before exit.
 from __future__ import annotations
 
 import json
+import math
+import os
 import threading
 import time
 # py3.10: concurrent.futures.TimeoutError is not yet the builtin one
@@ -47,19 +66,33 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
+from hydragnn_tpu.resilience.chaos import ServeChaos
 from hydragnn_tpu.serve.batcher import (
     BatcherClosedError,
     MicroBatcher,
+    PredictTimeoutError,
     QueueFullError,
+    RequestShedError,
 )
 from hydragnn_tpu.serve.config import ServingConfig
-from hydragnn_tpu.serve.engine import BucketOverflowError, InferenceEngine
+from hydragnn_tpu.serve.engine import (
+    BucketOverflowError,
+    InferenceEngine,
+    ReloadValidationError,
+)
 
 
 # hard ceiling on request bodies, checked BEFORE reading the stream: a
 # graph that fits any plausible bucket is far below this, and an
 # unbounded read would let one oversized POST balloon the process
 MAX_REQUEST_BYTES = 16 << 20
+
+
+class _BodyTooLarge(ValueError):
+    def __init__(self, n: int):
+        super().__init__(f"body of {n} bytes over the cap")
+        self.n = n
 
 
 def sample_from_json(obj: Dict[str, Any], cfg,
@@ -191,12 +224,28 @@ class InferenceServer:
     def __init__(self, engine: InferenceEngine,
                  serving: Optional[ServingConfig] = None,
                  batcher: Optional[MicroBatcher] = None,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 chaos: Optional[ServeChaos] = None):
         self.engine = engine
         self.serving = serving or engine.serving
+        # serving-side fault injection (HYDRAGNN_CHAOS_SERVE_*): threads
+        # through the batcher's predict path and the reload loader
+        self.chaos = chaos if chaos is not None else ServeChaos.from_env()
+        # consecutive predict failures/timeouts trip the breaker: fail
+        # fast with 503 + degraded /healthz instead of queueing behind a
+        # broken predict path; a trip right after a hot reload rolls the
+        # checkpoint back (reload probation)
+        self.breaker = CircuitBreaker(
+            threshold=self.serving.breaker_threshold,
+            cooldown_s=self.serving.breaker_cooldown_s,
+            what="predict", telemetry=engine.telemetry,
+            on_open=self._on_breaker_open)
         self.batcher = batcher or MicroBatcher(
             engine, max_wait_ms=self.serving.max_wait_ms,
-            max_queue=self.serving.max_queue, telemetry=engine.telemetry)
+            max_queue=self.serving.max_queue, telemetry=engine.telemetry,
+            default_deadline_ms=self.serving.request_deadline_ms,
+            predict_timeout_s=self.serving.predict_timeout_s,
+            breaker=self.breaker, chaos=self.chaos)
         self.request_timeout_s = float(request_timeout_s)
         self._t0 = time.time()
         server = self
@@ -213,13 +262,29 @@ class InferenceServer:
             def log_message(self, fmt, *args):  # noqa: A003
                 pass
 
-            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+            def _reply(self, code: int, payload: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _retry_after(self, seconds: float) -> Dict[str, str]:
+                return {"Retry-After": str(max(1, math.ceil(seconds)))}
+
+            def _read_json(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", 0))
+                if n < 0:
+                    # rfile.read(-1) would read until EOF — the
+                    # unbounded buffering the cap exists to prevent
+                    raise ValueError("invalid Content-Length")
+                if n > MAX_REQUEST_BYTES:
+                    raise _BodyTooLarge(n)
+                return json.loads(self.rfile.read(n) or b"{}")
 
             def do_GET(self):  # noqa: N802 — stdlib API
                 if self.path == "/healthz":
@@ -230,29 +295,43 @@ class InferenceServer:
                     self._reply(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):  # noqa: N802 — stdlib API
+                if self.path == "/reload":
+                    self._do_reload()
+                    return
                 if self.path != "/predict":
                     self._reply(404, {"error": f"unknown path {self.path}"})
                     return
                 t0 = time.perf_counter()
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    if n < 0:
-                        # rfile.read(-1) would read until EOF — the
-                        # unbounded buffering the cap exists to prevent
-                        self._reply(400, {"error": "invalid Content-Length"})
-                        return
-                    if n > MAX_REQUEST_BYTES:
-                        self._reply(413, {
-                            "error": f"request body {n} bytes exceeds the "
-                                     f"{MAX_REQUEST_BYTES}-byte limit"})
-                        return
-                    obj = json.loads(self.rfile.read(n) or b"{}")
+                    obj = self._read_json()
+                    # per-request deadline: header wins over body field,
+                    # absent -> the batcher's configured default.  NOTE
+                    # client semantics differ from the server knob: a
+                    # client that wants NO deadline omits the field
+                    # (timeout_ms=0 means zero tolerance -> immediate
+                    # shed), while Serving.request_deadline_ms=0
+                    # disables the server default
+                    tmo = self.headers.get("X-Timeout-Ms")
+                    if tmo is None and isinstance(obj, dict):
+                        tmo = obj.get("timeout_ms")
+                    deadline_s = None
+                    if tmo is not None:
+                        deadline_s = float(tmo) / 1e3
+                        if deadline_s < 0:
+                            raise ValueError(
+                                "timeout_ms must be >= 0 (omit it for "
+                                "the server default deadline)")
                     sample = sample_from_json(
                         obj, server.engine.cfg,
                         edge_length_norm=server.serving.edge_length_norm,
                         pbc=server.engine.pbc,
                         build_max_neighbours=(
                             server.serving.edge_build_max_neighbours))
+                except _BodyTooLarge as e:
+                    self._reply(413, {
+                        "error": f"request body {e.n} bytes exceeds the "
+                                 f"{MAX_REQUEST_BYTES}-byte limit"})
+                    return
                 except (ValueError, TypeError, IndexError, KeyError,
                         json.JSONDecodeError) as e:
                     # malformed payloads must answer 400, never escape
@@ -260,16 +339,34 @@ class InferenceServer:
                     self._reply(400, {"error": str(e)})
                     return
                 try:
-                    fut = server.batcher.submit(sample)
-                    res = fut.result(timeout=server.request_timeout_s)
+                    fut = server.batcher.submit(sample,
+                                                deadline_s=deadline_s)
+                    res = fut.result(timeout=server._wait_s(deadline_s))
                 except BucketOverflowError as e:
                     self._reply(413, {"error": str(e)})
                     return
+                except BreakerOpenError as e:
+                    # breaker open: fail fast, tell the client when the
+                    # half-open probe will be admitted
+                    self._reply(503, {"error": str(e), "breaker": "open"},
+                                headers=self._retry_after(e.retry_after_s))
+                    return
+                except RequestShedError as e:
+                    # shed (admission control or expired-in-queue):
+                    # 429 + Retry-After from the measured drain rate
+                    self._reply(429, {"error": str(e)},
+                                headers=self._retry_after(e.retry_after_s))
+                    return
                 except QueueFullError as e:
-                    self._reply(503, {"error": str(e)})
+                    self._reply(503, {"error": str(e)},
+                                headers=self._retry_after(
+                                    server.batcher.retry_after_s()))
                     return
                 except BatcherClosedError as e:
                     self._reply(503, {"error": str(e)})
+                    return
+                except PredictTimeoutError as e:
+                    self._reply(504, {"error": str(e)})
                     return
                 except (_FutureTimeout, TimeoutError):
                     self._reply(504, {"error": "request timed out"})
@@ -283,12 +380,115 @@ class InferenceServer:
                     "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
                 })
 
+            def _do_reload(self) -> None:
+                try:
+                    obj = self._read_json()
+                    path = obj.get("checkpoint") if isinstance(obj, dict) \
+                        else None
+                    if not path or not isinstance(path, str):
+                        self._reply(400, {
+                            "error": "reload body needs "
+                                     "{\"checkpoint\": \"path/to/ckpt.pk\"}"})
+                        return
+                except _BodyTooLarge:
+                    self._reply(413, {"error": "reload body too large"})
+                    return
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                # trust boundary: pickle.load of a client-named path is
+                # code execution.  Non-loopback clients may only name
+                # paths inside the allowlisted Serving.reload_root;
+                # without one, /reload is loopback-only.
+                root = server.serving.reload_root
+                if root:
+                    real = os.path.realpath(path)
+                    if not real.startswith(
+                            os.path.realpath(root) + os.sep):
+                        self._reply(403, {
+                            "error": f"checkpoint path outside the "
+                                     f"allowlisted reload_root {root}"})
+                        return
+                elif self.client_address[0] not in ("127.0.0.1", "::1"):
+                    self._reply(403, {
+                        "error": "reload is loopback-only unless "
+                                 "Serving.reload_root allowlists a "
+                                 "checkpoint directory"})
+                    return
+                try:
+                    report = server.reload(path)
+                except FileNotFoundError:
+                    self._reply(404, {"error": f"no checkpoint at {path}"})
+                    return
+                except ReloadValidationError as e:
+                    # validation rejected the candidate: the previous
+                    # state keeps serving — a rollback, not an outage
+                    self._reply(409, {"status": "rolled_back",
+                                      "error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — loader failure
+                    self._reply(500, {"error": repr(e)})
+                    return
+                self._reply(200, {"status": "ok", **report})
+
         self.httpd = ThreadingHTTPServer(
             (self.serving.host, int(self.serving.port)), Handler)
         # ephemeral-port support (port 0): the bound port is the real one
         self.port = int(self.httpd.server_address[1])
         self._serve_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
         self._stopped = False
+
+    # -- overload / reload plumbing ------------------------------------------
+
+    def _wait_s(self, deadline_s: Optional[float]) -> float:
+        """How long a handler thread waits on its future: the request's
+        own deadline plus the worst predict it could sit behind, capped
+        by the global request timeout."""
+        if deadline_s is None:
+            return self.request_timeout_s
+        grace = max(1.0, self.serving.predict_timeout_s)
+        return min(self.request_timeout_s, deadline_s + grace)
+
+    def _on_breaker_open(self) -> None:
+        """Breaker trip hook: inside the post-reload probation window
+        the freshly-swapped checkpoint is the prime suspect — roll back
+        to the retained previous state instantly and half-open the
+        breaker so the next flush probes the restored state."""
+        if self.engine.in_probation(self.serving.reload_probation_s):
+            if self.engine.rollback(reason="breaker_trip"):
+                self.breaker.reset(to="half_open")
+
+    def reload(self, path: str) -> Dict[str, Any]:
+        """Hot-swap the checkpoint at ``path`` (validation + atomic swap
+        + retained rollback state); raises ReloadValidationError when
+        the candidate is rejected."""
+        return self.engine.reload_from_checkpoint(
+            path, chaos=self.chaos, source="http")
+
+    def _watch_loop(self, poll_s: float) -> None:
+        """Checkpoint file watch: a changed mtime (or the file's first
+        appearance) triggers the same validated reload as POST /reload;
+        failures keep the old state serving (telemetry records them)."""
+        path = self.serving.reload_watch_path
+        try:
+            last: Optional[float] = os.path.getmtime(path)
+        except OSError:
+            last = None
+        while not self._stopped:
+            time.sleep(poll_s)
+            try:
+                m = os.path.getmtime(path)
+            except OSError:
+                continue
+            if last is not None and m == last:
+                continue
+            last = m
+            try:
+                self.engine.reload_from_checkpoint(
+                    path, chaos=self.chaos, source="watch")
+            except Exception:  # noqa: BLE001 — reload_rollback emitted
+                pass
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -300,6 +500,11 @@ class InferenceServer:
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="http-serve", daemon=True)
         self._serve_thread.start()
+        if self.serving.reload_watch_path and self.serving.reload_watch_s > 0:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, name="reload-watch", daemon=True,
+                args=(self.serving.reload_watch_s,))
+            self._watch_thread.start()
         self.engine.telemetry.health(
             "serve_start", port=self.port, buckets=n,
             max_wait_ms=self.serving.max_wait_ms)
@@ -339,11 +544,18 @@ class InferenceServer:
 
     def health(self) -> Dict[str, Any]:
         cache = self.engine.cache_stats()
+        breaker = self.breaker.snapshot()
+        # the breaker only degrades /healthz when it actually gates
+        # traffic (threshold 0 = disabled)
+        degraded = self.breaker.threshold > 0 \
+            and breaker["state"] != "closed"
         return {
-            "status": "ok",
+            "status": "degraded" if degraded else "ok",
             "uptime_s": round(time.time() - self._t0, 3),
             "compiled_buckets": cache["compiled_buckets"],
             "queue_depth": self.batcher.stats()["queue_depth"],
+            "breaker": breaker,
+            "reload": self.engine.reload_stats(),
         }
 
     def metrics(self) -> Dict[str, Any]:
@@ -351,5 +563,7 @@ class InferenceServer:
             "uptime_s": round(time.time() - self._t0, 3),
             "engine": self.engine.cache_stats(),
             "batcher": self.batcher.stats(),
+            "breaker": self.breaker.snapshot(),
+            "reload": self.engine.reload_stats(),
             "health_events": self.engine.telemetry.health_counts,
         }

@@ -1,0 +1,533 @@
+"""Overload-safe serving (docs/SERVING.md "Overload behavior"):
+admission control + load shedding, expired-entry skip before batch
+formation, predict watchdog + circuit breaker open/half-open/close,
+hot checkpoint reload with golden-batch validation + rollback, and the
+/healthz degraded transitions — all driven through the real production
+code paths by the serving chaos harness (resilience/chaos.py ServeChaos).
+"""
+
+import json
+import pickle
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.resilience import BreakerOpenError, CircuitBreaker, \
+    ServeChaos
+from hydragnn_tpu.serve import (
+    DeadlineExpiredError,
+    InferenceEngine,
+    InferenceServer,
+    InferenceState,
+    MicroBatcher,
+    PredictTimeoutError,
+    ReloadValidationError,
+    RequestShedError,
+    ServingConfig,
+)
+
+
+def _sample(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    pos = rng.rand(n, 3).astype(np.float32) * 2.0
+    return GraphSample(x=rng.rand(n, 1).astype(np.float32), pos=pos,
+                       edge_index=radius_graph(pos, 1.2, 8))
+
+
+_HEADS = [HeadSpec("energy", "graph", 1)]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One tiny SAGE engine, ONE bucket (single compile) shared by the
+    whole module — tier-1 budget discipline."""
+    import jax
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    pads = [PadSpec.for_batch(4, 16, 64)]
+    example = collate([_sample()], pads[0], _HEADS)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    eng = InferenceEngine(cfg, state, _HEADS, pads)
+    eng.warmup()
+    return eng
+
+
+def _state_copy(engine, step=1):
+    """Host-numpy copy of the engine's live state (a structurally
+    identical 'new checkpoint')."""
+    import jax
+
+    return InferenceState(
+        step=step,
+        params=jax.tree_util.tree_map(np.asarray, engine.state.params),
+        batch_stats=jax.tree_util.tree_map(np.asarray,
+                                           engine.state.batch_stats))
+
+
+# ---------------------------------------------------------------------------
+# Admission control & load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_admission_shed_before_enqueue(engine):
+    """A request whose deadline the measured backlog drain already
+    exceeds is shed AT SUBMIT (429 path) — it never occupies a queue
+    slot, and Retry-After reflects the drain estimate."""
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    b = MicroBatcher(engine, max_wait_ms=0, max_queue=32,
+                     telemetry=MetricsLogger.disabled())
+    try:
+        # prime the drain-rate estimate (20 req/s) without running the
+        # worker, then back the queue up: 5 queued / 20 rps = 250 ms
+        b._rate_ewma = 20.0
+        for i in range(4):
+            b.submit(_sample(5, seed=i))  # no deadline: always admitted
+        with pytest.raises(RequestShedError) as ei:
+            b.submit(_sample(5, seed=9), deadline_s=0.05)
+        assert ei.value.retry_after_s >= 0.25
+        st = b.stats()
+        assert st["shed"] == 1
+        assert st["queue_depth"] == 4  # the shed request never queued
+        assert b.telemetry.health_counts.get("request_shed") == 1
+        # a generous deadline is still admitted through the same path
+        b.submit(_sample(5, seed=10), deadline_s=30.0)
+        # cold start never sheds: no rate estimate -> no basis
+        b2 = MicroBatcher(engine, max_wait_ms=0, max_queue=4)
+        b2.submit(_sample(5, seed=11), deadline_s=0.001)
+        b2.close(drain=False)
+    finally:
+        b.close(drain=False)
+
+
+def test_expired_entries_skipped_at_flush(engine):
+    """Entries whose deadline expired in the queue are failed BEFORE
+    batch formation; the stale burst does not poison the batch that
+    follows it (fresh requests still get real answers)."""
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    b = MicroBatcher(engine, max_wait_ms=0, max_queue=32,
+                     telemetry=MetricsLogger.disabled())
+    # enqueue BEFORE the worker starts: the tiny deadlines expire while
+    # the requests sit in the queue
+    dead = [b.submit(_sample(5, seed=i), deadline_s=0.01) for i in range(3)]
+    live = [b.submit(_sample(5, seed=10 + i), deadline_s=30.0)
+            for i in range(2)]
+    time.sleep(0.05)
+    b.start()
+    try:
+        for f in live:
+            assert f.result(timeout=30)["energy"].shape == (1,)
+        for f in dead:
+            with pytest.raises(DeadlineExpiredError):
+                f.result(timeout=5)
+        st = b.stats()
+        assert st["expired"] == 3
+        assert b.telemetry.health_counts.get("deadline_expired") == 3
+        # not poisoned: a subsequent request is served normally
+        assert b.submit(_sample(6, seed=20)).result(
+            timeout=30)["energy"].shape == (1,)
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog + circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    """Pure state machine: closed -> open at threshold, cooldown ->
+    half-open probe, probe failure re-opens, probe success closes;
+    threshold 0 disables; transition telemetry lands in the tally."""
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    tel = MetricsLogger.disabled()
+    br = CircuitBreaker(threshold=2, cooldown_s=0.08, telemetry=tel)
+    assert br.allow() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed" and br.allow()  # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert br.time_to_retry() > 0
+    time.sleep(0.1)
+    assert br.allow() and br.state == "half_open"  # cooldown elapsed
+    br.record_failure()                            # probe fails
+    assert br.state == "open"
+    time.sleep(0.1)
+    assert br.allow() and br.state == "half_open"
+    br.record_success()                            # probe succeeds
+    assert br.state == "closed" and br.time_to_retry() == 0.0
+    # a success resets the consecutive counter
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"
+    counts = tel.health_counts
+    assert counts["breaker_open"] == 2
+    assert counts["breaker_half_open"] == 2
+    assert counts["breaker_close"] == 1
+    # disabled breaker never gates or records
+    off = CircuitBreaker(threshold=0)
+    off.record_failure()
+    assert off.allow() and off.state == "closed"
+
+
+def test_predict_timeout_watchdog_trips_breaker(engine):
+    """Chaos-injected predict latency exceeds the watchdog: the flush
+    fails with PredictTimeoutError, consecutive timeouts trip the
+    breaker, and further submits fail fast with BreakerOpenError."""
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    tel = MetricsLogger.disabled()
+    chaos = ServeChaos(predict_ms=400.0, lat_from=1)
+    br = CircuitBreaker(threshold=2, cooldown_s=30.0, telemetry=tel)
+    b = MicroBatcher(engine, max_wait_ms=0, max_queue=8, telemetry=tel,
+                     predict_timeout_s=0.05, breaker=br,
+                     chaos=chaos).start()
+    try:
+        for seed in (20, 21):
+            with pytest.raises(PredictTimeoutError):
+                b.submit(_sample(5, seed=seed)).result(timeout=10)
+        assert br.state == "open"
+        with pytest.raises(BreakerOpenError) as ei:
+            b.submit(_sample(5, seed=22))
+        assert ei.value.retry_after_s > 0
+        st = b.stats()
+        assert st["predict_timeouts"] == 2
+        assert tel.health_counts.get("predict_timeout") == 2
+        assert tel.health_counts.get("breaker_open") == 1
+        assert chaos.injected_latency == 2
+    finally:
+        b.close(drain=False)
+
+
+def test_breaker_recovery_cycle(engine):
+    """Chaos predict failures trip the breaker; after the cooldown the
+    next flush is the half-open probe, and its (clean) success closes
+    the breaker — the full open -> half-open -> close cycle."""
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    tel = MetricsLogger.disabled()
+    chaos = ServeChaos(fail_steps={1, 2})  # first two flushes raise
+    br = CircuitBreaker(threshold=2, cooldown_s=0.15, telemetry=tel)
+    b = MicroBatcher(engine, max_wait_ms=0, max_queue=8, telemetry=tel,
+                     breaker=br, chaos=chaos).start()
+    try:
+        for seed in (30, 31):
+            with pytest.raises(RuntimeError, match="chaos"):
+                b.submit(_sample(5, seed=seed)).result(timeout=10)
+        assert br.state == "open"
+        with pytest.raises(BreakerOpenError):
+            b.submit(_sample(5, seed=32))
+        time.sleep(0.2)  # cooldown: the next submit becomes the probe
+        r = b.submit(_sample(5, seed=33)).result(timeout=10)
+        assert r["energy"].shape == (1,)
+        assert br.state == "closed"
+        assert tel.health_counts.get("breaker_close") == 1
+        assert tel.health_counts.get("breaker_half_open", 0) >= 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Hot reload: validation, parity, rollback
+# ---------------------------------------------------------------------------
+
+
+def test_reload_parity_and_corrupt_rollback(engine):
+    """A structurally-identical checkpoint hot-swaps in with
+    bit-identical predictions and zero recompiles; a chaos-corrupted
+    candidate fails golden-batch validation, the live state keeps
+    serving, and manual rollback restores the pre-reload state."""
+    s0 = _sample(7, seed=40)
+    r0 = engine.predict_samples([s0])[0]["energy"]
+    compiles_before = engine.cache_stats()["compiled_buckets"]
+
+    copy = _state_copy(engine, step=5)
+    report = engine.reload_state(copy)
+    assert report["step"] == 5
+    assert report["golden_max_delta"] == 0.0  # same weights, same outputs
+    np.testing.assert_array_equal(
+        engine.predict_samples([s0])[0]["energy"], r0)
+    # the cached executables are reused across the swap — no recompile
+    assert engine.cache_stats()["compiled_buckets"] == compiles_before
+    assert engine.telemetry.health_counts.get("reload_ok", 0) >= 1
+
+    # chaos-corrupted candidate: NaN params must fail the golden-batch
+    # finiteness check and leave the live state untouched
+    chaos = ServeChaos(reload_corrupt=1)
+    bad = chaos.on_reload_state(_state_copy(engine, step=6))
+    with pytest.raises(ReloadValidationError, match="non-finite"):
+        engine.reload_state(bad)
+    assert chaos.injected_corruptions == 1
+    np.testing.assert_array_equal(
+        engine.predict_samples([s0])[0]["energy"], r0)
+    assert engine.telemetry.health_counts.get("reload_rollback", 0) >= 1
+
+    # structure mismatch is rejected before any replay
+    import jax
+
+    wrong = InferenceState(
+        step=7,
+        params=jax.tree_util.tree_map(
+            lambda a: np.zeros(np.shape(a) + (2,), np.float32),
+            copy.params),
+        batch_stats=copy.batch_stats)
+    with pytest.raises(ReloadValidationError, match="structure"):
+        engine.reload_state(wrong)
+
+    # manual rollback restores the retained pre-reload state exactly once
+    assert engine.rollback(reason="test") is True
+    assert engine.rollback() is False
+    np.testing.assert_array_equal(
+        engine.predict_samples([s0])[0]["energy"], r0)
+    stats = engine.reload_stats()
+    assert stats["reloads"] == 1 and stats["rollbacks"] == 1
+    assert stats["reload_failures"] == 2
+
+
+# ---------------------------------------------------------------------------
+# HTTP: 429 + Retry-After, /reload, /healthz degradation, reload under load
+# ---------------------------------------------------------------------------
+
+
+def _post(port, path, obj, timeout=30.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _sample_json(s, **extra):
+    return {"x": s.x.tolist(), "pos": s.pos.tolist(),
+            "edge_index": s.edge_index.tolist(), **extra}
+
+
+@pytest.fixture()
+def server(engine):
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    engine.telemetry = MetricsLogger.disabled()
+    srv = InferenceServer(
+        engine,
+        serving=ServingConfig(port=0, max_wait_ms=5,
+                              request_deadline_ms=10_000.0,
+                              breaker_threshold=2, breaker_cooldown_s=30.0,
+                              predict_timeout_s=30.0),
+        chaos=None)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_http_deadline_shed_429_with_retry_after(server, engine):
+    # a zero budget expires in the queue -> shed -> 429 + Retry-After
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/predict",
+        data=json.dumps(_sample_json(_sample(5, seed=50),
+                                     timeout_ms=0)).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    # the header spelling works too
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/predict",
+        data=json.dumps(_sample_json(_sample(5, seed=51))).encode(),
+        headers={"Content-Type": "application/json", "X-Timeout-Ms": "0"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 429
+    # a sane deadline is served normally
+    code, out = _post(server.port, "/predict",
+                      _sample_json(_sample(5, seed=52), timeout_ms=10_000))
+    assert code == 200 and len(out["heads"]["energy"]) == 1
+    # negative timeout_ms is a client error, not a silent clamp
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.port, "/predict",
+              _sample_json(_sample(5, seed=53), timeout_ms=-5))
+    assert ei.value.code == 400
+
+
+def test_http_reload_healthz_and_breaker_rollback(server, engine, tmp_path):
+    """The full reload + degradation story over HTTP: /reload swaps a
+    checkpoint (200), a corrupt candidate is rejected with 409 while
+    serving continues, a breaker trip inside the reload probation rolls
+    the engine back automatically, /healthz degrades while the breaker
+    is not closed and recovers after a clean probe."""
+    s0 = _sample(6, seed=60)
+    base_stats = engine.reload_stats()  # module engine: cumulative
+    code, base = _post(server.port, "/predict", _sample_json(s0))
+    assert code == 200
+    assert _get(server.port, "/healthz")["status"] == "ok"
+
+    # write a real checkpoint pickle (the run_training payload format)
+    copy = _state_copy(engine, step=9)
+    ck = tmp_path / "cand.pk"
+    with open(ck, "wb") as f:
+        pickle.dump({"step": 9, "params": copy.params,
+                     "batch_stats": copy.batch_stats}, f)
+    code, out = _post(server.port, "/reload", {"checkpoint": str(ck)})
+    assert code == 200 and out["status"] == "ok" and out["step"] == 9
+    # zero dropped/changed answers across the swap
+    code, after = _post(server.port, "/predict", _sample_json(s0))
+    assert code == 200 and after["heads"] == base["heads"]
+
+    # corrupt candidate -> 409, old state keeps serving
+    bad = ServeChaos(reload_corrupt=1).on_reload_state(copy)
+    bad_ck = tmp_path / "bad.pk"
+    with open(bad_ck, "wb") as f:
+        pickle.dump({"step": 10, "params": bad.params,
+                     "batch_stats": bad.batch_stats}, f)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.port, "/reload", {"checkpoint": str(bad_ck)})
+    assert ei.value.code == 409
+    assert json.loads(ei.value.read())["status"] == "rolled_back"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.port, "/reload", {"checkpoint": str(tmp_path / "no.pk")})
+    assert ei.value.code == 404
+    # reload_root allowlist: a path outside the configured root is 403
+    # (loopback-only default is what let the requests above through)
+    server.serving.reload_root = str(tmp_path)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.port, "/reload", {"checkpoint": "/etc/hostname"})
+    assert ei.value.code == 403
+    server.serving.reload_root = ""
+    code, after = _post(server.port, "/predict", _sample_json(s0))
+    assert code == 200 and after["heads"] == base["heads"]
+
+    # breaker trip inside the reload probation: auto-rollback to the
+    # pre-reload state + half-open breaker -> /healthz "degraded"
+    assert server.engine.reload_stats()["can_rollback"]
+    for _ in range(server.breaker.threshold):
+        server.breaker.record_failure()
+    assert server.engine.reload_stats()["rollbacks"] \
+        == base_stats["rollbacks"] + 1
+    assert server.breaker.state == "half_open"  # reset by the rollback
+    h = _get(server.port, "/healthz")
+    assert h["status"] == "degraded"
+    assert h["breaker"]["state"] == "half_open"
+    # the next clean flush is the probe: service recovers, healthz too
+    code, after = _post(server.port, "/predict", _sample_json(s0))
+    assert code == 200 and after["heads"] == base["heads"]
+    h = _get(server.port, "/healthz")
+    assert h["status"] == "ok" and h["breaker"]["state"] == "closed"
+    m = _get(server.port, "/metrics")
+    assert m["reload"]["reloads"] == base_stats["reloads"] + 1
+    assert m["reload"]["rollbacks"] == base_stats["rollbacks"] + 1
+    assert m["breaker"]["opens"] == 1  # breaker is per-server: fresh
+
+
+def test_reload_under_load_zero_drops(server, engine, tmp_path):
+    """A hot reload while requests are in flight drops nothing: every
+    request before, during and after the swap is answered 200, and
+    post-reload predictions are bit-identical (same weights)."""
+    s0 = _sample(6, seed=70)
+    ref = _post(server.port, "/predict", _sample_json(s0))[1]["heads"]
+    copy = _state_copy(engine, step=11)
+    ck = tmp_path / "swap.pk"
+    with open(ck, "wb") as f:
+        pickle.dump({"step": 11, "params": copy.params,
+                     "batch_stats": copy.batch_stats}, f)
+
+    results, errors = [], []
+
+    def client():
+        for i in range(16):
+            try:
+                results.append(_post(server.port, "/predict",
+                                     _sample_json(s0)))
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.05)  # land the reload mid-stream
+    code, out = _post(server.port, "/reload", {"checkpoint": str(ck)})
+    assert code == 200 and out["status"] == "ok"
+    t.join(timeout=60)
+    assert not errors, errors
+    assert len(results) == 16
+    assert all(code == 200 for code, _ in results)
+    # bit-identical across the swap (same weights in the new checkpoint)
+    assert all(out["heads"] == ref for _, out in results)
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing for the new knobs
+# ---------------------------------------------------------------------------
+
+
+def test_robustness_config_knobs_and_env(monkeypatch):
+    d = ServingConfig()
+    assert d.request_deadline_ms > 0 and d.breaker_threshold > 0
+    with pytest.raises(ValueError):
+        ServingConfig(request_deadline_ms=-1)
+    with pytest.raises(ValueError):
+        ServingConfig(breaker_threshold=-2)
+    with pytest.raises(ValueError):
+        ServingConfig(predict_timeout_s=-0.5)
+    monkeypatch.setenv("HYDRAGNN_SERVE_DEADLINE_MS", "250")
+    monkeypatch.setenv("HYDRAGNN_SERVE_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("HYDRAGNN_SERVE_BREAKER_COOLDOWN_S", "1.5")
+    monkeypatch.setenv("HYDRAGNN_SERVE_PREDICT_TIMEOUT_S", "2.5")
+    monkeypatch.setenv("HYDRAGNN_SERVE_RELOAD_WATCH", "/tmp/ck.pk")
+    monkeypatch.setenv("HYDRAGNN_SERVE_RELOAD_WATCH_S", "0.5")
+    cfg = ServingConfig.from_section({"request_deadline_ms": 9000})
+    assert cfg.request_deadline_ms == 250.0  # env wins over config
+    assert cfg.breaker_threshold == 3
+    assert cfg.breaker_cooldown_s == 1.5
+    assert cfg.predict_timeout_s == 2.5
+    assert cfg.reload_watch_path == "/tmp/ck.pk"
+    assert cfg.reload_watch_s == 0.5
+    # the finalize-written Serving defaults carry the new knobs
+    from hydragnn_tpu.serve import serving_defaults
+
+    for key in ("request_deadline_ms", "predict_timeout_s",
+                "breaker_threshold", "breaker_cooldown_s",
+                "reload_probation_s", "reload_watch_path",
+                "reload_watch_s", "reload_root"):
+        assert key in serving_defaults()
+    monkeypatch.setenv("HYDRAGNN_SERVE_RELOAD_ROOT", "/ckpts")
+    assert ServingConfig.from_section(None).reload_root == "/ckpts"
+
+
+def test_serve_chaos_env_parsing(monkeypatch):
+    assert ServeChaos.from_env() is None  # nothing armed
+    monkeypatch.setenv("HYDRAGNN_CHAOS_SERVE_PREDICT_MS", "250@3+")
+    monkeypatch.setenv("HYDRAGNN_CHAOS_SERVE_FAIL_STEP", "2,5")
+    monkeypatch.setenv("HYDRAGNN_CHAOS_SERVE_RELOAD_CORRUPT", "1")
+    c = ServeChaos.from_env()
+    assert c.predict_ms == 250.0 and c.lat_from == 3
+    assert c.fail_steps == {2, 5} and c.reload_corrupt == 1
+    # bare latency spec arms every flush
+    monkeypatch.setenv("HYDRAGNN_CHAOS_SERVE_PREDICT_MS", "100")
+    monkeypatch.delenv("HYDRAGNN_CHAOS_SERVE_FAIL_STEP")
+    monkeypatch.delenv("HYDRAGNN_CHAOS_SERVE_RELOAD_CORRUPT")
+    c = ServeChaos.from_env()
+    assert c.predict_ms == 100.0 and c.lat_from == 1

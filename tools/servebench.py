@@ -1,27 +1,35 @@
 #!/usr/bin/env python
-"""servebench: closed-loop load generator for the serving subsystem.
+"""servebench: closed- and open-loop load generator for serving.
 
     python tools/servebench.py --selftest                 # self-hosted bench
     python tools/servebench.py --url http://host:port \
         [--concurrency 4] [--requests 200] [--nodes 12] \
         [--out BENCH_serve.json]
+    python tools/servebench.py --selftest --overload \
+        [--rate 0] [--duration 8] [--deadline-ms 250]     # overload probe
 
-Closed loop: each of ``--concurrency`` workers POSTs random graphs to
-``/predict`` back-to-back (next request only after the previous
-response), so offered load adapts to service rate — the standard way to
-measure latency without coordinated-omission artifacts from an open-loop
-generator outrunning the server.
+Closed loop (default): each of ``--concurrency`` workers POSTs random
+graphs to ``/predict`` back-to-back (next request only after the
+previous response), so offered load adapts to service rate — the
+standard way to measure latency without coordinated-omission artifacts
+from an open-loop generator outrunning the server.
+
+Open loop (``--overload``): requests fire at a FIXED arrival rate
+regardless of completions (``--rate`` req/s; 0 = auto, 2x a measured
+closed-loop capacity probe), each carrying a ``timeout_ms`` deadline.
+This is the measurement harness for the admission-control acceptance
+criterion (docs/SERVING.md "Overload behavior"): above capacity the
+server must SHED with 429s instead of erroring — reported as goodput
+(200s/s), shed rate, p99-of-accepted (measured from the SCHEDULED fire
+time, so queue-building is not hidden), and a zero-5xx check.
 
 ``--selftest`` builds a tiny fresh-initialized model + server in-process
 on an ephemeral port (no checkpoint needed), benches it, and shuts it
 down — the zero-setup smoke path CI and future perf PRs track.
 
-Reported (and emitted as BENCH_serve-style JSON): throughput,
+Reported (and emitted as BENCH_serve[_overload].json): throughput,
 p50/p95/p99/max latency, batch fill %, compile-cache hit rate, flush
-reasons, and an SLO check — every request should complete within
-``max_wait_ms`` (the batching deadline) + up to two predict times (the
-in-flight batch ahead of it + its own) + a transport allowance; with the
-AOT warmup the steady-state cache-hit rate must be 100%.
+reasons, and the SLO check for the selected mode.
 """
 
 from __future__ import annotations
@@ -33,7 +41,7 @@ import sys
 import threading
 import time
 import urllib.request
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
@@ -154,9 +162,171 @@ def run_bench(url: str, concurrency: int, requests_total: int,
     return result
 
 
-def _selftest_server():
+def run_overload(url: str, rate: float, duration_s: float, max_nodes: int,
+                 input_dim: int = 1, deadline_ms: float = 250.0,
+                 capacity_rps: float = 0.0) -> Dict[str, Any]:
+    """Open-loop overload probe: fire at ``rate`` req/s for
+    ``duration_s``, each request carrying a ``timeout_ms`` deadline.
+
+    Latency is measured from the SCHEDULED fire time (not the actual
+    send), so a generator falling behind shows up as latency instead of
+    silently thinning the offered load (coordinated omission).  A
+    bounded worker pool replays the schedule; the pool is sized so
+    sheds (fast 429s) keep workers available.
+    """
+    n_total = max(1, int(rate * duration_s))
+    lock = threading.Lock()
+    idx = [0]
+    accepted: List[float] = []   # latency ms of 200s, from scheduled fire
+    shed_429 = [0]
+    rejected_503 = [0]
+    other_4xx: List[str] = []    # 400/404/413/...: a misconfigured bench
+    errors_5xx: List[str] = []
+    other_errors: List[str] = []
+    rng_global = np.random.RandomState(7)
+    # pre-build request bodies: JSON encode off the hot path
+    bodies = [json.dumps({**random_graph(rng_global, max_nodes, input_dim),
+                          "timeout_ms": deadline_ms}).encode()
+              for _ in range(min(64, n_total))]
+
+    t_start = time.perf_counter() + 0.2  # let all workers arm
+
+    def worker():
+        import urllib.error
+
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= n_total:
+                    return
+                idx[0] += 1
+            t_fire = t_start + i / rate
+            now = time.perf_counter()
+            if t_fire > now:
+                time.sleep(t_fire - now)
+            req = urllib.request.Request(
+                url + "/predict", data=bodies[i % len(bodies)],
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+            except Exception as e:  # noqa: BLE001 — transport failure
+                with lock:
+                    other_errors.append(repr(e))
+                continue
+            dt_ms = (time.perf_counter() - t_fire) * 1e3
+            with lock:
+                if code == 200:
+                    accepted.append(dt_ms)
+                elif code == 429:
+                    shed_429[0] += 1
+                elif code == 503:
+                    rejected_503[0] += 1
+                elif code >= 500:
+                    errors_5xx.append(str(code))
+                else:
+                    other_4xx.append(str(code))
+
+    # enough workers that the open loop can keep firing while accepted
+    # requests wait out their deadline server-side — an undersized pool
+    # silently turns this into a closed loop and hides the overload
+    n_workers = max(8, min(512, int(rate)))
+    threads = [threading.Thread(target=worker) for _ in range(n_workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+
+    lat = np.asarray(sorted(accepted)) if accepted else np.zeros(1)
+    metrics = _get(url, "/metrics")
+    bat = metrics.get("batcher", {})
+    eng = metrics.get("engine", {})
+    n_answered = len(accepted) + shed_429[0] + rejected_503[0] \
+        + len(other_4xx) + len(errors_5xx)
+    goodput = len(accepted) / wall_s if wall_s else 0.0
+    result = {
+        "bench": "serve_overload",
+        "config": {
+            "url": url,
+            "offered_rps": round(rate, 2),
+            "duration_s": duration_s,
+            "requests_total": n_total,
+            "deadline_ms": deadline_ms,
+            "max_nodes": max_nodes,
+            "measured_capacity_rps": round(capacity_rps, 2),
+        },
+        "accepted": len(accepted),
+        "shed_429": shed_429[0],
+        "rejected_503": rejected_503[0],
+        "other_4xx": len(other_4xx),
+        "other_4xx_samples": other_4xx[:3],
+        "errors_5xx": len(errors_5xx),
+        "transport_errors": len(other_errors),
+        "transport_error_samples": other_errors[:3],
+        "wall_s": round(wall_s, 3),
+        "goodput_rps": round(goodput, 2),
+        "shed_rate": round(shed_429[0] / n_answered, 4) if n_answered else 0,
+        "latency_accepted_ms": {
+            "p50": round(float(np.percentile(lat, 50)), 3),
+            "p95": round(float(np.percentile(lat, 95)), 3),
+            "p99": round(float(np.percentile(lat, 99)), 3),
+            "max": round(float(lat.max()), 3),
+        },
+        "batcher": {
+            "shed": int(bat.get("shed", 0)),
+            "expired": int(bat.get("expired", 0)),
+            "drain_rate_rps": float(bat.get("drain_rate_rps", 0.0)),
+            "avg_fill_pct": round(float(bat.get("avg_fill_pct", 0.0)), 2),
+            "full_flushes": int(bat.get("full_flushes", 0)),
+            "deadline_flushes": int(bat.get("deadline_flushes", 0)),
+        },
+        "cache_misses": int(eng.get("misses", 0)),
+    }
+    # the acceptance gate (ISSUE 5): shed with 429s instead of erroring —
+    # zero 5xx, p99 of ACCEPTED requests within the deadline (plus a
+    # small transport allowance the server cannot control: client-side
+    # connect/parse/GIL scheduling, measured from the SCHEDULED fire
+    # time), and (when a capacity probe ran) goodput within 10% of the
+    # measured sustainable capacity
+    transport_allowance_ms = 10.0
+    p99_ok = float(np.percentile(lat, 99)) \
+        <= deadline_ms + transport_allowance_ms if accepted else False
+    goodput_ok = goodput >= 0.9 * capacity_rps if capacity_rps > 0 \
+        else bool(accepted)
+    result["slo"] = {
+        "zero_5xx": not errors_5xx,
+        # any OTHER 4xx (400/404/413) means the bench itself is
+        # misconfigured for the server under test — fail loudly rather
+        # than report a clean shed profile over invalid requests
+        "zero_other_4xx": not other_4xx,
+        "transport_allowance_ms": transport_allowance_ms,
+        "p99_within_deadline": p99_ok,
+        "goodput_within_10pct_of_capacity": goodput_ok,
+        "ok": bool(not errors_5xx and not other_4xx and not other_errors
+                   and p99_ok and goodput_ok),
+    }
+    return result
+
+
+def _selftest_server(deadline_ms: float = 10_000.0,
+                     chaos_predict_ms: float = 0.0,
+                     buckets: Tuple[int, ...] = (1, 4, 16)):
     """Tiny fresh-initialized SAGE model behind a local server on an
-    ephemeral port — no checkpoint, no dataset."""
+    ephemeral port — no checkpoint, no dataset.
+
+    ``chaos_predict_ms`` injects per-flush predict latency through the
+    serving chaos harness (resilience/chaos.py:ServeChaos) — the
+    overload selftest uses it to pull the tiny CPU model's capacity
+    down to a rate a Python-thread open-loop generator (and the stdlib
+    accept loop) can genuinely exceed; the capacity probe runs against
+    the SAME slowed server, so the 2x-capacity claim stays honest.
+    """
     import jax
 
     from hydragnn_tpu.graph.batch import (
@@ -180,15 +350,20 @@ def _selftest_server():
         example, train=False)
     state = InferenceState(step=0, params=variables["params"],
                            batch_stats=variables.get("batch_stats", {}))
-    serving = ServingConfig(buckets=(1, 4, 16), max_nodes_per_graph=16,
+    serving = ServingConfig(buckets=buckets, max_nodes_per_graph=16,
                             max_edges_per_graph=128, max_wait_ms=10.0,
-                            port=0)
+                            port=0, request_deadline_ms=deadline_ms)
     pads = [PadSpec.for_batch(b, serving.max_nodes_per_graph,
                               serving.max_edges_per_graph)
             for b in serving.buckets]
     engine = InferenceEngine(cfg, state, [HeadSpec("energy", "graph", 1)],
                              pads, serving=serving)
-    server = InferenceServer(engine, serving=serving)
+    chaos = None
+    if chaos_predict_ms > 0:
+        from hydragnn_tpu.resilience import ServeChaos
+
+        chaos = ServeChaos(predict_ms=chaos_predict_ms, lat_from=1)
+    server = InferenceServer(engine, serving=serving, chaos=chaos)
     server.start()
     return server
 
@@ -208,31 +383,84 @@ def main(argv=None) -> int:
     ap.add_argument("--input-dim", type=int, default=1,
                     help="node feature dim of request graphs (match the "
                          "served model)")
-    ap.add_argument("--out", default="BENCH_serve.json",
-                    help="output JSON path (default BENCH_serve.json)")
+    ap.add_argument("--overload", action="store_true",
+                    help="open-loop overload mode: fixed arrival rate "
+                         "above capacity; reports goodput/shed "
+                         "rate/p99-of-accepted")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="overload arrival rate in req/s (0 = auto: 2x a "
+                         "measured closed-loop capacity probe)")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="overload run length in seconds (default 8)")
+    ap.add_argument("--deadline-ms", type=float, default=250.0,
+                    help="per-request deadline in overload mode "
+                         "(default 250)")
+    ap.add_argument("--chaos-predict-ms", type=float, default=25.0,
+                    help="selftest overload only: chaos-injected predict "
+                         "latency that pulls capacity into the "
+                         "generator's envelope (default 25; 0 = off)")
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default BENCH_serve.json, "
+                         "or BENCH_serve_overload.json with --overload)")
     args = ap.parse_args(argv)
+    out_path = args.out or ("BENCH_serve_overload.json" if args.overload
+                            else "BENCH_serve.json")
 
     server = None
     url = args.url
     if args.selftest or url is None:
-        server = _selftest_server()
+        # overload selftest: a small top bucket + injected predict
+        # latency keep TRUE (batched) capacity low enough that the
+        # thread-pool open loop and the stdlib accept loop can offer a
+        # genuine 2x overload
+        server = _selftest_server(
+            deadline_ms=args.deadline_ms if args.overload else 10_000.0,
+            chaos_predict_ms=args.chaos_predict_ms if args.overload
+            else 0.0,
+            buckets=(1, 2, 4) if args.overload else (1, 4, 16))
         url = f"http://127.0.0.1:{server.port}"
         print(f"selftest server on {url}", flush=True)
     try:
-        result = run_bench(url.rstrip("/"), args.concurrency, args.requests,
-                           args.nodes, args.input_dim)
+        url = url.rstrip("/")
+        if args.overload:
+            rate, capacity = args.rate, 0.0
+            if rate <= 0:
+                # capacity probe: a SATURATING closed-loop run (enough
+                # workers to keep buckets full) measures the sustainable
+                # batched service rate; overload = 2x that
+                probe = run_bench(url, 32, 320, args.nodes, args.input_dim)
+                capacity = float(probe["throughput_rps"])
+                rate = max(2.0 * capacity, 1.0)
+                print(f"capacity probe: {capacity:.1f} req/s sustained -> "
+                      f"offering {rate:.1f} req/s", flush=True)
+            result = run_overload(url, rate, args.duration, args.nodes,
+                                  args.input_dim, args.deadline_ms,
+                                  capacity_rps=capacity)
+        else:
+            result = run_bench(url, args.concurrency, args.requests,
+                               args.nodes, args.input_dim)
     finally:
         if server is not None:
             server.shutdown()
-    with open(args.out, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result, indent=2))
-    print(f"\nwrote {args.out}")
+    print(f"\nwrote {out_path}")
     slo = result["slo"]
-    print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: max latency "
-          f"{slo['max_latency_ms']} ms vs bound {slo['bound_ms']} ms, "
-          f"cache hit rate {result['cache']['hit_rate_post_warmup']:.2%} "
-          "post-warmup")
+    if args.overload:
+        print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: goodput "
+              f"{result['goodput_rps']} rps at "
+              f"{result['config']['offered_rps']} rps offered, shed rate "
+              f"{result['shed_rate']:.1%}, p99 accepted "
+              f"{result['latency_accepted_ms']['p99']} ms vs deadline "
+              f"{result['config']['deadline_ms']} ms, "
+              f"{result['errors_5xx']} 5xx")
+    else:
+        print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: max latency "
+              f"{slo['max_latency_ms']} ms vs bound {slo['bound_ms']} ms, "
+              f"cache hit rate "
+              f"{result['cache']['hit_rate_post_warmup']:.2%} "
+              "post-warmup")
     return 0 if slo["ok"] else 1
 
 

@@ -112,7 +112,10 @@ def health_section(health: List[Dict[str, Any]],
                   "error", "consecutive",
                   # serving events (docs/SERVING.md)
                   "n", "reason", "fill_pct", "wait_ms", "predict_ms",
-                  "depth", "port", "served"):
+                  "depth", "port", "served",
+                  # overload/reload events
+                  "est_wait_ms", "deadline_ms", "waited_ms", "timeout_s",
+                  "cooldown_s", "source", "golden_max_delta"):
             if r.get(f) is not None:
                 where.append(f"{f}={r[f]}")
         lines.append(f"  {kind}: " + "  ".join(where))
@@ -122,7 +125,16 @@ def health_section(health: List[Dict[str, Any]],
 # serving event kinds (docs/TELEMETRY.md "Serving events"): emitted by
 # hydragnn_tpu/serve through the same MetricsLogger.health spine
 _SERVING_KINDS = ("request_enqueued", "batch_flushed", "deadline_flush",
-                  "cache_miss", "batch_error", "serve_start", "serve_drain")
+                  "cache_miss", "batch_error", "serve_start", "serve_drain",
+                  # overload/robustness events (docs/SERVING.md
+                  # "Overload behavior")
+                  "request_shed", "deadline_expired", "predict_timeout",
+                  "breaker_open", "breaker_half_open", "breaker_close",
+                  "reload_ok", "reload_rollback")
+
+# WARN when more than this fraction of offered requests were shed
+# (request_shed + deadline_expired over offered = enqueued + shed)
+_SHED_WARN_RATIO = 0.10
 
 
 def serving_section(health: List[Dict[str, Any]],
@@ -157,6 +169,38 @@ def serving_section(health: List[Dict[str, Any]],
     if n_miss:
         lines.append(f"  WARNING {n_miss} steady-state compile(s) — a "
                      "request shape missed the warmed bucket ladder")
+    # overload accounting: shed ratio over OFFERED requests (accepted +
+    # shed-at-admission; expired entries were accepted, then died in
+    # the queue)
+    n_shed = counts.get("request_shed", 0) + counts.get(
+        "deadline_expired", 0)
+    offered = counts.get("request_enqueued", 0) + counts.get(
+        "request_shed", 0)
+    if n_shed and offered:
+        ratio = n_shed / offered
+        lines.append(f"  shed {n_shed}/{offered} offered "
+                     f"({100.0 * ratio:.1f}%: "
+                     f"{counts.get('request_shed', 0)} at admission, "
+                     f"{counts.get('deadline_expired', 0)} expired in "
+                     "queue)")
+        if ratio > _SHED_WARN_RATIO:
+            lines.append(f"  WARNING shed ratio {100.0 * ratio:.1f}% "
+                         f"exceeds {100.0 * _SHED_WARN_RATIO:.0f}% — the "
+                         "server is overloaded (raise capacity, lower "
+                         "deadlines, or add replicas)")
+    n_open = counts.get("breaker_open", 0)
+    if n_open:
+        closes = counts.get("breaker_close", 0)
+        state = "recovered" if closes >= n_open else "possibly still open"
+        lines.append(f"  WARNING circuit breaker opened {n_open} time(s), "
+                     f"closed {closes} ({state}) — see predict_timeout/"
+                     "batch_error events")
+    n_rb = counts.get("reload_rollback", 0)
+    if n_rb:
+        lines.append(f"  WARNING {n_rb} checkpoint reload rollback(s) — "
+                     "a candidate failed validation or tripped the "
+                     "breaker (reload_ok: "
+                     f"{counts.get('reload_ok', 0)})")
     return "\n".join(lines)
 
 
