@@ -1,0 +1,48 @@
+"""Low-precision inference states (docs/SERVING.md "Quantized
+inference"): f32 / bf16 / int8 weight-only dtype policies applied when
+``load_inference_state`` builds an InferenceState, gated by the
+engine's golden-batch replay against the f32 reference.
+
+``POLICIES``/``check_policy`` live here, dependency-free, because
+``config.finalize`` validates ``Serving.quant_policy`` in config-only
+callers that must not drag flax/jax in; everything else resolves
+lazily (PEP 562) from :mod:`hydragnn_tpu.quant.policy`.
+"""
+
+POLICIES = ("f32", "bf16", "int8")
+
+
+def check_policy(policy: str) -> str:
+    if policy not in POLICIES:
+        raise ValueError(
+            f"unknown quant policy {policy!r} (choose from {POLICIES})")
+    return policy
+
+
+_EXPORTS = (
+    "QTensor",
+    "apply_policy",
+    "cast_floats",
+    "dequantize",
+    "dequantize_tree",
+    "policy_summary",
+    "quantize_int8",
+    "tree_nbytes",
+    "wrap_eval_step",
+)
+
+__all__ = sorted(_EXPORTS + ("POLICIES", "check_policy"))
+
+
+def __getattr__(name: str):
+    if name not in _EXPORTS:
+        raise AttributeError(
+            f"module 'hydragnn_tpu.quant' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module("hydragnn_tpu.quant.policy"),
+                   name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,223 @@
+"""Inference dtype policies: f32 baseline, bf16, int8 weight-only.
+
+The serving stack is padding- and memory-bound, not FLOP-bound
+(BENCH_r05: the mainline SchNet run sits at ~1.2% of roofline), so the
+cheapest per-chip rps lever is shrinking the resident parameter bytes —
+more replicas (and more tenant checkpoints) fit per chip, and every
+weight load moves half (bf16) or a quarter (int8) of the HBM traffic.
+
+Three policies, selected by ``Serving.quant_policy``:
+
+- ``f32``   — identity.  The engine's compiled program stays BYTE-equal
+  to the training eval step, preserving the bit-parity contract with
+  ``run_prediction``.
+- ``bf16``  — every float leaf of params/batch_stats cast to bfloat16,
+  and the eval step wrapped so batch floats are cast on entry and
+  outputs are cast back to f32 on exit: weights AND compute in bf16
+  (f32 accumulation inside the MXU), half the resident bytes.
+- ``int8``  — weight-only quantization: 2-D+ kernels become
+  :class:`QTensor` (int8 values + per-output-channel f32 scales,
+  ~0.26x the f32 bytes), everything else falls to bf16.  At apply time
+  the kernels are dequantized INTO bf16 (``q * scale -> bf16``) so the
+  matmuls themselves run bf16 — XLA fuses the dequant into the
+  consumer, and the resident state stays int8.
+
+Quantization here is LOSSY by design and gated downstream: the engine
+only activates a non-f32 policy when a golden-batch replay against the
+f32 reference stays under ``Serving.quant_tolerance``
+(serve/engine.py).  Nothing in this module decides acceptance.
+
+Per-channel scales are along the LAST axis (flax Dense kernels are
+``[in, out]``: one scale per output channel), ``absmax / 127``
+symmetric — the weight distribution per output unit is what varies
+across a trained layer, and symmetric scaling keeps the dequant a
+single fused multiply.  Leaves with fewer than 2 rows are NOT
+quantized: the f32 scale vector would cost as much as the int8 win.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from flax import struct
+
+# canonical policy list + validator live in hydragnn_tpu/quant/__init__
+# (dependency-free for config-only callers); re-exported here for the
+# engine-side consumers that already pay the flax import
+from hydragnn_tpu.quant import POLICIES, check_policy  # noqa: F401
+
+
+@struct.dataclass
+class QTensor:
+    """int8 weight + per-output-channel scale (last-axis channels).
+
+    A pytree node (flax struct), so quantized param trees flow through
+    ``jax.device_put`` / ``jit`` / the engine's aval-specialized AOT
+    executables like any other state."""
+
+    q: Any      # int8, same shape as the source weight
+    scale: Any  # f32, [shape[-1]]
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.nbytes) + int(self.scale.nbytes)
+
+
+def quantize_int8(w) -> QTensor:
+    """Symmetric per-channel int8 quantization along the last axis."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    # all-zero channels get scale 1 so dequant is exactly zero (0 * 1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale.astype(jnp.float32))
+
+
+def dequantize(qt: QTensor, dtype=None):
+    """``q * scale`` in f32, cast into ``dtype`` (default bfloat16) —
+    the bf16 operand the policy's matmuls consume."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
+
+
+def _is_qtensor(x) -> bool:
+    return isinstance(x, QTensor)
+
+
+def dequantize_tree(tree, dtype=None):
+    """Replace every QTensor leaf with its bf16 dequantization; other
+    leaves pass through untouched."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x, dtype) if _is_qtensor(x) else x,
+        tree, is_leaf=_is_qtensor)
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype``; ints, bools
+    and QTensors are untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    def _cast(x):
+        if _is_qtensor(x):
+            return x
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree, is_leaf=_is_qtensor)
+
+
+def _quantizable(x) -> bool:
+    """Weight-only gate: float, >= 2 dims, >= 2 rows per channel (below
+    that the f32 scale vector costs as much as the int8 saving)."""
+    import numpy as np
+
+    shape = np.shape(x)
+    dt = getattr(x, "dtype", None)
+    if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+        return False
+    if len(shape) < 2 or shape[-1] < 1:
+        return False
+    rows = 1
+    for s in shape[:-1]:
+        rows *= int(s)
+    return rows >= 2
+
+
+def apply_policy(state, policy: str):
+    """Return ``state`` with its params/batch_stats transformed by the
+    dtype policy.  ``state`` is any object with ``params``,
+    ``batch_stats`` and a dataclass-style ``.replace`` (InferenceState).
+    ``f32`` returns the state unchanged (identity object — the caller's
+    bit-parity guarantee)."""
+    import jax
+    import jax.numpy as jnp
+
+    check_policy(policy)
+    if policy == "f32":
+        return state
+    if policy == "bf16":
+        return state.replace(
+            params=cast_floats(state.params, jnp.bfloat16),
+            batch_stats=cast_floats(state.batch_stats, jnp.bfloat16))
+    # int8 weight-only: kernels -> QTensor, the rest -> bf16
+    params = jax.tree_util.tree_map(
+        lambda x: quantize_int8(x) if _quantizable(x)
+        else cast_floats(x, jnp.bfloat16),
+        state.params)
+    return state.replace(
+        params=params,
+        batch_stats=cast_floats(state.batch_stats, jnp.bfloat16))
+
+
+def wrap_eval_step(eval_step, policy: str):
+    """Wrap a ``(state, batch) -> metrics`` eval step for a low-precision
+    policy: batch floats cast to bf16 on entry (params are already bf16 /
+    int8, so the model's matmuls run bf16), QTensor kernels dequantized
+    into bf16 inside the traced program (XLA fuses the multiply into the
+    consumers; the RESIDENT buffers stay int8), and every float output
+    cast back to f32 so host-side unpacking/denormalization sees the
+    dtypes it always has."""
+    import jax.numpy as jnp
+
+    check_policy(policy)
+    if policy == "f32":
+        return eval_step
+
+    def wrapped(state, batch):
+        batch = cast_floats(batch, jnp.bfloat16)
+        if policy == "int8":
+            state = state.replace(params=dequantize_tree(state.params))
+        m = eval_step(state, batch)
+        return cast_floats(m, jnp.float32)
+
+    return wrapped
+
+
+def tree_nbytes(tree) -> int:
+    """Resident bytes of every leaf in a pytree (QTensor counts q +
+    scale) — the number behind the HBM-halving claim, reported by
+    ``InferenceEngine.cache_stats`` and tools/servebench.py."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is None:
+            nb = np.asarray(leaf).nbytes
+        total += int(nb)
+    return total
+
+
+def policy_summary(params, batch_stats=None) -> Dict[str, Any]:
+    """Small introspection helper: leaf counts + resident bytes split by
+    representation (int8 / bf16 / f32 / other)."""
+    import jax
+    import numpy as np
+
+    by: Dict[str, int] = {}
+    leaves = jax.tree_util.tree_leaves(
+        (params, batch_stats if batch_stats is not None else {}),
+        is_leaf=_is_qtensor)
+    for leaf in leaves:
+        if _is_qtensor(leaf):
+            by["int8"] = by.get("int8", 0) + leaf.nbytes
+            continue
+        dt = str(np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
+        key = {"bfloat16": "bf16", "float32": "f32"}.get(dt, dt)
+        by[key] = by.get(key, 0) + int(getattr(leaf, "nbytes", 0))
+    return {"bytes_by_repr": by, "total_bytes": sum(by.values())}

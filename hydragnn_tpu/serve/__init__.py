@@ -1,8 +1,10 @@
 """Online inference serving subsystem (docs/SERVING.md).
 
 Checkpoint -> :func:`load_inference_state` (params + batch_stats, no
-optimizer) -> :class:`InferenceEngine` (bucketed AOT compile cache, hot
-reload with golden-batch validation + rollback) -> :class:`MicroBatcher`
+optimizer; optional f32/bf16/int8 dtype policy via hydragnn_tpu/quant)
+-> :class:`InferenceEngine` (bucketed AOT compile cache, golden-gated
+quantized states, hot reload with golden-batch validation + rollback)
+-> :class:`MicroBatcher`
 (fill-or-deadline dynamic micro-batching, deadline-based load shedding,
 predict watchdog + circuit breaker) -> :class:`InferenceServer` (stdlib
 HTTP: /predict, /reload, /healthz, /metrics, graceful SIGTERM drain).
@@ -17,6 +19,13 @@ every config-only caller.
 """
 
 _EXPORTS = {
+    "bucket_cost": "hydragnn_tpu.serve.autotune",
+    "demands_from_flushes": "hydragnn_tpu.serve.autotune",
+    "expected_cost": "hydragnn_tpu.serve.autotune",
+    "replay_flushes": "hydragnn_tpu.serve.autotune",
+    "required_capacity": "hydragnn_tpu.serve.autotune",
+    "simulate_bursts": "hydragnn_tpu.serve.autotune",
+    "tune_ladder": "hydragnn_tpu.serve.autotune",
     "BatcherClosedError": "hydragnn_tpu.serve.batcher",
     "DeadlineExpiredError": "hydragnn_tpu.serve.batcher",
     "MicroBatcher": "hydragnn_tpu.serve.batcher",

@@ -1,0 +1,279 @@
+"""Padding-waste-driven bucket-ladder auto-tuning.
+
+The serving bucket ladder (``Serving.buckets``) fixes which padded batch
+shapes get AOT-compiled; every flush then pays the padded-slot cost of
+the smallest bucket that fits it.  A ladder tuned for the wrong traffic
+burns FLOPs and latency on padding — the per-flush padding % the
+batcher records (telemetry serve step records, docs/TELEMETRY.md) is
+the direct measurement of that waste.
+
+This module turns those measurements back into a ladder:
+
+- :func:`required_capacity` — the smallest batch capacity (graphs)
+  whose PadSpec fits a flush of ``(ng, nn, ne)`` real graphs / nodes /
+  edges: the ladder-independent "demand" of the flush.  The batcher
+  tallies a live histogram of these (``flush_demands`` in its stats).
+- :func:`tune_ladder` — given a demand histogram, solve for the ladder
+  of at most ``max_ladder`` capacities minimizing expected padded
+  slots (nodes + edges — the FLOP proxy every message-passing layer
+  scales with).  Exact DP over distinct demand values: an optimal
+  ladder only needs points AT observed demands (any other point could
+  be lowered to the next demand below it without losing coverage), so
+  the search space is the demand set itself — O(m^2 * K) for m
+  distinct demands.
+- :func:`replay_flushes` — validate a candidate ladder by replaying
+  recorded flushes through the engine's own bucket-selection rule
+  (smallest fitting bucket, the ``select_bucket`` slot conventions).
+- :func:`simulate_bursts` — build a synthetic flush stream from a
+  request-size distribution + burst (arrival) model, for tuning from
+  ``/metrics`` request histograms when no per-flush log exists.
+
+``tools/buckettune.py`` is the CLI wrapping these against a telemetry
+JSONL or a live ``/metrics`` scrape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Sequence, Tuple
+
+from hydragnn_tpu.graph.batch import PadSpec
+
+# hard stop for demand solving: a flush needing more than this many
+# graph slots is a configuration error, not a tuning input
+MAX_CAPACITY = 65536
+
+
+def _fits(spec: PadSpec, ng: int, nn: int, ne: int) -> bool:
+    """The engine's bucket-fit rule (serve/engine.py:select_bucket):
+    collate reserves one node slot and the trailing padding graph."""
+    return (spec.num_graphs - 1 >= ng and spec.num_nodes - 1 >= nn
+            and spec.num_edges >= ne)
+
+
+def bucket_cost(capacity: int, max_nodes_per_graph: int,
+                max_edges_per_graph: int, round_to: int = 8) -> float:
+    """Padded-slot cost of one flush in a bucket of ``capacity`` graphs:
+    node slots + edge slots of its PadSpec — the quantity message-passing
+    FLOPs (and step time, once memory-bound) scale with."""
+    spec = PadSpec.for_batch(int(capacity), int(max_nodes_per_graph),
+                             int(max_edges_per_graph), round_to)
+    return float(spec.num_nodes + spec.num_edges)
+
+
+def required_capacity(ng: int, nn: int, ne: int, max_nodes_per_graph: int,
+                      max_edges_per_graph: int, round_to: int = 8) -> int:
+    """Smallest batch capacity whose PadSpec fits ``ng`` graphs /
+    ``nn`` nodes / ``ne`` edges — the flush's ladder-independent
+    demand."""
+    mn = int(max_nodes_per_graph)
+    me = int(max_edges_per_graph)
+    if mn < 1 or me < 1:
+        raise ValueError(
+            "required_capacity needs the per-graph worst case "
+            f"(max_nodes_per_graph={mn}, max_edges_per_graph={me})")
+    # lower bound from each constraint.  PadSpec rounds num_nodes/edges
+    # UP by as much as round_to-1 slots, which spans SEVERAL capacity
+    # steps when mn/me < round_to (2-3-atom graphs) — so the bound must
+    # concede the whole rounding allowance, not one step: the padded
+    # capacity of c is at most c*mn + round_to, hence the minimal c is
+    # at least (nn - round_to) / mn.  Floor division keeps the start
+    # at-or-under the true minimum; the walk-up finds it exactly.
+    c = max(1, int(ng),
+            max(0, int(nn) - round_to) // mn,
+            max(0, int(ne) - round_to) // me)
+    while c <= MAX_CAPACITY:
+        if _fits(PadSpec.for_batch(c, mn, me, round_to), ng, nn, ne):
+            return c
+        c += 1
+    raise ValueError(f"flush of {ng} graphs / {nn} nodes / {ne} edges "
+                     f"needs a capacity beyond {MAX_CAPACITY}")
+
+
+def expected_cost(demands: Dict[int, int], ladder: Sequence[int],
+                  max_nodes_per_graph: int, max_edges_per_graph: int,
+                  round_to: int = 8) -> Tuple[float, int]:
+    """(total padded slots, overflowed flushes) of serving a demand
+    histogram with ``ladder`` — each demand pays the cost of the
+    smallest ladder point >= it; demands above the top overflow."""
+    lad = sorted(set(int(c) for c in ladder))
+    costs = {c: bucket_cost(c, max_nodes_per_graph, max_edges_per_graph,
+                            round_to) for c in lad}
+    total, overflow = 0.0, 0
+    for d, w in demands.items():
+        c = next((c for c in lad if c >= int(d)), None)
+        if c is None:
+            overflow += int(w)
+            continue
+        total += int(w) * costs[c]
+    return total, overflow
+
+
+def tune_ladder(demands: Dict[int, int], max_ladder: int,
+                max_nodes_per_graph: int, max_edges_per_graph: int,
+                force_top: int = 0, round_to: int = 8) -> Dict[str, Any]:
+    """Exact minimum-expected-padded-slots ladder of size <= max_ladder.
+
+    ``demands`` maps required capacity -> flush count (the batcher's
+    ``flush_demands`` histogram, or :func:`demands_from_flushes`).
+    ``force_top`` (the CURRENT top capacity) is always covered so the
+    tuned ladder never shrinks serviceability: a request the old ladder
+    admitted must not start bouncing with 413s.
+
+    Returns ``{"ladder", "cost", "buckets_used", "per_demand"}``.
+    """
+    if max_ladder < 1:
+        raise ValueError(f"max_ladder must be >= 1, got {max_ladder}")
+    if not demands:
+        raise ValueError("empty demand histogram — nothing to tune from")
+    ds = sorted(int(d) for d in demands if int(demands[d]) > 0)
+    if not ds:
+        raise ValueError("demand histogram has no positive counts")
+    w = {int(d): int(demands[d]) for d in ds}
+    if force_top and int(force_top) > ds[-1]:
+        # zero-weight sentinel demand: the DP must still place (or
+        # cover with) a point >= it
+        ds.append(int(force_top))
+        w[int(force_top)] = 0
+    m = len(ds)
+    k_max = min(int(max_ladder), m)
+    costs = [bucket_cost(d, max_nodes_per_graph, max_edges_per_graph,
+                         round_to) for d in ds]
+    # prefix weights: W[j] = sum of counts of ds[0..j-1]
+    pref = [0] * (m + 1)
+    for j, d in enumerate(ds):
+        pref[j + 1] = pref[j] + w[d]
+    inf = float("inf")
+    # f[j][k]: min cost covering ds[0..j] with k ladder points, the
+    # largest of which is ds[j]; every demand in (ds[i], ds[j]] pays
+    # cost(ds[j])
+    f = [[inf] * (k_max + 1) for _ in range(m)]
+    parent = [[-1] * (k_max + 1) for _ in range(m)]
+    for j in range(m):
+        f[j][1] = pref[j + 1] * costs[j]
+        for k in range(2, k_max + 1):
+            for i in range(j):
+                cand = f[i][k - 1] + (pref[j + 1] - pref[i + 1]) * costs[j]
+                if cand < f[j][k]:
+                    f[j][k] = cand
+                    parent[j][k] = i
+    best_k = min(range(1, k_max + 1), key=lambda k: f[m - 1][k])
+    ladder: List[int] = []
+    j, k = m - 1, best_k
+    while j >= 0 and k >= 1:
+        ladder.append(ds[j])
+        j, k = parent[j][k], k - 1
+    ladder.reverse()
+    cost, overflow = expected_cost(
+        {d: w[d] for d in ds}, ladder, max_nodes_per_graph,
+        max_edges_per_graph, round_to)
+    assert overflow == 0, "tuned ladder must cover every demand"
+    per_demand = {}
+    lad = sorted(ladder)
+    for d in ds:
+        if w[d]:
+            per_demand[int(d)] = next(c for c in lad if c >= d)
+    return {"ladder": tuple(ladder), "cost": cost,
+            "buckets_used": len(ladder), "per_demand": per_demand}
+
+
+def demands_from_flushes(flushes: Iterable[Tuple[int, int, int]],
+                         max_nodes_per_graph: int,
+                         max_edges_per_graph: int,
+                         round_to: int = 8) -> Dict[int, int]:
+    """Histogram of :func:`required_capacity` over recorded flushes
+    ``(real_graphs, real_nodes, real_edges)``."""
+    out: Dict[int, int] = {}
+    for ng, nn, ne in flushes:
+        c = required_capacity(ng, nn, ne, max_nodes_per_graph,
+                              max_edges_per_graph, round_to)
+        out[c] = out.get(c, 0) + 1
+    return out
+
+
+def replay_flushes(flushes: Iterable[Tuple[int, int, int]],
+                   ladder: Sequence[int], max_nodes_per_graph: int,
+                   max_edges_per_graph: int,
+                   round_to: int = 8) -> Dict[str, Any]:
+    """Replay recorded flushes through a ladder with the engine's own
+    smallest-fitting-bucket selection; returns padded/real slot totals,
+    waste percentages, per-bucket flush counts, and overflows (flushes
+    no bucket fits — must be 0 for a deployable ladder)."""
+    specs = [PadSpec.for_batch(int(c), int(max_nodes_per_graph),
+                               int(max_edges_per_graph), round_to)
+             for c in sorted(set(int(c) for c in ladder))]
+    caps = sorted(set(int(c) for c in ladder))
+    padded_n = padded_e = real_n = real_e = 0
+    per_bucket: Dict[int, int] = {}
+    overflow = 0
+    for ng, nn, ne in flushes:
+        chosen = None
+        for cap, spec in zip(caps, specs):
+            if _fits(spec, ng, nn, ne):
+                chosen = (cap, spec)
+                break
+        if chosen is None:
+            overflow += 1
+            continue
+        cap, spec = chosen
+        per_bucket[cap] = per_bucket.get(cap, 0) + 1
+        padded_n += spec.num_nodes
+        padded_e += spec.num_edges
+        real_n += int(nn)
+        real_e += int(ne)
+    def _waste(real, padded):
+        return (1.0 - real / padded) * 100.0 if padded else 0.0
+    return {
+        "flushes": sum(per_bucket.values()),
+        "overflow": overflow,
+        "padded_nodes": padded_n,
+        "padded_edges": padded_e,
+        "real_nodes": real_n,
+        "real_edges": real_e,
+        "padded_slots": padded_n + padded_e,
+        "nodes_waste_pct": _waste(real_n, padded_n),
+        "edges_waste_pct": _waste(real_e, padded_e),
+        "slots_waste_pct": _waste(real_n + real_e, padded_n + padded_e),
+        "per_bucket": per_bucket,
+    }
+
+
+def simulate_bursts(request_sizes: Sequence[Tuple[int, int]],
+                    burst_sizes: Sequence[int], top_capacity: int,
+                    max_nodes_per_graph: int, max_edges_per_graph: int,
+                    round_to: int = 8) -> List[Tuple[int, int, int]]:
+    """Turn a request-size stream into flushes under the batcher's
+    accumulation rule: each burst (requests arriving inside one
+    ``max_wait_ms`` window) flushes together, split early whenever the
+    TOP bucket would overflow — the ``full``-flush bound of
+    serve/batcher.py.  Returns ``(ng, nn, ne)`` flushes for
+    :func:`replay_flushes`/:func:`demands_from_flushes`.
+
+    ``request_sizes`` is ``[(num_nodes, num_edges), ...]`` (e.g. drawn
+    from the /metrics per-request histograms); ``burst_sizes`` is the
+    arrival model — how many requests land in each batching window.
+    """
+    top = PadSpec.for_batch(int(top_capacity), int(max_nodes_per_graph),
+                            int(max_edges_per_graph), round_to)
+    flushes: List[Tuple[int, int, int]] = []
+    it = iter(request_sizes)
+    exhausted = False
+    for burst in burst_sizes:
+        if exhausted:
+            break
+        ng = nn = ne = 0
+        for _ in range(int(burst)):
+            try:
+                rn, re_ = next(it)
+            except StopIteration:
+                exhausted = True
+                break
+            if ng and not _fits(top, ng + 1, nn + int(rn), ne + int(re_)):
+                flushes.append((ng, nn, ne))  # full flush: top overflow
+                ng = nn = ne = 0
+            ng += 1
+            nn += int(rn)
+            ne += int(re_)
+        if ng:
+            flushes.append((ng, nn, ne))      # deadline flush: burst end
+    return flushes

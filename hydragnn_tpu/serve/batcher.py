@@ -38,7 +38,13 @@ fast while open.
 Telemetry: request_enqueued / batch_flushed / deadline_flush /
 request_shed / deadline_expired / predict_timeout health events through
 the shared MetricsLogger (docs/TELEMETRY.md "Serving events"); fill %
-and padding % ride the batch_flushed records.
+and padding % ride the batch_flushed records AND a full per-flush STEP
+record in the trainer's JSONL schema (``source: "serve"`` — one format
+for train and serve padding waste).  The batcher also tallies
+request-size and per-flush demand histograms plus per-bucket
+fill/waste aggregates into ``stats()`` (-> GET /metrics), the live
+inputs of the bucket autotuner (serve/autotune.py,
+tools/buckettune.py).
 """
 
 from __future__ import annotations
@@ -162,8 +168,18 @@ class MicroBatcher:
                    "breaker_fastfails": 0}
         self._fill_sum = 0.0
         self._pad_nodes_sum = 0.0
+        self._pad_edges_sum = 0.0
         self._predict_ms_sum = 0.0
         self._predict_ms_max = 0.0
+        # autotuner inputs (serve/autotune.py, GET /metrics): per-request
+        # node/edge size histograms of ACCEPTED requests, the per-flush
+        # required-capacity (demand) histogram, and per-bucket flush
+        # aggregates.  Sizes are bounded by the top bucket, so the
+        # distinct-key counts stay small.
+        self._req_nodes_hist: Dict[int, int] = {}
+        self._req_edges_hist: Dict[int, int] = {}
+        self._flush_demands: Dict[int, int] = {}
+        self._bucket_stats: Dict[str, Dict[str, float]] = {}
         # EWMA of served requests/second over flush cycles — the drain
         # rate behind admission-shed decisions and Retry-After hints —
         # and of per-flush predict seconds (a request's deadline covers
@@ -264,6 +280,10 @@ class MicroBatcher:
             return req.future
         with self._lock:
             self._n["requests"] += 1
+            n = int(sample.num_nodes)
+            e = int(sample.num_edges)
+            self._req_nodes_hist[n] = self._req_nodes_hist.get(n, 0) + 1
+            self._req_edges_hist[e] = self._req_edges_hist.get(e, 0) + 1
         self.telemetry.health("request_enqueued", depth=self._q.qsize())
         return req.future
 
@@ -479,20 +499,68 @@ class MicroBatcher:
                 r.future.set_result(res)
         fill_pct = 100.0 * len(group) / max(spec.num_graphs - 1, 1)
         real_nodes = sum(s.num_nodes for s in samples)
+        real_edges = sum(s.num_edges for s in samples)
         pad_nodes_pct = 100.0 * (1.0 - real_nodes / max(spec.num_nodes, 1))
+        pad_edges_pct = 100.0 * (1.0 - real_edges / max(spec.num_edges, 1))
         wait_ms = (t0 - group[0].t_enq) * 1e3
+        # ladder-independent demand of this flush (the autotuner's unit
+        # of accounting) — computable only when the per-graph worst case
+        # is configured (direct-built engines may not carry it)
+        serving = getattr(self.engine, "serving", None)
+        mn = int(getattr(serving, "max_nodes_per_graph", 0) or 0)
+        me = int(getattr(serving, "max_edges_per_graph", 0) or 0)
+        demand = 0
+        if mn > 0 and me > 0:
+            from hydragnn_tpu.serve.autotune import required_capacity
+
+            demand = required_capacity(len(group), real_nodes, real_edges,
+                                       mn, me)
+        bucket_key = f"{spec.num_graphs - 1}g/{spec.num_nodes}n/" \
+                     f"{spec.num_edges}e"
         with self._lock:
             self._n["batches"] += 1
             self._n[f"{reason}_flushes"] += 1
             self._fill_sum += fill_pct
             self._pad_nodes_sum += pad_nodes_pct
+            self._pad_edges_sum += pad_edges_pct
             self._predict_ms_sum += predict_ms
             self._predict_ms_max = max(self._predict_ms_max, predict_ms)
+            if demand:
+                self._flush_demands[demand] = \
+                    self._flush_demands.get(demand, 0) + 1
+            b = self._bucket_stats.setdefault(bucket_key, {
+                "flushes": 0, "graphs": 0, "fill_pct_sum": 0.0,
+                "pad_nodes_pct_sum": 0.0, "pad_edges_pct_sum": 0.0,
+                "request_nodes_hist": {}, "request_edges_hist": {}})
+            b["flushes"] += 1
+            b["graphs"] += len(group)
+            b["fill_pct_sum"] += fill_pct
+            b["pad_nodes_pct_sum"] += pad_nodes_pct
+            b["pad_edges_pct_sum"] += pad_edges_pct
+            # per-bucket request-size distribution: which sizes landed
+            # in this bucket (attributed at flush — bucket membership
+            # is a flush-time decision)
+            for s in samples:
+                hn, he = b["request_nodes_hist"], b["request_edges_hist"]
+                hn[s.num_nodes] = hn.get(s.num_nodes, 0) + 1
+                he[s.num_edges] = he.get(s.num_edges, 0) + 1
         self.telemetry.health(
             "batch_flushed", n=len(group), reason=reason,
             fill_pct=round(fill_pct, 2),
             pad_nodes_pct=round(pad_nodes_pct, 2),
             wait_ms=round(wait_ms, 3), predict_ms=round(predict_ms, 3))
+        # the unified step-record twin of batch_flushed: same padding
+        # schema as trainer steps, the format teleview's per-bucket
+        # table and the bucket autotuner consume (docs/TELEMETRY.md)
+        self.telemetry.serve_step(
+            bucket={"graphs": spec.num_graphs - 1,
+                    "nodes": spec.num_nodes, "edges": spec.num_edges},
+            num_graphs=len(group), nodes_real=real_nodes,
+            edges_real=real_edges, predict_ms=predict_ms,
+            wait_ms=wait_ms, reason=reason, fill_pct=fill_pct,
+            demand=demand, max_nodes_per_graph=mn,
+            max_edges_per_graph=me,
+            ladder=[p.num_graphs - 1 for p in self.engine.pad_specs])
         if reason == "deadline":
             self.telemetry.health("deadline_flush", n=len(group),
                                   wait_ms=round(wait_ms, 3))
@@ -576,6 +644,21 @@ class MicroBatcher:
         with self._lock:
             nb = self._n["batches"]
             ok = max(nb - self._n["errors"], 0)
+            per_bucket = {
+                key: {
+                    "flushes": int(b["flushes"]),
+                    "graphs": int(b["graphs"]),
+                    "avg_fill_pct": round(
+                        b["fill_pct_sum"] / b["flushes"], 2),
+                    "avg_pad_nodes_pct": round(
+                        b["pad_nodes_pct_sum"] / b["flushes"], 2),
+                    "avg_pad_edges_pct": round(
+                        b["pad_edges_pct_sum"] / b["flushes"], 2),
+                    "request_nodes_hist": dict(b["request_nodes_hist"]),
+                    "request_edges_hist": dict(b["request_edges_hist"]),
+                }
+                for key, b in self._bucket_stats.items()
+            }
             return {
                 **self._n,
                 "queue_depth": self._q.qsize(),
@@ -585,6 +668,15 @@ class MicroBatcher:
                 "avg_fill_pct": (self._fill_sum / ok) if ok else 0.0,
                 "avg_pad_nodes_pct": (self._pad_nodes_sum / ok) if ok
                                      else 0.0,
+                "avg_pad_edges_pct": (self._pad_edges_sum / ok) if ok
+                                     else 0.0,
                 "avg_predict_ms": (self._predict_ms_sum / ok) if ok else 0.0,
                 "max_predict_ms": self._predict_ms_max,
+                # autotuner feed (tools/buckettune.py --url): accepted
+                # request-size distribution + per-flush demand histogram
+                # + per-bucket fill/padding aggregates
+                "request_nodes_hist": dict(self._req_nodes_hist),
+                "request_edges_hist": dict(self._req_edges_hist),
+                "flush_demands": dict(self._flush_demands),
+                "per_bucket": per_bucket,
             }

@@ -40,7 +40,9 @@ class ServingConfig:
     and the overload/robustness knobs HYDRAGNN_SERVE_DEADLINE_MS,
     HYDRAGNN_SERVE_PREDICT_TIMEOUT_S, HYDRAGNN_SERVE_BREAKER_THRESHOLD,
     HYDRAGNN_SERVE_BREAKER_COOLDOWN_S, HYDRAGNN_SERVE_RELOAD_WATCH,
-    HYDRAGNN_SERVE_RELOAD_WATCH_S (docs/SERVING.md "Overload behavior").
+    HYDRAGNN_SERVE_RELOAD_WATCH_S (docs/SERVING.md "Overload behavior"),
+    and the quantization knobs HYDRAGNN_SERVE_QUANT_POLICY /
+    HYDRAGNN_SERVE_QUANT_TOL (docs/SERVING.md "Quantized inference").
     """
 
     # batch-capacity ladder (graphs per bucket), ascending; each entry
@@ -98,6 +100,17 @@ class ServingConfig:
     # allowlisted checkpoint directory is set AND the path resolves
     # inside it ("" = loopback clients only)
     reload_root: str = ""
+    # inference dtype policy (hydragnn_tpu/quant): "f32" (bit-parity
+    # baseline), "bf16" (params+compute, 0.5x resident bytes), "int8"
+    # (weight-only, per-channel scales dequantized into bf16 matmuls,
+    # ~0.26x).  Non-f32 policies only ACTIVATE when the engine's
+    # golden-batch replay stays under quant_tolerance; otherwise the
+    # server falls back to f32 and emits a quant_reject health event.
+    quant_policy: str = "f32"
+    # max abs golden-batch output drift vs the f32 reference a policy
+    # may introduce and still be accepted (absolute, on the raw model
+    # outputs); 0 = strictest (any drift rejects)
+    quant_tolerance: float = 0.05
 
     def __post_init__(self):
         self.buckets = _parse_buckets(self.buckets)
@@ -130,6 +143,13 @@ class ServingConfig:
             raise ValueError(
                 f"Serving.breaker_threshold must be >= 0 (0 disables), "
                 f"got {self.breaker_threshold}")
+        from hydragnn_tpu.quant import check_policy
+
+        check_policy(self.quant_policy)
+        if float(self.quant_tolerance) < 0:
+            raise ValueError(
+                f"Serving.quant_tolerance must be >= 0, "
+                f"got {self.quant_tolerance}")
 
     @classmethod
     def from_section(cls,
@@ -167,6 +187,9 @@ class ServingConfig:
             reload_watch_s=float(s.get("reload_watch_s",
                                        d.reload_watch_s)),
             reload_root=str(s.get("reload_root", d.reload_root)),
+            quant_policy=str(s.get("quant_policy", d.quant_policy)),
+            quant_tolerance=float(s.get("quant_tolerance",
+                                        d.quant_tolerance)),
         )
         if "HYDRAGNN_SERVE_BUCKETS" in os.environ:
             cfg.buckets = _parse_buckets(os.environ["HYDRAGNN_SERVE_BUCKETS"])
@@ -206,6 +229,11 @@ class ServingConfig:
                 os.environ["HYDRAGNN_SERVE_RELOAD_WATCH_S"])
         if "HYDRAGNN_SERVE_RELOAD_ROOT" in os.environ:
             cfg.reload_root = os.environ["HYDRAGNN_SERVE_RELOAD_ROOT"]
+        if "HYDRAGNN_SERVE_QUANT_POLICY" in os.environ:
+            cfg.quant_policy = os.environ["HYDRAGNN_SERVE_QUANT_POLICY"]
+        if "HYDRAGNN_SERVE_QUANT_TOL" in os.environ:
+            cfg.quant_tolerance = float(
+                os.environ["HYDRAGNN_SERVE_QUANT_TOL"])
         # re-validate after the env overlay (the dataclass validated the
         # config values; env strings can be just as wrong)
         cfg.__post_init__()
@@ -236,4 +264,6 @@ def serving_defaults() -> Dict[str, Any]:
         "reload_watch_path": d.reload_watch_path,
         "reload_watch_s": d.reload_watch_s,
         "reload_root": d.reload_root,
+        "quant_policy": d.quant_policy,
+        "quant_tolerance": d.quant_tolerance,
     }

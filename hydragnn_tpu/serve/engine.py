@@ -28,6 +28,17 @@ buffers are donated to the executable (they are fresh per request and
 dead after the call); CPU has no donation support, so the flag is
 dropped there to keep smoke runs warning-free.
 
+**Quantized states.**  ``Serving.quant_policy`` (f32 / bf16 / int8
+weight-only, hydragnn_tpu/quant) is applied at :meth:`warmup` behind a
+golden-batch gate: the f32 reference outputs are captured first, the
+quantized state replays the same batch, and the policy only activates
+when its max output drift stays under ``Serving.quant_tolerance`` —
+otherwise the engine keeps the f32 weights (fallback; /healthz and
+/metrics report the active policy either way).  The policy rides the
+executable-cache key, so every bucket compiles once per policy and
+steady state stays recompile-free; reload candidates are re-quantized
+with the active policy before validation so their avals always match.
+
 **Hot reload.**  :meth:`InferenceEngine.reload_state` swaps a new
 checkpoint in WITHOUT a restart and without re-paying AOT warmup: the
 cached executables are specialized on the state's avals (shapes/dtypes),
@@ -67,6 +78,12 @@ from hydragnn_tpu.graph.batch import (
 )
 from hydragnn_tpu.models.base import ModelConfig
 from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.quant import (
+    apply_policy,
+    check_policy,
+    tree_nbytes,
+    wrap_eval_step,
+)
 from hydragnn_tpu.serve.config import ServingConfig
 from hydragnn_tpu.train.trainer import make_eval_step
 
@@ -80,7 +97,8 @@ class ReloadValidationError(RuntimeError):
     non-finite golden-batch outputs); the live state was NOT swapped."""
 
 
-def load_inference_state(config, logs_dir: str = "./logs/"):
+def load_inference_state(config, logs_dir: str = "./logs/",
+                         policy: str = "f32"):
     """Load a run's checkpoint into an inference-only state.
 
     Reads the single-file checkpoint ``run_training`` saves
@@ -94,6 +112,14 @@ def load_inference_state(config, logs_dir: str = "./logs/"):
     only raw fields) or a path to one.  Returns an :class:`InferenceState`
     whose ``params``/``batch_stats`` attributes satisfy every eval-side
     consumer of a TrainState (``make_eval_step``, ``test``).
+
+    ``policy`` applies a low-precision dtype policy (hydragnn_tpu/quant:
+    ``f32``/``bf16``/``int8``) to the loaded state.  NOTE the serving
+    stack deliberately loads ``f32`` here and lets the ENGINE apply
+    ``Serving.quant_policy`` during warmup — the golden-batch gate needs
+    the f32 reference to measure drift against, and a rejected policy
+    must fall back to the f32 weights.  Pass a policy here only for
+    standalone consumers (tools, notebooks) that accept it ungated.
     """
     import jax.numpy as jnp
 
@@ -104,11 +130,12 @@ def load_inference_state(config, logs_dir: str = "./logs/"):
     fname = os.path.join(logs_dir, log_name, f"{log_name}.pk")
     with open(fname, "rb") as f:
         payload = pickle.load(f)
-    return InferenceState(
+    state = InferenceState(
         step=jnp.asarray(payload["step"]),
         params=payload["params"],
         batch_stats=payload["batch_stats"],
     )
+    return apply_policy(state, check_policy(policy))
 
 
 # flax.struct so the state is a pytree (jit-traceable like TrainState)
@@ -174,9 +201,24 @@ class InferenceEngine:
         # donate the per-request batch buffers (fresh every call, dead
         # after it); CPU has no donation — drop the flag so smoke tests
         # don't spray "donated buffers were not usable" warnings
-        donate = () if jax.default_backend() == "cpu" else (1,)
-        self._eval = jax.jit(make_eval_step(self.model, cfg),
-                             donate_argnums=donate)
+        self._donate = () if jax.default_backend() == "cpu" else (1,)
+        # one jitted eval per dtype policy, built lazily: the f32 entry
+        # is EXACTLY the pre-quantization program (the run_prediction
+        # bit-parity contract), non-f32 entries wrap it with the
+        # quant-policy casts (hydragnn_tpu/quant.wrap_eval_step)
+        self._evals: Dict[str, Any] = {}
+        # dtype policy state: requested comes from Serving.quant_policy,
+        # active flips only after the golden-batch gate in warmup()
+        self._policy_requested = check_policy(self.serving.quant_policy)
+        self._policy = "f32"
+        self._quant: Dict[str, Any] = {
+            "requested": self._policy_requested,
+            "active": "f32",
+            "tolerance": float(self.serving.quant_tolerance),
+            "golden_max_delta": None,
+            "fallback": False,
+        }
+        self._golden_f32: Optional[List[np.ndarray]] = None
         self._compiled: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
         self._hits = 0
@@ -323,11 +365,34 @@ class InferenceEngine:
             batch = batch.replace(extras=extras)
         return batch
 
+    def _eval_fn(self, policy: Optional[str] = None):
+        """Jitted eval step for a dtype policy (default: the active
+        one).  The f32 program is byte-identical to the pre-quant
+        engine's — bit-parity with run_prediction is a per-policy
+        property of f32, not of the engine."""
+        import jax
+
+        policy = self._policy if policy is None else policy
+        fn = self._evals.get(policy)
+        if fn is None:
+            base = make_eval_step(self.model, self.cfg)
+            if policy != "f32":
+                base = wrap_eval_step(base, policy)
+            fn = jax.jit(base, donate_argnums=self._donate)
+            self._evals[policy] = fn
+        return fn
+
     def _executable(self, spec: PadSpec, batch: Optional[GraphBatch] = None,
-                    warmup: bool = False):
-        """Compiled eval executable for one bucket; compiles AOT on first
-        sighting (counted as warmup or cache_miss), cache hit thereafter."""
-        key = (spec.num_nodes, spec.num_edges, spec.num_graphs)
+                    warmup: bool = False, policy: Optional[str] = None,
+                    state=None):
+        """Compiled eval executable for one (policy, bucket); compiles
+        AOT on first sighting (counted as warmup or cache_miss), cache
+        hit thereafter.  The policy rides the cache key so a quant
+        fallback (or the warmup-time f32 reference probe) never
+        collides with the active policy's executables — and steady
+        state stays at zero recompiles under every policy."""
+        policy = self._policy if policy is None else policy
+        key = (policy, spec.num_nodes, spec.num_edges, spec.num_graphs)
         with self._lock:
             exe = self._compiled.get(key)
             if exe is not None:
@@ -341,7 +406,7 @@ class InferenceEngine:
         if not warmup:
             self.telemetry.health(
                 "cache_miss", nodes=spec.num_nodes, edges=spec.num_edges,
-                graphs=spec.num_graphs)
+                graphs=spec.num_graphs, policy=policy)
         # compile OUTSIDE the lock: a bucket compile takes seconds, and
         # cache_stats() (-> /healthz, /metrics) takes the same lock — a
         # liveness probe must not block behind XLA.  Concurrent callers
@@ -350,33 +415,91 @@ class InferenceEngine:
             batch = self._collate([self._zero_sample()], spec)
         # snapshot: a concurrent hot reload must not swap the state
         # between aval capture and compile
-        state = self.state
-        exe = self._eval.lower(state, batch).compile()
+        if state is None:
+            state = self.state
+        exe = self._eval_fn(policy).lower(state, batch).compile()
         with self._lock:
             return self._compiled.setdefault(key, exe)
 
     def warmup(self) -> int:
         """AOT-compile every configured bucket (server startup), then
         capture the golden batch + reference outputs that hot-reload
-        validation replays; returns the number of executables
-        compiled."""
+        validation replays; returns the number of executables compiled
+        for the active policy.
+
+        When ``Serving.quant_policy`` asks for a low-precision policy,
+        warmup is also the GATE: the f32 reference golden outputs are
+        captured first, the quantized state is staged and replayed, and
+        the policy only becomes active when its ``golden_max_delta``
+        against the f32 reference stays under
+        ``Serving.quant_tolerance`` — otherwise the engine keeps the
+        f32 weights (fallback, ``quant_reject`` health event)."""
+        # f32 reference replay (smallest bucket): the baseline every
+        # quant policy is gated against
+        self._golden_f32 = self._golden_outputs(self.state, policy="f32")
+        if self._policy_requested != "f32":
+            self._activate_policy(self._policy_requested)
         for spec in self.pad_specs:
             self._executable(spec, warmup=True)
         self._golden = self._golden_outputs(self.state)
-        return len(self._compiled)
+        with self._lock:
+            return sum(1 for k in self._compiled if k[0] == self._policy)
+
+    def _activate_policy(self, policy: str) -> bool:
+        """Stage the quantized state, replay the golden batch, and swap
+        the policy in only when drift vs the f32 reference is under
+        tolerance.  On rejection the f32 state keeps serving (the
+        fallback the HTTP layer reports via /healthz)."""
+        tol = float(self.serving.quant_tolerance)
+        staged = self._canon_state(apply_policy(self.state, policy))
+        try:
+            outs = self._golden_outputs(staged, policy=policy)
+            finite = all(np.isfinite(o).all() for o in outs)
+        except Exception as e:  # noqa: BLE001 — any failure rejects
+            self._quant["fallback"] = True
+            self.telemetry.health("quant_reject", policy=policy,
+                                  error=repr(e)[:200])
+            return False
+        delta = max(
+            (float(np.max(np.abs(o.astype(np.float64)
+                                 - g.astype(np.float64))))
+             if o.size else 0.0)
+            for o, g in zip(outs, self._golden_f32))
+        self._quant["golden_max_delta"] = delta
+        if not finite or delta > tol:
+            self._quant["fallback"] = True
+            self.telemetry.health(
+                "quant_reject", policy=policy,
+                golden_max_delta=round(delta, 9), tolerance=tol,
+                finite=finite)
+            return False
+        # accepted: the quantized state replaces the f32 one (freeing
+        # the full-precision replica — the HBM saving IS the point)
+        self.state = staged
+        self._policy = policy
+        self._quant["active"] = policy
+        self.telemetry.health(
+            "quant_policy", policy=policy,
+            golden_max_delta=round(delta, 9), tolerance=tol,
+            param_bytes=tree_nbytes((staged.params, staged.batch_stats)))
+        return True
 
     # -- hot reload ----------------------------------------------------------
 
-    def _golden_outputs(self, state) -> List[np.ndarray]:
+    def _golden_outputs(self, state,
+                        policy: Optional[str] = None) -> List[np.ndarray]:
         """Replay the golden batch (a freshly-collated dummy in the
         smallest bucket — re-collated per call because accelerator
         backends DONATE the batch buffers) through the already-compiled
-        executable with ``state``."""
+        executable with ``state``.  ``policy`` selects which policy's
+        executable runs it (default: active) — the quant gate replays
+        both the f32 reference and the quantized candidate."""
         spec = self.pad_specs[0]
         batch = self._collate([self._zero_sample()], spec)
-        exe = self._executable(spec, batch=batch, warmup=True)
+        exe = self._executable(spec, batch=batch, warmup=True,
+                               policy=policy, state=state)
         m = exe(state, batch)
-        return [np.asarray(o) for o in m["outputs"]]
+        return [np.asarray(o, dtype=np.float32) for o in m["outputs"]]
 
     def validate_state(self, state: "InferenceState") -> Dict[str, Any]:
         """Validate a DEVICE-STAGED hot-reload candidate against the
@@ -426,7 +549,12 @@ class InferenceEngine:
         Raises :class:`ReloadValidationError` (live state untouched) on
         a bad candidate."""
         with self._reload_lock:
-            staged = self._canon_state(state)
+            # a live quant policy re-applies to every candidate: the
+            # checkpoint arrives f32, the served tree is bf16/int8 —
+            # quantizing FIRST keeps structure/aval parity with the
+            # compiled executables (zero reload recompiles, quantized
+            # or not)
+            staged = self._canon_state(apply_policy(state, self._policy))
             try:
                 report = self.validate_state(staged)
             except ReloadValidationError as e:
@@ -492,6 +620,16 @@ class InferenceEngine:
             "can_rollback": self._prev_state is not None,
         }
 
+    def quant_stats(self) -> Dict[str, Any]:
+        """Active dtype-policy report: requested vs active policy,
+        golden drift vs the f32 reference, and the resident parameter
+        bytes of the SERVED state (the HBM-per-replica number)."""
+        return {
+            **self._quant,
+            "param_bytes": tree_nbytes(
+                (self.state.params, self.state.batch_stats)),
+        }
+
     def cache_stats(self) -> Dict[str, Any]:
         with self._lock:
             total = self._hits + self._misses
@@ -506,6 +644,7 @@ class InferenceEngine:
                      "edges": p.num_edges}
                     for p in self.pad_specs
                 ],
+                "quant": self.quant_stats(),
             }
 
     # -- prediction ----------------------------------------------------------

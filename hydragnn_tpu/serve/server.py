@@ -549,6 +549,7 @@ class InferenceServer:
         # traffic (threshold 0 = disabled)
         degraded = self.breaker.threshold > 0 \
             and breaker["state"] != "closed"
+        quant = self.engine.quant_stats()
         return {
             "status": "degraded" if degraded else "ok",
             "uptime_s": round(time.time() - self._t0, 3),
@@ -556,14 +557,30 @@ class InferenceServer:
             "queue_depth": self.batcher.stats()["queue_depth"],
             "breaker": breaker,
             "reload": self.engine.reload_stats(),
+            # active dtype policy (+ whether the requested one was
+            # rejected by the golden-batch gate and fell back to f32)
+            "quant_policy": quant["active"],
+            "quant_fallback": bool(quant["fallback"]),
         }
 
     def metrics(self) -> Dict[str, Any]:
+        cache = self.engine.cache_stats()  # carries quant_stats already
         return {
             "uptime_s": round(time.time() - self._t0, 3),
-            "engine": self.engine.cache_stats(),
+            "engine": cache,
             "batcher": self.batcher.stats(),
             "breaker": self.breaker.snapshot(),
             "reload": self.engine.reload_stats(),
+            # the serving shape parameters a bucket autotuner needs to
+            # interpret the batcher histograms (tools/buckettune.py
+            # --url scrapes this instead of log files)
+            "serving": {
+                "buckets": [int(b) for b in self.serving.buckets],
+                "max_nodes_per_graph": int(
+                    self.serving.max_nodes_per_graph),
+                "max_edges_per_graph": int(
+                    self.serving.max_edges_per_graph),
+                "quant_policy": cache["quant"]["active"],
+            },
             "health_events": self.engine.telemetry.health_counts,
         }

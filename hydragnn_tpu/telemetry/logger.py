@@ -214,6 +214,10 @@ class MetricsLogger:
         # (an unlocked read-modify-write would drop counts under load)
         self._health_counts: Dict[str, int] = {}
         self._health_lock = threading.Lock()
+        # per-flush serving step records (serve_step) get their own
+        # monotonic counter — they interleave with training steps in
+        # shared logs and must not perturb the trainer's step axis
+        self._serve_steps = 0
         if self.enabled and self.rank == 0:
             self.sinks = build_sinks(
                 self.cfg.sinks, self.out_dir, self.run_id,
@@ -312,6 +316,75 @@ class MetricsLogger:
     def health_counts(self) -> Dict[str, int]:
         with self._health_lock:
             return dict(self._health_counts)
+
+    # -- serving step records ------------------------------------------------
+
+    def serve_step(self, *, bucket: Dict[str, int], num_graphs: int,
+                   nodes_real: float, edges_real: float, predict_ms: float,
+                   wait_ms: float, reason: str, fill_pct: float,
+                   demand: int = 0, max_nodes_per_graph: int = 0,
+                   max_edges_per_graph: int = 0,
+                   ladder: Optional[Sequence[int]] = None) -> None:
+        """One per-flush serving step record in the SAME JSONL step
+        schema the trainer emits (``event: "step"`` with the ``padding``
+        sub-record of flush_steps) so tools/teleview.py and the bucket
+        autotuner (serve/autotune.py, tools/buckettune.py) read one
+        format for train and serve padding waste alike.  Serve records
+        carry ``source: "serve"`` plus the chosen ``bucket``
+        (graph/node/edge capacities) and the flush's ladder-independent
+        ``demand`` (autotune.required_capacity).
+
+        ``bucket`` is ``{"graphs": real capacity, "nodes": padded node
+        slots, "edges": padded edge slots}`` — the cache_stats bucket
+        rendering.  Rides the health lock: the JSONL sink's stream is
+        shared with concurrent handler threads' health events."""
+        if not self.enabled:
+            return
+        predict_s = max(float(predict_ms), 1e-6) / 1e3
+        padded_nodes = int(bucket["nodes"])
+        padded_edges = int(bucket["edges"])
+        padded_graphs = int(bucket["graphs"]) + 1  # + the padding graph
+        rec: Dict[str, Any] = {
+            "event": "step",
+            "source": "serve",
+            "run_id": self.run_id,
+            "rank": self.rank,
+            "t": time.time(),
+            "step": 0,  # filled under the lock below
+            "num_graphs": float(num_graphs),
+            "step_time_s": predict_s,
+            "graphs_per_s": float(num_graphs) / predict_s,
+            "predict_ms": round(float(predict_ms), 3),
+            "wait_ms": round(float(wait_ms), 3),
+            "reason": reason,
+            "fill_pct": round(float(fill_pct), 2),
+            "bucket": dict(bucket),
+            "demand": int(demand),
+            "max_nodes_per_graph": int(max_nodes_per_graph),
+            "max_edges_per_graph": int(max_edges_per_graph),
+            # the FULL configured ladder, not just the bucket used:
+            # offline tuning (tools/buckettune.py) must see capacities
+            # traffic never landed in, or it would shrink the top and
+            # start 413-ing requests the live ladder admits
+            "ladder": [int(c) for c in (ladder or [])],
+            "padding": {
+                "nodes_real": float(nodes_real),
+                "edges_real": float(edges_real),
+                "padded_nodes": padded_nodes,
+                "padded_edges": padded_edges,
+                "padded_graphs": padded_graphs,
+                "nodes_waste_pct": waste_pct(nodes_real, padded_nodes),
+                "edges_waste_pct": waste_pct(edges_real, padded_edges),
+                "graphs_waste_pct": waste_pct(num_graphs, padded_graphs),
+            },
+        }
+        with self._health_lock:
+            self._serve_steps += 1
+            rec["step"] = self._serve_steps
+            self.ring.push({k: v for k, v in rec.items()
+                            if isinstance(v, (int, float))
+                            and not isinstance(v, bool)})
+            self._emit(rec)
 
     def resume_counts(self, global_step: int) -> None:
         """Continue the step/dispatch numbering of a preempted run so the

@@ -1,0 +1,229 @@
+"""Bucket-ladder auto-tuning (serve/autotune.py, tools/buckettune.py)
+and the unified serving padding telemetry it consumes: DP optimality vs
+brute force, ladder-size constraint, degenerate distributions, the
+tuned-beats-default acceptance check on the selftest request
+distribution, batcher request/demand histograms, serve step records in
+the trainer step schema, and teleview's per-bucket waste table."""
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.serve.autotune import (
+    bucket_cost,
+    demands_from_flushes,
+    expected_cost,
+    replay_flushes,
+    required_capacity,
+    simulate_bursts,
+    tune_ladder,
+)
+
+_MN, _ME = 16, 64  # per-graph worst case used throughout
+
+
+def test_required_capacity_matches_fit_rule():
+    # 1 graph of 5 nodes / 8 edges -> capacity 1
+    assert required_capacity(1, 5, 8, _MN, _ME) == 1
+    # graph count binds
+    assert required_capacity(3, 10, 10, _MN, _ME) == 3
+    # node count binds: cap 1 holds round8(16+1)-1 = 23 real nodes
+    assert required_capacity(1, 23, 8, _MN, _ME) == 1
+    assert required_capacity(1, 24, 8, _MN, _ME) == 2
+    # edge count binds: cap 1 holds round8(64+1) = 72 edges
+    assert required_capacity(1, 5, 72, _MN, _ME) == 1
+    assert required_capacity(1, 5, 73, _MN, _ME) == 2
+    # per-graph worst case BELOW round_to: PadSpec's round-up spans
+    # several capacity steps — the answer must still be minimal
+    # (cap 8 at mn=2 pads to round8(17)=24 nodes, 23 real >= 20)
+    assert required_capacity(1, 20, 1, 2, 64) == 8
+    with pytest.raises(ValueError):
+        required_capacity(1, 5, 8, 0, _ME)
+
+
+def test_tune_ladder_optimal_vs_bruteforce():
+    demands = {1: 50, 2: 30, 5: 12, 10: 15, 16: 5}
+    tuned = tune_ladder(demands, max_ladder=3, max_nodes_per_graph=_MN,
+                        max_edges_per_graph=_ME)
+    # brute force over every ladder of <= 3 points drawn from the
+    # demand values (an optimal ladder only needs observed demands)
+    best = float("inf")
+    for k in (1, 2, 3):
+        for lad in itertools.combinations(sorted(demands), k):
+            if lad[-1] < max(demands):
+                continue  # must cover the max demand
+            cost, over = expected_cost(demands, lad, _MN, _ME)
+            if over == 0:
+                best = min(best, cost)
+    assert tuned["cost"] == best
+    assert tuned["ladder"][-1] == 16
+    # and it beats the default ladder on this distribution
+    default_cost, _ = expected_cost(demands, (1, 4, 16), _MN, _ME)
+    assert tuned["cost"] < default_cost
+
+
+def test_ladder_size_constraint():
+    demands = {c: 10 for c in (1, 2, 3, 5, 8, 13)}
+    for k in (1, 2, 4):
+        t = tune_ladder(demands, max_ladder=k, max_nodes_per_graph=_MN,
+                        max_edges_per_graph=_ME)
+        assert len(t["ladder"]) <= k
+        _, over = expected_cost(demands, t["ladder"], _MN, _ME)
+        assert over == 0
+    # monotone: more buckets never cost more
+    c1 = tune_ladder(demands, 1, _MN, _ME)["cost"]
+    c2 = tune_ladder(demands, 2, _MN, _ME)["cost"]
+    c4 = tune_ladder(demands, 4, _MN, _ME)["cost"]
+    assert c4 <= c2 <= c1
+
+
+def test_degenerate_single_size_distribution():
+    t = tune_ladder({4: 100}, max_ladder=4, max_nodes_per_graph=_MN,
+                    max_edges_per_graph=_ME)
+    assert t["ladder"] == (4,)
+    assert t["cost"] == 100 * bucket_cost(4, _MN, _ME)
+    # force_top keeps the current top serviceable even with no traffic
+    # at it (zero-weight point: present or covered, and free)
+    t = tune_ladder({4: 100}, max_ladder=4, max_nodes_per_graph=_MN,
+                    max_edges_per_graph=_ME, force_top=16)
+    assert t["ladder"][-1] == 16
+    assert 4 in t["ladder"]
+    assert t["cost"] == 100 * bucket_cost(4, _MN, _ME)
+
+
+def test_tuned_ladder_beats_default_on_selftest_distribution():
+    """The acceptance check: on the servebench selftest request
+    distribution (random 3..12-node graphs) under a bursty arrival
+    model, the tuned ladder reduces expected padding waste vs the
+    default (1, 4, 16) ladder, replayed through the engine's own
+    bucket selection."""
+    rng = np.random.RandomState(7)
+    sizes = [(int(rng.randint(3, 13)), int(rng.randint(4, 40)))
+             for _ in range(1500)]
+    bursts = [int(b) for b in rng.choice([1, 2, 2, 3, 6, 10], size=500)]
+    flushes = simulate_bursts(sizes, bursts, 16, _MN, _ME)
+    assert flushes and all(ng >= 1 for ng, _, _ in flushes)
+    demands = demands_from_flushes(flushes, _MN, _ME)
+    tuned = tune_ladder(demands, max_ladder=4, max_nodes_per_graph=_MN,
+                        max_edges_per_graph=_ME, force_top=16)
+    base = replay_flushes(flushes, (1, 4, 16), _MN, _ME)
+    new = replay_flushes(flushes, tuned["ladder"], _MN, _ME)
+    assert new["overflow"] == base["overflow"] == 0
+    assert new["padded_slots"] < base["padded_slots"]
+    assert new["nodes_waste_pct"] < base["nodes_waste_pct"]
+    assert new["slots_waste_pct"] < base["slots_waste_pct"]
+
+
+# ---------------------------------------------------------------------------
+# batcher histograms + unified serve step records + teleview table
+# ---------------------------------------------------------------------------
+
+
+def _sample(n=6, seed=0):
+    from hydragnn_tpu.graph.batch import GraphSample
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+
+    rng = np.random.RandomState(seed)
+    pos = rng.rand(n, 3).astype(np.float32) * 2.0
+    return GraphSample(x=rng.rand(n, 1).astype(np.float32), pos=pos,
+                       edge_index=radius_graph(pos, 1.2, 8))
+
+
+def test_batcher_emits_unified_padding_telemetry(tmp_path):
+    """Per-flush fill/padding ride the JSONL STEP-record schema (same
+    padding fields the trainer emits, source: "serve"), the batcher
+    tallies request-size + flush-demand histograms, and teleview's
+    per-bucket table renders them with the >50%-waste WARNING."""
+    import jax
+
+    from hydragnn_tpu.graph.batch import (
+        GraphSample, HeadSpec, PadSpec, collate)
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.serve import (
+        InferenceEngine, InferenceState, MicroBatcher, ServingConfig)
+    from hydragnn_tpu.telemetry import MetricsLogger, TelemetryConfig
+
+    heads = [HeadSpec("energy", "graph", 1)]
+    pads = [PadSpec.for_batch(4, _MN, _ME)]
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    example = collate([_sample()], pads[0], heads)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    tele = MetricsLogger(
+        TelemetryConfig(enable=True, sinks=("jsonl",)),
+        run_name="servetel", out_dir=str(tmp_path))
+    eng = InferenceEngine(
+        cfg, state, heads, pads, telemetry=tele,
+        serving=ServingConfig(max_nodes_per_graph=_MN,
+                              max_edges_per_graph=_ME))
+    eng.warmup()
+    b = MicroBatcher(eng, max_wait_ms=5.0, max_queue=32).start()
+    try:
+        futs = [b.submit(_sample(4 + i, seed=50 + i)) for i in range(5)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        b.close()
+    st = b.stats()
+    # accepted-request size histograms (the /metrics autotuner feed)
+    assert sum(st["request_nodes_hist"].values()) == 5
+    assert sum(st["request_edges_hist"].values()) == 5
+    assert all(4 <= int(k) <= 9 for k in st["request_nodes_hist"])
+    # per-flush demands resolved against the configured worst case
+    assert st["flush_demands"] and sum(st["flush_demands"].values()) \
+        == st["batches"]
+    assert st["per_bucket"]
+    key = next(iter(st["per_bucket"]))
+    assert st["per_bucket"][key]["flushes"] == st["batches"]
+    assert "avg_pad_edges_pct" in st["per_bucket"][key]
+    # per-bucket request-size distribution sums to the accepted count
+    assert sum(st["per_bucket"][key]["request_nodes_hist"].values()) == 5
+    tele.finalize()
+
+    records = [json.loads(line) for line in
+               open(tele.jsonl_path) if line.strip()]
+    serve_steps = [r for r in records
+                   if r.get("event") == "step"
+                   and r.get("source") == "serve"]
+    assert len(serve_steps) == st["batches"]
+    rec = serve_steps[0]
+    # the trainer's step-record padding schema, field for field
+    pad = rec["padding"]
+    for fld in ("nodes_real", "edges_real", "padded_nodes",
+                "padded_edges", "padded_graphs", "nodes_waste_pct",
+                "edges_waste_pct", "graphs_waste_pct"):
+        assert fld in pad, fld
+    assert pad["padded_nodes"] == pads[0].num_nodes
+    assert rec["bucket"]["graphs"] == 4
+    assert rec["demand"] >= 1
+    assert rec["max_nodes_per_graph"] == _MN
+    # the CONFIGURED ladder rides every record (buckettune's baseline
+    # must include buckets traffic never used)
+    assert rec["ladder"] == [4]
+    assert 0.0 <= pad["nodes_waste_pct"] <= 100.0
+
+    # teleview: per-bucket table + the >50% mean-waste WARNING (tiny
+    # graphs in a 4-graph bucket waste well over half the node slots)
+    from tools.teleview import serve_bucket_section
+
+    out = serve_bucket_section(serve_steps)
+    assert "bucket" in out and f"4g/{pads[0].num_nodes}n" in out
+    assert "WARNING" in out and "buckettune" in out
+
+    # and buckettune's JSONL path reconstructs the same demands
+    from tools.buckettune import flushes_from_records
+
+    flushes, mn, me, baseline = flushes_from_records(records)
+    assert mn == _MN and me == _ME and baseline == [4]
+    assert demands_from_flushes(flushes, mn, me) == {
+        int(k): v for k, v in st["flush_demands"].items()}

@@ -1,0 +1,266 @@
+"""Quantized inference (hydragnn_tpu/quant + serve/engine policy gate,
+docs/SERVING.md "Quantized inference"): int8 per-channel round-trip
+exactness, bf16/int8 engine parity against f32 within tolerance,
+resident-bytes ratios, tolerance-reject fallback (bit-identical f32),
+zero steady-state recompiles per policy, and hot reload + rollback with
+a quantized active policy."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.quant import (
+    QTensor,
+    apply_policy,
+    check_policy,
+    dequantize,
+    quantize_int8,
+    tree_nbytes,
+)
+from hydragnn_tpu.serve import (
+    InferenceEngine,
+    InferenceState,
+    ServingConfig,
+)
+
+_HEADS = [HeadSpec("energy", "graph", 1)]
+_PADS = [PadSpec.for_batch(2, 16, 64)]
+
+
+def _sample(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    pos = rng.rand(n, 3).astype(np.float32) * 2.0
+    return GraphSample(x=rng.rand(n, 1).astype(np.float32), pos=pos,
+                       edge_index=radius_graph(pos, 1.2, 8))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=32, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 32, 1, (32,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    example = collate([_sample()], _PADS[0], _HEADS)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    return cfg, state
+
+
+def _engine(cfg, state, policy, tol=0.05):
+    eng = InferenceEngine(
+        cfg, state, _HEADS, _PADS,
+        serving=ServingConfig(quant_policy=policy, quant_tolerance=tol,
+                              max_nodes_per_graph=16,
+                              max_edges_per_graph=64))
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines(setup):
+    """One warmed engine per policy (ONE bucket each, for budget)."""
+    cfg, state = setup
+    return {p: _engine(cfg, state, p) for p in ("f32", "bf16", "int8")}
+
+
+# ---------------------------------------------------------------------------
+# quant primitives (no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_int8_roundtrip_exact_on_synthetic_weights():
+    """Weights built as int8 grids times power-of-two per-channel scales
+    survive quantize -> dequantize EXACTLY (scale recovery is exact and
+    q * 2^-k fits bf16's mantissa)."""
+    rng = np.random.RandomState(0)
+    q0 = rng.randint(-127, 128, size=(24, 8)).astype(np.float32)
+    q0[0, :] = 127.0  # pin each channel's absmax so scale = 2^-k exactly
+    scales = 2.0 ** -rng.randint(1, 6, size=8).astype(np.float32)
+    w = q0 * scales[None, :]
+    qt = quantize_int8(w)
+    assert np.asarray(qt.q).dtype == np.int8
+    np.testing.assert_array_equal(np.asarray(qt.scale), scales)
+    deq32 = np.asarray(dequantize(qt, dtype=np.float32))
+    np.testing.assert_array_equal(deq32, w)
+    # and the bf16 operand the matmuls actually consume is exact too
+    deq16 = np.asarray(dequantize(qt)).astype(np.float32)
+    np.testing.assert_array_equal(deq16, w)
+
+
+def test_int8_per_channel_scales_are_independent():
+    w = np.zeros((16, 3), np.float32)
+    w[:, 0] = np.linspace(-1.27, 1.27, 16)
+    w[:, 1] = np.linspace(-254.0, 254.0, 16)
+    w[:, 2] = 0.0  # all-zero channel: scale 1, dequant exactly zero
+    qt = quantize_int8(w)
+    s = np.asarray(qt.scale)
+    assert s.shape == (3,)
+    assert s[1] == pytest.approx(s[0] * 200.0, rel=1e-6)
+    assert s[2] == 1.0
+    deq = np.asarray(dequantize(qt, dtype=np.float32))
+    np.testing.assert_array_equal(deq[:, 2], 0.0)
+    # per-channel quantization error bounded by scale/2 per element
+    assert np.max(np.abs(deq - w)) <= 0.5 * s.max()
+
+
+def test_apply_policy_bytes_ratios():
+    """bf16 == 0.5x f32; int8 on kernel-dominated trees <= 0.3x (the
+    HBM-per-replica acceptance number)."""
+    rng = np.random.RandomState(1)
+    params = {f"layer{i}": {"kernel": rng.randn(64, 64).astype(np.float32),
+                            "bias": rng.randn(64).astype(np.float32)}
+              for i in range(4)}
+    state = InferenceState(step=0, params=params, batch_stats={})
+    f32b = tree_nbytes(state.params)
+    bf16b = tree_nbytes(apply_policy(state, "bf16").params)
+    int8b = tree_nbytes(apply_policy(state, "int8").params)
+    assert bf16b == f32b // 2
+    assert int8b <= 0.3 * f32b
+    # kernels became QTensors, biases fell to bf16
+    import jax
+
+    qparams = apply_policy(state, "int8").params
+    assert isinstance(qparams["layer0"]["kernel"], QTensor)
+    assert str(qparams["layer0"]["bias"].dtype) == "bfloat16"
+    # 1-row matrices are NOT quantized (scale overhead >= saving)
+    tiny = InferenceState(
+        step=0, params={"k": np.ones((1, 64), np.float32)}, batch_stats={})
+    assert not isinstance(apply_policy(tiny, "int8").params["k"], QTensor)
+    with pytest.raises(ValueError):
+        check_policy("fp8")
+
+
+# ---------------------------------------------------------------------------
+# engine policy gate
+# ---------------------------------------------------------------------------
+
+
+def test_bf16_and_int8_parity_within_tolerance(engines):
+    samples = [_sample(5, seed=11), _sample(7, seed=12)]
+    ref = engines["f32"].predict_arrays(samples)
+    for policy in ("bf16", "int8"):
+        eng = engines[policy]
+        q = eng.quant_stats()
+        assert q["active"] == policy and not q["fallback"]
+        assert q["golden_max_delta"] is not None
+        assert q["golden_max_delta"] <= q["tolerance"]
+        out = eng.predict_arrays(samples)
+        for a, b in zip(out, ref):
+            np.testing.assert_allclose(a, b, atol=0.05)
+    # resident bytes: bf16 half, int8 under half of that plus scales
+    f32b = engines["f32"].quant_stats()["param_bytes"]
+    assert engines["bf16"].quant_stats()["param_bytes"] == f32b // 2
+    assert engines["int8"].quant_stats()["param_bytes"] < 0.35 * f32b
+
+
+def test_zero_steady_state_recompiles_per_policy(engines):
+    """The cache-counter contract EXTENDED per policy, not relaxed:
+    warmup = every bucket for the active policy + the one f32 golden
+    reference probe; steady state hits for every policy."""
+    for policy, eng in engines.items():
+        eng.predict_samples([_sample(5, seed=21)])
+        eng.predict_samples([_sample(6, seed=22)])
+        st = eng.cache_stats()
+        assert st["misses"] == 0, policy
+        assert st["hit_rate"] == 1.0, policy
+        expected_warmups = len(_PADS) + (0 if policy == "f32" else 1)
+        assert st["warmup_compiles"] == expected_warmups, policy
+
+
+def test_tolerance_reject_falls_back_to_f32(setup, engines):
+    """An unmeetable tolerance rejects the policy: f32 keeps serving
+    (bit-identical to the f32 engine), the fallback is visible in
+    quant_stats, and a quant_reject health event is tallied."""
+    cfg, state = setup
+    eng = _engine(cfg, state, "int8", tol=1e-12)
+    q = eng.quant_stats()
+    assert q["requested"] == "int8" and q["active"] == "f32"
+    assert q["fallback"] is True
+    assert eng.telemetry.health_counts.get("quant_reject") == 1
+    s = [_sample(5, seed=31)]
+    np.testing.assert_array_equal(
+        eng.predict_arrays(s)[0], engines["f32"].predict_arrays(s)[0])
+    assert eng.cache_stats()["misses"] == 0
+
+
+def test_hot_reload_and_rollback_with_quantized_policy(setup, tmp_path):
+    """A fresh f32 checkpoint hot-swaps into an int8-active engine with
+    zero recompiles (the candidate is quantized BEFORE validation, so
+    avals match); rollback restores the previous quantized state
+    bit-exactly; a NaN-corrupted candidate is rejected through the
+    quantize path."""
+    import jax
+
+    cfg, state = setup
+    eng = _engine(cfg, state, "int8")
+    s = [_sample(6, seed=41)]
+    before = eng.predict_arrays(s)[0]
+
+    model = create_model(cfg)
+    example = collate([_sample()], _PADS[0], _HEADS)
+    v2 = model.init(
+        {"params": jax.random.PRNGKey(9), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    ckpt = os.path.join(str(tmp_path), "cand.pk")
+    with open(ckpt, "wb") as f:
+        pickle.dump({"step": 5, "params": jax.device_get(v2["params"]),
+                     "batch_stats": jax.device_get(
+                         v2.get("batch_stats", {}))}, f)
+    rep = eng.reload_from_checkpoint(ckpt)
+    assert rep["step"] == 5
+    after = eng.predict_arrays(s)[0]
+    assert not np.array_equal(after, before)
+    assert eng.cache_stats()["misses"] == 0
+    assert eng.quant_stats()["active"] == "int8"
+    assert eng.rollback()
+    np.testing.assert_array_equal(eng.predict_arrays(s)[0], before)
+    assert eng.cache_stats()["misses"] == 0
+
+    from hydragnn_tpu.serve.engine import ReloadValidationError
+
+    bad = jax.tree_util.tree_map(
+        lambda a: np.full_like(np.asarray(a), np.nan)
+        if np.issubdtype(np.asarray(a).dtype, np.floating) else a,
+        jax.device_get(v2["params"]))
+    with pytest.raises(ReloadValidationError):
+        eng.reload_state(InferenceState(
+            step=9, params=bad,
+            batch_stats=jax.device_get(v2.get("batch_stats", {}))))
+    np.testing.assert_array_equal(eng.predict_arrays(s)[0], before)
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_serving_config_quant_knobs(monkeypatch):
+    with pytest.raises(ValueError):
+        ServingConfig(quant_policy="fp8")
+    with pytest.raises(ValueError):
+        ServingConfig(quant_tolerance=-1.0)
+    cfg = ServingConfig.from_section(
+        {"quant_policy": "bf16", "quant_tolerance": 0.01})
+    assert cfg.quant_policy == "bf16" and cfg.quant_tolerance == 0.01
+    monkeypatch.setenv("HYDRAGNN_SERVE_QUANT_POLICY", "int8")
+    monkeypatch.setenv("HYDRAGNN_SERVE_QUANT_TOL", "0.2")
+    cfg = ServingConfig.from_section({"quant_policy": "bf16"})
+    assert cfg.quant_policy == "int8"      # env wins over config
+    assert cfg.quant_tolerance == 0.2
+    from hydragnn_tpu.serve.config import serving_defaults
+
+    d = serving_defaults()
+    assert d["quant_policy"] == "f32"
+    assert d["quant_tolerance"] == 0.05

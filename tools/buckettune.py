@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""buckettune: solve a serving bucket ladder from recorded padding waste.
+
+    python tools/buckettune.py --jsonl logs/<run>/telemetry/events.jsonl \
+        [--max-ladder 4] [--max-nodes N --max-edges E] [--baseline 1,4,16]
+    python tools/buckettune.py --url http://host:port [--max-ladder 4]
+    python tools/buckettune.py --selftest        # synthetic demo + checks
+
+The serving micro-batcher records every flush's REAL graph/node/edge
+counts and the bucket it paid for (telemetry serve step records — the
+same JSONL step schema the trainer emits; docs/TELEMETRY.md).  This
+tool replays that traffic, solves for the bucket ladder of at most
+``--max-ladder`` capacities that minimizes expected padded slots
+(serve/autotune.py — exact DP over observed flush demands), validates
+the candidate by replaying the recorded distribution through the
+engine's own smallest-fitting-bucket selection, and emits the
+``Serving.buckets`` override.
+
+Data sources:
+- ``--jsonl``: a telemetry events.jsonl (or the directory holding one).
+  Uses the per-flush serve step records; the per-graph worst case
+  (max_nodes/max_edges_per_graph) is read from the records when the
+  server knew it, else pass ``--max-nodes/--max-edges``.
+- ``--url``: a live server.  Scrapes ``GET /metrics`` for the batcher's
+  ``flush_demands`` histogram and the serving shape parameters — no log
+  files needed.
+
+The tuned top capacity never shrinks below the baseline top, so every
+request the old ladder admitted still fits (no new 413s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hydragnn_tpu.serve.autotune import (  # noqa: E402
+    demands_from_flushes,
+    expected_cost,
+    replay_flushes,
+    simulate_bursts,
+    tune_ladder,
+)
+
+
+def _load_jsonl(path: str) -> List[Dict[str, Any]]:
+    if os.path.isdir(path):
+        for cand in (os.path.join(path, "events.jsonl"),
+                     os.path.join(path, "telemetry", "events.jsonl")):
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(f"no events.jsonl under {path}")
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # live run mid-write
+    return records
+
+
+def flushes_from_records(records) -> Tuple[List[Tuple[int, int, int]],
+                                           int, int, List[int]]:
+    """(flushes, max_nodes_per_graph, max_edges_per_graph, baseline
+    ladder) from serve step records.  The baseline prefers the
+    records' CONFIGURED ``ladder`` field over the buckets traffic
+    happened to land in — otherwise an unused top bucket would vanish
+    from the baseline and the tuned ladder could shrink serviceability
+    (new 413s on requests the live ladder admits)."""
+    flushes: List[Tuple[int, int, int]] = []
+    mn = me = 0
+    baseline: set = set()
+    used: set = set()
+    for r in records:
+        if r.get("event") != "step" or r.get("source") != "serve":
+            continue
+        pad = r.get("padding") or {}
+        flushes.append((int(r.get("num_graphs", 0)),
+                        int(pad.get("nodes_real", 0)),
+                        int(pad.get("edges_real", 0))))
+        mn = max(mn, int(r.get("max_nodes_per_graph", 0)))
+        me = max(me, int(r.get("max_edges_per_graph", 0)))
+        baseline.update(int(c) for c in (r.get("ladder") or []))
+        b = r.get("bucket") or {}
+        if b.get("graphs"):
+            used.add(int(b["graphs"]))
+    return flushes, mn, me, sorted(baseline or used)
+
+
+def _report(demands, baseline, tuned, mn, me,
+            flushes=None) -> Dict[str, Any]:
+    base_cost, base_over = expected_cost(demands, baseline, mn, me)
+    tuned_cost, tuned_over = expected_cost(demands, tuned["ladder"],
+                                           mn, me)
+    out: Dict[str, Any] = {
+        "baseline": {"ladder": list(baseline),
+                     "padded_slots": base_cost,
+                     "overflow_flushes": base_over},
+        "tuned": {"ladder": list(tuned["ladder"]),
+                  "padded_slots": tuned_cost,
+                  "overflow_flushes": tuned_over},
+        "padded_slots_saved_pct": round(
+            100.0 * (1.0 - tuned_cost / base_cost), 2)
+        if base_cost else 0.0,
+        "demands": {str(k): int(v) for k, v in sorted(demands.items())},
+        "max_nodes_per_graph": mn,
+        "max_edges_per_graph": me,
+    }
+    if flushes is not None:
+        # the validation replay: recorded traffic through the engine's
+        # bucket-selection rule under each ladder
+        out["replay"] = {
+            "baseline": replay_flushes(flushes, baseline, mn, me),
+            "tuned": replay_flushes(flushes, tuned["ladder"], mn, me),
+        }
+    return out
+
+
+def _print_report(rep: Dict[str, Any]) -> None:
+    b, t = rep["baseline"], rep["tuned"]
+    print(f"demands (capacity: flushes): {rep['demands']}")
+    print(f"baseline ladder {b['ladder']}: "
+          f"{b['padded_slots']:.0f} padded slots"
+          + (f", {b['overflow_flushes']} OVERFLOW"
+             if b["overflow_flushes"] else ""))
+    print(f"tuned    ladder {t['ladder']}: "
+          f"{t['padded_slots']:.0f} padded slots "
+          f"({rep['padded_slots_saved_pct']}% saved)")
+    rp = rep.get("replay")
+    if rp:
+        rb, rt = rp["baseline"], rp["tuned"]
+        print(f"replay (engine bucket selection over recorded flushes):")
+        print(f"  baseline: node waste {rb['nodes_waste_pct']:.1f}%  "
+              f"edge waste {rb['edges_waste_pct']:.1f}%  "
+              f"slots {rb['padded_slots']}")
+        print(f"  tuned:    node waste {rt['nodes_waste_pct']:.1f}%  "
+              f"edge waste {rt['edges_waste_pct']:.1f}%  "
+              f"slots {rt['padded_slots']}")
+    lad = ",".join(str(c) for c in t["ladder"])
+    print(f"\nServing.buckets override:")
+    print(f"  env:    HYDRAGNN_SERVE_BUCKETS={lad}")
+    print(f"  config: {{\"Serving\": {{\"buckets\": \"{lad}\"}}}}")
+    if list(t["ladder"]) == list(b["ladder"]):
+        print("  (tuned ladder equals the baseline — traffic already "
+              "matches the configured buckets)")
+
+
+def _selftest() -> int:
+    """Synthetic demo doubling as a sanity check: a burst-y request
+    stream whose flushes the default ladder pads badly."""
+    import numpy as np
+
+    mn, me, top = 16, 64, 16
+    rng = np.random.RandomState(7)
+    sizes = [(int(rng.randint(3, 13)), int(rng.randint(4, 40)))
+             for _ in range(2000)]
+    bursts = [int(b) for b in rng.choice(
+        [1, 2, 2, 3, 6, 10], size=600, replace=True)]
+    flushes = simulate_bursts(sizes, bursts, top, mn, me)
+    demands = demands_from_flushes(flushes, mn, me)
+    baseline = [1, 4, 16]
+    tuned = tune_ladder(demands, max_ladder=4, max_nodes_per_graph=mn,
+                        max_edges_per_graph=me, force_top=top)
+    rep = _report(demands, baseline, tuned, mn, me, flushes)
+    _print_report(rep)
+    ok = (rep["tuned"]["padded_slots"] <= rep["baseline"]["padded_slots"]
+          and rep["replay"]["tuned"]["overflow"] == 0)
+    print(f"\nselftest {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--jsonl", default=None,
+                     help="telemetry events.jsonl (or its directory)")
+    src.add_argument("--url", default=None,
+                     help="live server base URL (scrapes GET /metrics)")
+    src.add_argument("--selftest", action="store_true",
+                     help="synthetic distribution demo + sanity check")
+    ap.add_argument("--max-ladder", type=int, default=4,
+                    help="max bucket count in the tuned ladder "
+                         "(default 4; each bucket is one AOT compile "
+                         "at startup)")
+    ap.add_argument("--max-nodes", type=int, default=0,
+                    help="per-graph worst-case nodes (JSONL mode when "
+                         "the records don't carry it)")
+    ap.add_argument("--max-edges", type=int, default=0,
+                    help="per-graph worst-case edges (ditto)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline ladder override, comma list "
+                         "(default: the ladder observed in the data)")
+    ap.add_argument("--out", default=None,
+                    help="write the full JSON report here")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+
+    flushes: Optional[List[Tuple[int, int, int]]] = None
+    if args.jsonl:
+        records = _load_jsonl(args.jsonl)
+        flushes, mn, me, baseline = flushes_from_records(records)
+        if not flushes:
+            print("no serve step records in the log — run traffic with "
+                  "telemetry enabled (HYDRAGNN_TELEMETRY=1) first",
+                  file=sys.stderr)
+            return 2
+        mn = args.max_nodes or mn
+        me = args.max_edges or me
+        if mn < 1 or me < 1:
+            print("records carry no per-graph worst case — pass "
+                  "--max-nodes/--max-edges (the serving config's "
+                  "max_nodes_per_graph/max_edges_per_graph)",
+                  file=sys.stderr)
+            return 2
+        demands = demands_from_flushes(flushes, mn, me)
+    elif args.url:
+        met = json.loads(urllib.request.urlopen(
+            args.url.rstrip("/") + "/metrics", timeout=10).read())
+        sv = met.get("serving") or {}
+        mn = args.max_nodes or int(sv.get("max_nodes_per_graph", 0))
+        me = args.max_edges or int(sv.get("max_edges_per_graph", 0))
+        if mn < 1 or me < 1:
+            print("server carries no per-graph worst case — pass "
+                  "--max-nodes/--max-edges", file=sys.stderr)
+            return 2
+        demands = {int(k): int(v) for k, v in
+                   (met.get("batcher", {}).get("flush_demands")
+                    or {}).items()}
+        if not demands:
+            print("server has no flush-demand samples yet (no flushes "
+                  "with a configured per-graph worst case) — send "
+                  "traffic first", file=sys.stderr)
+            return 2
+        baseline = [int(b) for b in sv.get("buckets", [])]
+    else:
+        print("need --jsonl, --url or --selftest", file=sys.stderr)
+        return 2
+
+    if args.baseline:
+        baseline = [int(x) for x in args.baseline.split(",") if x.strip()]
+    if not baseline:
+        baseline = [max(demands)]
+    tuned = tune_ladder(demands, max_ladder=args.max_ladder,
+                        max_nodes_per_graph=mn, max_edges_per_graph=me,
+                        force_top=max(baseline))
+    rep = _report(demands, baseline, tuned, mn, me, flushes)
+    _print_report(rep)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=2)
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

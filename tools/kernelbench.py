@@ -11,13 +11,20 @@ graph/segment.py choose between:
   dense     sorted dense-schedule scatter (ops/fused_mp.segment_sum_dense)
   poly      fused multi-moment pass (ops/poly_mp.segment_poly_dense)
 
-Two moment sets:
+Three moment sets:
 
   sum       plain segment sum — every backend
   pna       the PNA aggregator set (sum + sum-of-squares + max/min +
             degree): composed (2 scatter-sums + double-width segment_max +
             degree scatter) vs the ONE fused poly pass — the number behind
             the PNA end-to-end claim.
+  matmul    the quantized-inference dense op (hydragnn_tpu/quant,
+            docs/SERVING.md "Quantized inference"): an [E, F] x [F, 4F]
+            activation matmul as f32, bf16, and int8-weight-dequantized-
+            into-bf16 — isolating the per-op policy cost/win from
+            end-to-end serving noise.  Runs on every backend (no Pallas);
+            NOTE on CPU XLA emulates bf16, so the low-precision rows
+            lose there — the HBM/MXU win is TPU-only.
 
 Methodology matches bench.py: each measurement jits a fori_loop of
 ``--inner`` serially-dependent applications (the loop carry feeds a hair of
@@ -120,7 +127,8 @@ def _time_chain(fn, data, inner, repeats):
     return best / inner
 
 
-def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas):
+def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas,
+              feat=0):
     """{name: data -> output} for the requested moment set."""
     import jax
     import jax.numpy as jnp
@@ -134,6 +142,27 @@ def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas):
     m = jnp.asarray(mask)
     n = num_nodes
     run_pallas = on_tpu or force_pallas
+
+    if moments == "matmul":
+        # weight-only quantization A/B at this shape's feature width:
+        # data is the [E, F] activation block, weights are [F, 4F]
+        # (the MLP expansion every interaction block pays).  Weights
+        # are built EAGERLY (concrete arrays) — closure state created
+        # inside the timed trace would leak tracers.
+        from hydragnn_tpu.quant import dequantize, quantize_int8
+
+        rng = np.random.RandomState(11)
+        w32 = jnp.asarray(rng.randn(feat, 4 * feat).astype(np.float32))
+        w16 = w32.astype(jnp.bfloat16)
+        wq = quantize_int8(w32)
+        return {
+            "mm-f32": lambda d: d @ w32,
+            "mm-bf16": lambda d: (d.astype(jnp.bfloat16)
+                                  @ w16).astype(jnp.float32),
+            "mm-int8deq": lambda d: (d.astype(jnp.bfloat16)
+                                     @ dequantize(wq)
+                                     ).astype(jnp.float32),
+        }
 
     if moments == "sum":
         out = {
@@ -188,8 +217,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--shapes", default="small,flagship",
                     help=f"comma list from {sorted(SHAPES)}")
-    ap.add_argument("--moments", default="sum,pna",
-                    help="comma list from sum,pna")
+    ap.add_argument("--moments", default="sum,pna,matmul",
+                    help="comma list from sum,pna,matmul")
     ap.add_argument("--inner", type=int, default=20,
                     help="op applications per compiled loop (default 20)")
     ap.add_argument("--repeats", type=int, default=3,
@@ -218,7 +247,7 @@ def main(argv=None) -> int:
         data = jnp.asarray(data)
         for moments in [m for m in args.moments.split(",") if m]:
             fns = _backends(moments, receivers, mask, spec["num_nodes"],
-                            on_tpu, args.force_pallas)
+                            on_tpu, args.force_pallas, feat=spec["feat"])
             for name, fn in fns.items():
                 key = f"{shape_name}/{moments}/{name}"
                 try:

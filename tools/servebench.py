@@ -7,6 +7,7 @@
         [--out BENCH_serve.json]
     python tools/servebench.py --selftest --overload \
         [--rate 0] [--duration 8] [--deadline-ms 250]     # overload probe
+    python tools/servebench.py --quant-ab                 # f32/bf16/int8 A/B
 
 Closed loop (default): each of ``--concurrency`` workers POSTs random
 graphs to ``/predict`` back-to-back (next request only after the
@@ -148,6 +149,10 @@ def run_bench(url: str, concurrency: int, requests_total: int,
             "hit_rate_post_warmup": round(
                 hits / (hits + misses), 4) if (hits + misses) else 1.0,
         },
+        # resident parameter bytes + active dtype policy of the loaded
+        # pytree (engine.quant_stats) — the HBM-per-replica claim is
+        # RECORDED per run, not asserted
+        "quant": eng.get("quant", {}),
         "slo": {
             "max_wait_ms": max_wait_ms,
             "max_predict_ms": round(max_predict_ms, 3),
@@ -316,7 +321,9 @@ def run_overload(url: str, rate: float, duration_s: float, max_nodes: int,
 
 def _selftest_server(deadline_ms: float = 10_000.0,
                      chaos_predict_ms: float = 0.0,
-                     buckets: Tuple[int, ...] = (1, 4, 16)):
+                     buckets: Tuple[int, ...] = (1, 4, 16),
+                     quant_policy: str = "f32",
+                     hidden_dim: int = 8):
     """Tiny fresh-initialized SAGE model behind a local server on an
     ephemeral port — no checkpoint, no dataset.
 
@@ -326,6 +333,10 @@ def _selftest_server(deadline_ms: float = 10_000.0,
     down to a rate a Python-thread open-loop generator (and the stdlib
     accept loop) can genuinely exceed; the capacity probe runs against
     the SAME slowed server, so the 2x-capacity claim stays honest.
+
+    ``quant_policy``/``hidden_dim`` drive the ``--quant-ab`` A/B: the
+    quant runs use a wider model (hidden 64) so the int8 per-channel
+    scale overhead is amortized like a real checkpoint's.
     """
     import jax
 
@@ -336,9 +347,10 @@ def _selftest_server(deadline_ms: float = 10_000.0,
     from hydragnn_tpu.serve import (
         InferenceEngine, InferenceServer, InferenceState, ServingConfig)
 
+    h = int(hidden_dim)
     cfg = ModelConfig(
-        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
-        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        model_type="SAGE", input_dim=1, hidden_dim=h, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, h, 1, (h,)),
         node_head=None, task_weights=(1.0,), num_conv_layers=2)
     model = create_model(cfg)
     example = collate(
@@ -352,7 +364,8 @@ def _selftest_server(deadline_ms: float = 10_000.0,
                            batch_stats=variables.get("batch_stats", {}))
     serving = ServingConfig(buckets=buckets, max_nodes_per_graph=16,
                             max_edges_per_graph=128, max_wait_ms=10.0,
-                            port=0, request_deadline_ms=deadline_ms)
+                            port=0, request_deadline_ms=deadline_ms,
+                            quant_policy=quant_policy)
     pads = [PadSpec.for_batch(b, serving.max_nodes_per_graph,
                               serving.max_edges_per_graph)
             for b in serving.buckets]
@@ -366,6 +379,141 @@ def _selftest_server(deadline_ms: float = 10_000.0,
     server = InferenceServer(engine, serving=serving, chaos=chaos)
     server.start()
     return server
+
+
+def _engine_rps(engine, max_nodes: int, n_graphs: int = 4,
+                iters: int = 60, rounds: int = 3) -> float:
+    """Low-noise engine-direct throughput (graphs/s): time a loop of
+    ``predict_arrays`` over a FIXED sample group, best-of-``rounds`` —
+    the A/B number that isolates the quant policy's compiled program
+    from HTTP/batcher transport jitter."""
+    from hydragnn_tpu.graph.batch import GraphSample
+    from hydragnn_tpu.graph.neighborlist import radius_graph
+
+    rng = np.random.RandomState(5)
+    samples = []
+    for _ in range(n_graphs):
+        n = int(rng.randint(6, max(7, max_nodes + 1)))
+        pos = (rng.rand(n, 3) * 2.0).astype(np.float32)
+        samples.append(GraphSample(
+            x=rng.rand(n, 1).astype(np.float32), pos=pos,
+            edge_index=radius_graph(pos, 1.2, max_neighbours=8)))
+    engine.predict_arrays(samples)  # warm the bucket
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            engine.predict_arrays(samples)
+        best = min(best, time.perf_counter() - t0)
+    return n_graphs * iters / best
+
+
+def run_quant_ab(requests_total: int, max_nodes: int,
+                 concurrency: int = 4) -> Dict[str, Any]:
+    """A/B the dtype policies end-to-end: one selftest server per
+    policy (f32 / bf16 / int8, hidden 64), the closed-loop HTTP bench
+    plus an engine-direct predict loop against each, resident
+    parameter bytes from the loaded pytree — BENCH_serve_quant.json.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    policies = ("f32", "bf16", "int8")
+    rows: Dict[str, Any] = {}
+    for policy in policies:
+        server = _selftest_server(quant_policy=policy, hidden_dim=64)
+        url = f"http://127.0.0.1:{server.port}"
+        print(f"quant-ab: policy {policy} on {url}", flush=True)
+        try:
+            # median-of-3 closed-loop rounds: the CPU selftest is
+            # transport-bound, so single-round throughput carries a few
+            # percent of scheduler noise that would swamp the policy
+            # comparison
+            rounds = [run_bench(url, concurrency, requests_total,
+                                max_nodes) for _ in range(3)]
+            rps = sorted(r["throughput_rps"] for r in rounds)
+            res = rounds[-1]
+            quant = dict(res.get("quant") or {})
+            rows[policy] = {
+                "requested": quant.get("requested", policy),
+                "active": quant.get("active"),
+                "fallback": bool(quant.get("fallback")),
+                "golden_max_delta": quant.get("golden_max_delta"),
+                "quant_tolerance": quant.get("tolerance"),
+                "param_bytes": int(quant.get("param_bytes", 0)),
+                "http_rps": rps[1],
+                "http_rps_rounds": rps,
+                "latency_ms": res["latency_ms"],
+                "errors": sum(r["errors"] for r in rounds),
+                "cache_misses": res["cache"]["misses"],
+                "engine_rps": round(_engine_rps(server.engine,
+                                                max_nodes), 1),
+            }
+        finally:
+            server.shutdown()
+    f32b = max(rows["f32"]["param_bytes"], 1)
+    ab = {
+        "bf16_param_bytes_ratio": round(
+            rows["bf16"]["param_bytes"] / f32b, 4),
+        "int8_param_bytes_ratio": round(
+            rows["int8"]["param_bytes"] / f32b, 4),
+        "bf16_engine_rps_ratio": round(
+            rows["bf16"]["engine_rps"] / max(rows["f32"]["engine_rps"],
+                                             1e-9), 4),
+        "int8_engine_rps_ratio": round(
+            rows["int8"]["engine_rps"] / max(rows["f32"]["engine_rps"],
+                                             1e-9), 4),
+        "bf16_http_rps_ratio": round(
+            rows["bf16"]["http_rps"] / max(rows["f32"]["http_rps"],
+                                           1e-9), 4),
+        "int8_http_rps_ratio": round(
+            rows["int8"]["http_rps"] / max(rows["f32"]["http_rps"],
+                                           1e-9), 4),
+    }
+    result = {
+        "bench": "serve_quant",
+        "config": {"requests": requests_total, "concurrency": concurrency,
+                   "max_nodes": max_nodes, "hidden_dim": 64},
+        "policies": rows,
+        "ab": ab,
+        # On CPU, XLA EMULATES bf16 (convert ops around every matmul),
+        # so BOTH throughput ratios under-state the policies there —
+        # the levers they pull (HBM bandwidth, MXU-native bf16) only
+        # exist on TPU.  param_bytes, golden deltas, active policies
+        # and zero-recompile are backend-independent and enforced
+        # everywhere; the throughput gate is enforced on TPU.
+        "note": "CPU emulates bf16 compute, so the throughput ratios "
+                "under-state bf16/int8 off-TPU; param_bytes and the "
+                "golden-gate/zero-recompile rows are the portable "
+                "claims, and the throughput gate binds on tpu backends",
+        "slo": {
+            "backend": backend,
+            # ISSUE 6 acceptance gates; throughput = serving-level
+            # (median-of-3 closed loop), 2% noise floor
+            "bf16_throughput_ge_f32": ab["bf16_http_rps_ratio"] >= 0.98,
+            "bf16_http_rps_ge_f32_strict": ab["bf16_http_rps_ratio"]
+                                           >= 1.0,
+            "throughput_gate_enforced": backend == "tpu",
+            "policies_active": all(not rows[p]["fallback"]
+                                   and rows[p]["active"] == p
+                                   for p in ("bf16", "int8")),
+            "int8_param_bytes_le_0p3x": ab["int8_param_bytes_ratio"]
+                                        <= 0.3,
+            "bf16_param_bytes_le_0p5x": ab["bf16_param_bytes_ratio"]
+                                        <= 0.5,
+            "zero_recompiles": all(rows[p]["cache_misses"] == 0
+                                   for p in policies),
+            "zero_errors": all(rows[p]["errors"] == 0 for p in policies),
+        },
+    }
+    slo = result["slo"]
+    enforced = ["policies_active", "int8_param_bytes_le_0p3x",
+                "bf16_param_bytes_le_0p5x", "zero_recompiles",
+                "zero_errors"]
+    if slo["throughput_gate_enforced"]:
+        enforced.append("bf16_throughput_ge_f32")
+    slo["ok"] = all(bool(slo[k]) for k in enforced)
+    return result
 
 
 def main(argv=None) -> int:
@@ -387,6 +535,10 @@ def main(argv=None) -> int:
                     help="open-loop overload mode: fixed arrival rate "
                          "above capacity; reports goodput/shed "
                          "rate/p99-of-accepted")
+    ap.add_argument("--quant-ab", action="store_true",
+                    help="A/B the f32/bf16/int8 dtype policies against "
+                         "in-process selftest servers; writes "
+                         "BENCH_serve_quant.json")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="overload arrival rate in req/s (0 = auto: 2x a "
                          "measured closed-loop capacity probe)")
@@ -403,8 +555,27 @@ def main(argv=None) -> int:
                     help="output JSON path (default BENCH_serve.json, "
                          "or BENCH_serve_overload.json with --overload)")
     args = ap.parse_args(argv)
-    out_path = args.out or ("BENCH_serve_overload.json" if args.overload
-                            else "BENCH_serve.json")
+    out_path = args.out or (
+        "BENCH_serve_quant.json" if args.quant_ab
+        else "BENCH_serve_overload.json" if args.overload
+        else "BENCH_serve.json")
+
+    if args.quant_ab:
+        result = run_quant_ab(args.requests, args.nodes,
+                              concurrency=args.concurrency)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result, indent=2))
+        print(f"\nwrote {out_path}")
+        slo = result["slo"]
+        ab = result["ab"]
+        print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: bf16 engine rps "
+              f"{ab['bf16_engine_rps_ratio']:.2f}x f32, param bytes "
+              f"bf16 {ab['bf16_param_bytes_ratio']:.2f}x / int8 "
+              f"{ab['int8_param_bytes_ratio']:.2f}x f32, deltas "
+              f"bf16={result['policies']['bf16']['golden_max_delta']} "
+              f"int8={result['policies']['int8']['golden_max_delta']}")
+        return 0 if slo["ok"] else 1
 
     server = None
     url = args.url

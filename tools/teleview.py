@@ -136,6 +136,58 @@ _SERVING_KINDS = ("request_enqueued", "batch_flushed", "deadline_flush",
 # (request_shed + deadline_expired over offered = enqueued + shed)
 _SHED_WARN_RATIO = 0.10
 
+# WARN when a serving bucket's MEAN node-padding waste exceeds this —
+# the signal that the ladder is mis-sized for the traffic and
+# tools/buckettune.py should re-solve it
+_BUCKET_WASTE_WARN_PCT = 50.0
+
+
+def serve_bucket_section(serve_steps: List[Dict[str, Any]]) -> str:
+    """Per-bucket fill/padding table from the batcher's serve step
+    records (the trainer-schema padding block, docs/TELEMETRY.md):
+    which buckets traffic actually lands in and how much of each padded
+    batch was waste — the at-a-glance input to bucket-ladder retuning
+    (tools/buckettune.py)."""
+    groups: Dict[tuple, Dict[str, float]] = {}
+    for r in serve_steps:
+        b = r.get("bucket") or {}
+        pad = r.get("padding") or {}
+        key = (int(b.get("graphs", 0)), int(b.get("nodes", 0)),
+               int(b.get("edges", 0)))
+        g = groups.setdefault(key, {"flushes": 0, "graphs": 0.0,
+                                    "fill": 0.0, "pad_n": 0.0,
+                                    "pad_e": 0.0})
+        g["flushes"] += 1
+        g["graphs"] += float(r.get("num_graphs", 0))
+        g["fill"] += float(r.get("fill_pct", 0.0))
+        g["pad_n"] += float(pad.get("nodes_waste_pct", 0.0))
+        g["pad_e"] += float(pad.get("edges_waste_pct", 0.0))
+    rows, warns = [], []
+    for key in sorted(groups):
+        g = groups[key]
+        n = max(int(g["flushes"]), 1)
+        mean_pad_n = g["pad_n"] / n
+        rows.append([
+            f"{key[0]}g/{key[1]}n/{key[2]}e",
+            str(int(g["flushes"])),
+            str(int(g["graphs"])),
+            f"{g['fill'] / n:.1f}",
+            f"{mean_pad_n:.1f}",
+            f"{g['pad_e'] / n:.1f}",
+        ])
+        if mean_pad_n > _BUCKET_WASTE_WARN_PCT:
+            warns.append(
+                f"  WARNING bucket {key[0]}g/{key[1]}n/{key[2]}e mean "
+                f"node-padding waste {mean_pad_n:.1f}% exceeds "
+                f"{_BUCKET_WASTE_WARN_PCT:.0f}% — re-solve the ladder "
+                "with tools/buckettune.py")
+    table = _table(rows, ["bucket", "flushes", "graphs", "fill%",
+                          "pad_n%", "pad_e%"])
+    out = "\n".join("  " + line for line in table.splitlines())
+    if warns:
+        out += "\n" + "\n".join(warns)
+    return out
+
 
 def serving_section(health: List[Dict[str, Any]],
                     manifests: List[Dict[str, Any]]) -> str:
@@ -232,7 +284,12 @@ def main(argv=None) -> int:
 
     path = find_events(args.path)
     records = load_records(path)
-    steps = [r for r in records if r.get("event") == "step"]
+    # serving flushes share the step-record schema (source: "serve") —
+    # keep them out of the trainer step table
+    steps = [r for r in records if r.get("event") == "step"
+             and r.get("source") != "serve"]
+    serve_steps = [r for r in records if r.get("event") == "step"
+                   and r.get("source") == "serve"]
     epochs = [r for r in records if r.get("event") == "epoch"]
     manifests = [r for r in records if r.get("event") == "manifest"]
     health = [r for r in records if r.get("event") == "health"]
@@ -259,6 +316,9 @@ def main(argv=None) -> int:
             for k in (m.get("health") or {})):
         print("\nserving:")
         print(serving_section(health, manifests))
+    if serve_steps:
+        print("\nserving buckets:")
+        print(serve_bucket_section(serve_steps))
     if manifests:
         m = manifests[-1]
         print(f"\nmanifest: run {m.get('run_id')}  "
