@@ -135,6 +135,11 @@ def _sync(tree):
     np.asarray(jax.tree_util.tree_leaves(tree)[0])
 
 
+# the ONE optimizer every bench mode trains with: _zero_main's dp steps
+# must run the exact hyperparameters _build initialized the opt state under
+BENCH_OPTIMIZER = {"type": "AdamW", "learning_rate": 1e-3}
+
+
 def _build(model_type="SchNet", hidden=64, dtype="float32", batch_size=512,
            nodes_per_graph=20, tight_edges=False):
     """Flagship-shaped synthetic setup for one arch: QM9-scale graphs
@@ -221,7 +226,7 @@ def _build(model_type="SchNet", hidden=64, dtype="float32", batch_size=512,
         compute_dtype=dtype,
     )
     model = create_model(cfg)
-    opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    opt_spec = select_optimizer(BENCH_OPTIMIZER)
     state = create_train_state(model, batch, opt_spec)
     batch = jax.device_put(batch)
     step = make_train_step(model, cfg, opt_spec)
@@ -429,7 +434,7 @@ def _sustained(samples, heads, default_path=False):
         num_gaussians=50, num_filters=64, radius=1.8, max_neighbours=20,
         compute_dtype=os.getenv("HYDRAGNN_BENCH_DTYPE", "float32").strip())
     model = create_model(cfg)
-    opt_spec = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    opt_spec = select_optimizer(BENCH_OPTIMIZER)
     state = create_train_state(model, next(iter(train_loader)), opt_spec)
 
     n_epochs = 6
@@ -891,6 +896,174 @@ def _child(platform: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --zero: ZeRO sharded-training ladder (bytes per device + throughput)
+# ---------------------------------------------------------------------------
+
+
+def _zero_main(argv) -> int:
+    """``python bench.py --zero``: measure per-device resident param /
+    optimizer-state bytes and step throughput for the dense h256/h512/h1024
+    ladder under replicated DP vs ZeRO-1 vs ZeRO-2 on the current mesh
+    (docs/SCALING.md §4).  Bytes rows are exact (analytic from the placed
+    shardings, cross-checked against the MEASURED per-device shard bytes);
+    throughput rows are best-effort on CPU (the MEMORY ratio, not CPU
+    walltime, is the deliverable off-TPU).  Writes BENCH_zero.json and
+    prints one compact JSON line."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --zero")
+    ap.add_argument("--hidden", default="256,512,1024",
+                    help="comma ladder of hidden widths")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="graphs per DEVICE per step")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="timed steps per mode (0 = bytes only)")
+    ap.add_argument("--max-timed-hidden", type=int, default=None,
+                    help="skip throughput timing above this width "
+                         "(default: 512 on CPU, unlimited on TPU)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_zero.json"))
+    args = ap.parse_args(argv)
+
+    # the ladder needs a multi-device mesh to shard across — force a
+    # virtual 8-device host mesh unless the env already decided
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.parallel.mesh import (
+        make_dp_train_step,
+        make_mesh,
+        replicate_state,
+        stack_batches,
+    )
+    from hydragnn_tpu.parallel.zero import (
+        measured_device_bytes,
+        sharding_report,
+        zero_shard_state,
+    )
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    n_dev = len(devs)
+    max_timed = args.max_timed_hidden or (10**9 if on_tpu else 512)
+    mesh = make_mesh()
+    dtype = "bfloat16" if on_tpu else "float32"
+    print(f"bench --zero: platform={devs[0].platform} devices={n_dev} "
+          f"dtype={dtype}", file=sys.stderr)
+
+    rows = {}
+    compact_rows = {}
+    for hidden in [int(h) for h in args.hidden.split(",") if h.strip()]:
+        state, batch, _step, cfg, _s, _h = _build(
+            hidden=hidden, dtype=dtype, batch_size=args.batch,
+            tight_edges=True)
+        from hydragnn_tpu.models.create import create_model
+        from hydragnn_tpu.train.optimizer import select_optimizer
+
+        model = create_model(cfg)
+        opt_spec = select_optimizer(BENCH_OPTIMIZER)
+        # host copies: each mode re-places them, and the per-rung
+        # _release_device (which deletes EVERY live device array) must not
+        # invalidate the state the next rung's modes start from
+        state = jax.device_get(state)
+        stacked = jax.device_get(stack_batches([batch] * n_dev))
+        row = {}
+        prev_params = None
+        for mode, stage in (("replicated", 0), ("zero1", 1), ("zero2", 2)):
+            if stage == 0:
+                st = replicate_state(state, mesh)
+                zs = None
+            else:
+                st, zs = zero_shard_state(state, mesh, stage=stage)
+            rep = sharding_report(st, zs)
+            dev0 = mesh.devices.flat[0]
+            rep["param_bytes_per_device_measured"] = measured_device_bytes(
+                st.params, dev0)
+            rep["opt_bytes_per_device_measured"] = measured_device_bytes(
+                st.opt_state, dev0)
+            mrow = {k: rep[k] for k in (
+                "param_bytes_per_device", "opt_bytes_per_device",
+                "param_bytes_replicated", "opt_bytes_replicated",
+                "param_bytes_per_device_measured",
+                "opt_bytes_per_device_measured",
+                "padded_waste_bytes_per_device")}
+            mrow["resident_bytes_per_device"] = (
+                rep["param_bytes_per_device"] + rep["opt_bytes_per_device"])
+            if args.steps > 0 and hidden <= max_timed:
+                dp_step = make_dp_train_step(
+                    model, cfg, opt_spec, mesh, zero_specs=zs)
+                t0 = time.perf_counter()
+                st, m = dp_step(st, stacked)
+                _sync(m["loss"])
+                mrow["compile_plus_first_step_s"] = round(
+                    time.perf_counter() - t0, 3)
+                # the parity evidence: the FIRST step from identical state
+                # is bit-comparable across modes; later free-running steps
+                # accumulate cross-program fusion jitter
+                mrow["loss_first_step"] = float(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    st, m = dp_step(st, stacked)
+                _sync(m["loss"])
+                dt = (time.perf_counter() - t0) / args.steps
+                mrow["step_ms"] = round(dt * 1e3, 2)
+                mrow["graphs_per_sec"] = round(args.batch * n_dev / dt, 1)
+                # parity anchor: every mode's params after the same K steps
+                if stage > 0:
+                    from hydragnn_tpu.parallel.zero import consolidate_state
+
+                    st = consolidate_state(st, zs, mesh)
+                leaves = [np.asarray(x) for x in
+                          jax.tree_util.tree_leaves(jax.device_get(st.params))]
+                if prev_params is not None:
+                    mrow["params_match_replicated"] = bool(all(
+                        np.allclose(a, b, rtol=1e-4, atol=1e-6)
+                        for a, b in zip(prev_params, leaves)))
+                else:
+                    prev_params = leaves
+            row[mode] = mrow
+            print(f"bench --zero: h{hidden} {mode}: "
+                  f"opt {mrow['opt_bytes_per_device']/1e6:.2f} MB/dev "
+                  f"(repl {mrow['opt_bytes_replicated']/1e6:.2f}), "
+                  f"params {mrow['param_bytes_per_device']/1e6:.2f} MB/dev"
+                  + (f", {mrow.get('graphs_per_sec', 0)} g/s"
+                     if "graphs_per_sec" in mrow else ""), file=sys.stderr)
+        _release_device()  # rung boundary: all live device arrays dropped
+        rows[f"h{hidden}"] = row
+        o_r = row["replicated"]["opt_bytes_per_device"]
+        o_z = row["zero1"]["opt_bytes_per_device"]
+        compact_rows[f"h{hidden}"] = {
+            "opt_mb_repl": round(o_r / 1e6, 2),
+            "opt_mb_z1": round(o_z / 1e6, 2),
+            "ratio": round(o_z / max(o_r, 1), 4),
+        }
+    result = {
+        "metric": "zero_sharding_bytes",
+        "unit": "bytes/device",
+        "platform": devs[0].platform,
+        "devices": n_dev,
+        "zero_axis_size": n_dev,
+        "batch_per_device": args.batch,
+        "dtype": dtype,
+        "ladder": rows,
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, args.out)
+    print(json.dumps({"metric": "zero_sharding_bytes", "devices": n_dev,
+                      "ladder": compact_rows,
+                      "evidence": os.path.basename(args.out)}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # parent
 # ---------------------------------------------------------------------------
 
@@ -987,5 +1160,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         _child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
+    elif len(sys.argv) > 1 and sys.argv[1] == "--zero":
+        sys.exit(_zero_main(sys.argv[2:]))
     else:
         main()

@@ -199,6 +199,14 @@ def finalize(
 
     for k, v in resilience_training_defaults().items():
         training.setdefault(k, v)
+    # ZeRO sharding stage (docs/SCALING.md §4): default 0 (replicated DP)
+    # written back like the other Training defaults, and VALIDATED on every
+    # construction path — a typo'd stage must fail here, not silently train
+    # replicated while the operator believes memory is sharded.  The
+    # HYDRAGNN_ZERO env knob overlays at trainer build time (env wins).
+    from hydragnn_tpu.parallel.zero import check_zero_stage
+
+    training["zero_stage"] = check_zero_stage(training.get("zero_stage", 0))
     return config
 
 

@@ -261,11 +261,18 @@ def make_dp_train_step(
     be a tuple of mesh axes — e.g. ("dcn", "ici") from
     :func:`make_multislice_mesh` — in which case DP spans their product.
 
-    With ``zero_specs`` (from parallel.zero.shard_opt_state) the optimizer
-    state stays sharded along ``zero_axis`` (default: the innermost DP axis,
-    so the ZeRO all_gather stays on ICI) — each device updates only its
-    slice of params/moments and the new params are all_gather-ed (ZeRO-1,
-    reference optimizer.py:43-103).
+    ``zero_specs`` may be a :class:`parallel.zero.ZeroSharding` (from
+    ``zero_shard_state`` — the production path, stages 1 and 2) or a raw
+    PartitionSpec tree (from ``shard_opt_state``, legacy stage-1 callers).
+    The optimizer state stays sharded along ``zero_axis`` (default: the
+    innermost DP axis, so the ZeRO all_gather stays on ICI) — each device
+    updates only its slice of params/moments and the new params are
+    all_gather-ed (ZeRO-1, reference optimizer.py:43-103).  At stage 2 the
+    params are sharded at rest too: the step all_gathers them into the
+    transient full tree the forward needs and keeps the updated slices,
+    and because the returned jit donates the state (``donate_argnums=0``)
+    XLA reuses the sharded buffers — peak HBM is one full param tree plus
+    the 1/N-resident state, not N replicas.
 
     ``nonfinite_guard`` adds the in-jit NaN/Inf step guard
     (resilience/guards.py).  The flag is derived AFTER the gradient pmean,
@@ -275,8 +282,20 @@ def make_dp_train_step(
     """
     import optax
 
+    from hydragnn_tpu.parallel.zero import ZeroSharding
+
     energy_head, forces_head = _force_head_indices(output_names)
     axes = _dp_axes(axis)
+    zero_sh: Optional[ZeroSharding] = None
+    if isinstance(zero_specs, ZeroSharding):
+        zero_sh = zero_specs
+        zero_specs = zero_sh.opt_specs
+        if zero_axis is not None and zero_axis != zero_sh.axis:
+            raise ValueError(
+                f"zero_axis={zero_axis!r} but the ZeroSharding was built "
+                f"for axis {zero_sh.axis!r}")
+        zero_axis = zero_sh.axis
+    zero_stage2 = zero_sh is not None and zero_sh.stage >= 2
     if zero_specs is not None:
         # derive the shard axis from the specs the opt state was ACTUALLY
         # placed with — a separately-guessed axis would slice gradients
@@ -311,6 +330,16 @@ def make_dp_train_step(
             jax.random.fold_in(jax.random.PRNGKey(0xD0), state.step),
             dev_idx,
         )
+        if zero_stage2:
+            # stage 2: params arrive as this device's slice — all_gather the
+            # transient full tree the forward needs (the per-step peak; the
+            # at-rest copy stays 1/N)
+            from hydragnn_tpu.parallel import zero
+
+            params_full = zero.unshard_tree_dims(
+                state.params, zero_sh.param_dims, zero_axis)
+        else:
+            params_full = state.params
 
         def loss_fn(params):
             return _loss_and_metrics(
@@ -318,7 +347,7 @@ def make_dp_train_step(
                 energy_head, forces_head, dropout_rng)
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+            loss_fn, has_aux=True)(params_full)
         # gradient pmean across devices = DDP all-reduce parity (over a
         # multi-slice mesh XLA reduces hierarchically: ICI first, then DCN)
         grads = jax.lax.pmean(grads, axes)
@@ -337,12 +366,17 @@ def make_dp_train_step(
 
             idx = jax.lax.axis_index(zero_axis)
             g_sh = zero.shard_tree(grads, idx, n_zero)
-            p_sh = zero.shard_tree(state.params, idx, n_zero)
+            # stage 2: the at-rest params ARE this device's (padded) slice
+            p_sh = (state.params if zero_stage2
+                    else zero.shard_tree(state.params, idx, n_zero))
             updates, new_opt_state = opt_spec.tx.update(
                 g_sh, state.opt_state, p_sh)
             updates = encoder_freeze_mask(updates, cfg.freeze_conv)
             new_p_sh = optax.apply_updates(p_sh, updates)
-            new_params = zero.unshard_tree(new_p_sh, state.params, zero_axis)
+            # stage 2 keeps the updated slices sharded at rest; stage 1
+            # gathers them back to the replicated layout
+            new_params = (new_p_sh if zero_stage2 else
+                          zero.unshard_tree(new_p_sh, params_full, zero_axis))
         else:
             updates, new_opt_state = opt_spec.tx.update(
                 grads, state.opt_state, state.params)
@@ -360,10 +394,7 @@ def make_dp_train_step(
             **{f"task_{i}": t for i, t in enumerate(per_head)},
         }
         if telemetry_metrics:
-            from hydragnn_tpu.train.trainer import (
-                step_telemetry_metrics,
-                tree_l2_norm,
-            )
+            from hydragnn_tpu.train.trainer import step_telemetry_metrics
 
             tele = step_telemetry_metrics(g, grads, new_params, updates)
             # counts are per-shard — make them global like num_graphs
@@ -371,11 +402,29 @@ def make_dp_train_step(
             tele["edges_real"] = jax.lax.psum(tele["edges_real"], axes)
             if zero_specs is not None:
                 # ZeRO: updates live sharded along zero_axis — psum the
-                # squared slice norms for the global update norm
-                # (grad/param norms are already replicated: pmean'd grads,
-                # all-gathered params)
-                tele["update_norm"] = jnp.sqrt(jax.lax.psum(
-                    jnp.square(tree_l2_norm(updates)), zero_axis))
+                # squared SLICE norms for the global norm.  Scalar leaves
+                # (PReLU's alpha) pass through shard_tree replicated, so
+                # they are summed OUTSIDE the psum (a psum would count
+                # them N times and make the metric stage-dependent);
+                # padded rows are zero and don't perturb anything.
+                # (grad/param norms at stage 1 are already replicated:
+                # pmean'd grads, all-gathered params)
+                def _zero_norm(tree):
+                    zero = jnp.asarray(0.0, jnp.float32)
+                    sq_sl = sq_sc = zero
+                    for x in jax.tree_util.tree_leaves(tree):
+                        s = jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        if jnp.ndim(x) >= 1:
+                            sq_sl = sq_sl + s
+                        else:
+                            sq_sc = sq_sc + s
+                    return jnp.sqrt(
+                        jax.lax.psum(sq_sl, zero_axis) + sq_sc)
+
+                tele["update_norm"] = _zero_norm(updates)
+                if zero_stage2:
+                    # stage 2: new_params are slices too
+                    tele["param_norm"] = _zero_norm(new_params)
             metrics.update(tele)
         if nonfinite_guard:
             from hydragnn_tpu.resilience.guards import (
@@ -392,8 +441,10 @@ def make_dp_train_step(
         return new_state, metrics
 
     opt_spec_tree = P() if zero_specs is None else zero_specs
+    param_spec_tree = zero_sh.param_specs if zero_stage2 else P()
     state_specs = TrainState(
-        step=P(), params=P(), batch_stats=P(), opt_state=opt_spec_tree)
+        step=P(), params=param_spec_tree, batch_stats=P(),
+        opt_state=opt_spec_tree)
     sharded = _shard_map(
         per_device,
         mesh=mesh,
@@ -418,15 +469,29 @@ def make_dp_eval_step(
     cfg: ModelConfig,
     mesh: Mesh,
     axis=DATA_AXIS,
+    zero=None,
 ):
     """jit'd DP eval step over stacked batches [D, ...].  ``axis`` may be a
-    tuple of mesh axes (multi-slice meshes)."""
+    tuple of mesh axes (multi-slice meshes).
+
+    ``zero`` (a :class:`parallel.zero.ZeroSharding`) makes the in-specs
+    match a ZeRO-sharded train state — without it, jit would silently
+    re-replicate the sharded moments (and stage-2 param slices) on every
+    eval call, materializing exactly the N copies ZeRO removed.  Stage 2
+    all_gathers the param slices inside the step, like the train step."""
     axes = _dp_axes(axis)
+    zero_stage2 = zero is not None and zero.stage >= 2
 
     def per_device(state: TrainState, g: GraphBatch):
         g = jax.tree.map(lambda x: x[0], g)
+        params = state.params
+        if zero_stage2:
+            from hydragnn_tpu.parallel import zero as zero_mod
+
+            params = zero_mod.unshard_tree_dims(
+                state.params, zero.param_dims, zero.axis)
         loss, (per_head, _, outputs) = _loss_and_metrics(
-            model, cfg, state.params, state.batch_stats, g, False)
+            model, cfg, params, state.batch_stats, g, False)
         # weight by real graphs so empty wrap-padding shards don't dilute
         ng_local = g.n_real_graphs
         num_graphs = jax.lax.psum(ng_local, axes)
@@ -443,10 +508,18 @@ def make_dp_eval_step(
             "outputs": outputs,
         }
 
+    state_specs = P()
+    if zero is not None:
+        state_specs = TrainState(
+            step=P(),
+            params=zero.param_specs if zero_stage2 else P(),
+            batch_stats=P(),
+            opt_state=zero.opt_specs,
+        )
     sharded = _shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(axes)),
+        in_specs=(state_specs, P(axes)),
         out_specs={
             "loss": P(),
             "num_graphs": P(),

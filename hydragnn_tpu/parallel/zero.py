@@ -1,4 +1,4 @@
-"""ZeRO-1 optimizer-state sharding over the data mesh axis.
+"""ZeRO sharded training state over the data mesh axis.
 
 TPU-native analog of the reference's ``ZeroRedundancyOptimizer`` wrapping
 (reference hydragnn/utils/optimizer.py:43-103): optimizer state (Adam moments
@@ -10,21 +10,106 @@ Inside the shard_map train step each device updates only its slice (gradients
 are pmean-ed first, then sliced), and the updated parameter slices are
 re-assembled with an all_gather — the classic reduce/update/gather dance.
 
+Stages (``Training.zero_stage`` / HYDRAGNN_ZERO, see docs/SCALING.md):
+
+  0  replicated everywhere (plain DP);
+  1  optimizer state sharded at rest — each device updates its slice of
+     params/moments, new params all_gather-ed back to replicated;
+  2  stage 1 PLUS parameters sharded at rest: each step all_gathers the
+     param slices into the transient full tree the forward needs, and the
+     updated slices stay sharded — with ``donate_argnums`` on the state the
+     full gather is the only per-step peak, so resident params are ~1/N too
+     (DeepSpeed's stage 2 shards reduced gradients instead; gradients here
+     are transient values inside one jit, so sharding what is RESIDENT —
+     moments and params — is the TPU-native equivalent).
+
 Only elementwise optimizers partition exactly (all seven reference torch
-optimizers are); LAMB's per-tensor trust ratio would change under slicing, so
-``select_optimizer`` callers should avoid ZeRO+LAMB (same caveat as
-DeepSpeed).  Checkpoint consolidation (reference utils/model.py:61-62 calls
-``consolidate_state_dict`` before save) = :func:`consolidate_opt_state`.
+optimizers are); LAMB's per-tensor trust ratio would change under slicing,
+so ``select_optimizer`` raises for ZeRO+LAMB and the trainer's env path
+warns-and-disables (same caveat as DeepSpeed).  Checkpoint consolidation
+(reference utils/model.py:61-62 calls ``consolidate_state_dict`` before
+save) = :func:`consolidate_opt_state` / :func:`consolidate_state`.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import os
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ZERO_STAGES = (0, 1, 2)
+# per-tensor (non-elementwise) optimizers whose math changes under slicing
+NON_ELEMENTWISE_OPTIMIZERS = ("LAMB", "FusedLAMB")
+
+
+def check_zero_stage(stage: Any) -> int:
+    """Validate a ``zero_stage`` knob value; returns the int stage.
+    Non-integral values (1.5) are rejected, not truncated."""
+    try:
+        s = int(stage)
+        if float(stage) != s:
+            raise ValueError
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"zero_stage must be one of {ZERO_STAGES}, got {stage!r}")
+    if s not in ZERO_STAGES:
+        raise ValueError(
+            f"zero_stage must be one of {ZERO_STAGES}, got {stage!r}")
+    return s
+
+
+def zero_stage_from_training(training: Optional[dict] = None,
+                             opt_spec: Any = None, *,
+                             env: bool = True) -> int:
+    """Resolve the requested ZeRO stage: ``Training.zero_stage`` overlaid by
+    the HYDRAGNN_ZERO env knob (env wins, same layering as the resilience
+    and telemetry knobs), with the legacy ``Optimizer.use_zero_redundancy``
+    flag (reference optimizer.py:43-103 parity knob) lifting the floor to
+    stage 1.  Validates on every path.
+
+    ``env=False`` resolves the CONFIG-DECLARED stage only — the one
+    select_optimizer should refuse LAMB for (a declared combination is an
+    error; an env-forced ZeRO over a LAMB config must instead reach the
+    trainer's warn-and-disable fallback, not kill the job at startup)."""
+    t = dict(training or {})
+    stage = check_zero_stage(t.get("zero_stage", 0))
+    opt_cfg = t.get("Optimizer") or {}
+    if bool(opt_cfg.get("use_zero_redundancy")) or bool(
+            getattr(opt_spec, "use_zero_redundancy", False)):
+        stage = max(stage, 1)
+    # set-but-EMPTY falls through to the config stage (the repo's env-knob
+    # convention, utils/env.py) — only a non-empty value overrides, and
+    # HYDRAGNN_ZERO=0 explicitly forces replicated
+    env_val = os.environ.get("HYDRAGNN_ZERO") if env else None
+    if env_val:
+        stage = check_zero_stage(env_val)
+    return stage
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroSharding:
+    """Everything the mesh train/eval steps and checkpoint consolidation
+    need to know about an active ZeRO partition (built by
+    :func:`zero_shard_state`).
+
+    ``opt_specs``/``param_specs`` are PartitionSpec trees for shard_map
+    in/out specs; ``opt_dims``/``param_dims`` hold each leaf's ORIGINAL
+    leading dim (None for scalars) so gathers can strip the padding.
+    ``param_specs``/``param_dims`` are None below stage 2 (params
+    replicated)."""
+
+    stage: int
+    axis: str
+    n: int
+    opt_specs: Any
+    opt_dims: Any
+    param_specs: Any = None
+    param_dims: Any = None
 
 
 def _padded_dim(d0: int, n: int) -> int:
@@ -65,31 +150,75 @@ def shard_opt_state(opt_state, mesh: Mesh, axis: str):
 
 
 def shard_state_for_zero(state, mesh: Mesh, axis: Optional[str] = None):
-    """Replicate a TrainState EXCEPT its optimizer state, which is sharded
-    along ``axis`` (default: the mesh's innermost axis — "data" on a 1-axis
-    DP mesh, "ici" on a multi-slice mesh so the ZeRO all_gather stays off
-    DCN).  Returns (state, zero_specs, zero_dims) ready for
-    ``make_dp_train_step(..., zero_specs=zero_specs)``.
+    """Legacy stage-1 entry point: returns the raw
+    ``(state, zero_specs, zero_dims)`` triple.  New code should use
+    :func:`zero_shard_state`, which returns a :class:`ZeroSharding` and
+    supports stage 2."""
+    state, zs = zero_shard_state(state, mesh, axis=axis, stage=1)
+    return state, zs.opt_specs, zs.opt_dims
 
-    The order matters: the opt state must be pulled to host and sharded
-    BEFORE the rest of the state is replicated (replicating the full state
-    first would materialize the duplicate moments ZeRO exists to avoid).
-    """
+
+def zero_shard_state(state, mesh: Mesh, axis: Optional[str] = None,
+                     stage: int = 1):
+    """Place a TrainState under the requested ZeRO stage.
+
+    Optimizer state (stage >= 1) — and parameters too at stage 2 — is
+    sharded along ``axis`` (default: the mesh's innermost axis — "data" on
+    a 1-axis DP mesh, "ici" on a multi-slice mesh so the per-step ZeRO
+    all_gather stays off DCN); everything else is replicated.  Returns
+    ``(state, ZeroSharding)`` ready for
+    ``make_dp_train_step(..., zero_specs=sharding)``.
+
+    The order matters: the sharded components must be pulled to host and
+    placed BEFORE the rest of the state is replicated (replicating the full
+    state first would materialize the duplicate copies ZeRO exists to
+    avoid)."""
     from hydragnn_tpu.parallel.mesh import replicate_state
 
+    stage = check_zero_stage(stage)
+    if stage < 1:
+        raise ValueError("zero_shard_state needs stage 1 or 2")
     if axis is None:
         axis = tuple(mesh.axis_names)[-1]
-    opt_sharded, zero_specs, zero_dims = shard_opt_state(
+    opt_sharded, opt_specs, opt_dims = shard_opt_state(
         jax.device_get(state.opt_state), mesh, axis)
-    state = replicate_state(state.replace(opt_state=()), mesh)
-    return state.replace(opt_state=opt_sharded), zero_specs, zero_dims
+    param_sharded = param_specs = param_dims = None
+    if stage >= 2:
+        param_sharded, param_specs, param_dims = shard_opt_state(
+            jax.device_get(state.params), mesh, axis)
+    state = replicate_state(
+        state.replace(opt_state=(),
+                      params=() if stage >= 2 else state.params), mesh)
+    state = state.replace(opt_state=opt_sharded)
+    if stage >= 2:
+        state = state.replace(params=param_sharded)
+    return state, ZeroSharding(
+        stage=stage, axis=axis, n=int(mesh.shape[axis]),
+        opt_specs=opt_specs, opt_dims=opt_dims,
+        param_specs=param_specs, param_dims=param_dims)
+
+
+# per-mesh cached replicating gather: the jit MUST stay (device_put can't
+# reshard non-fully-addressable arrays on multi-host meshes — the gather is
+# a collective every process enters), but a fresh wrapper per call would
+# re-trace every leaf on EVERY save, and saves run on the preemption path
+# inside the SIGTERM grace window.  One cached callable per mesh keeps
+# repeated saves on jit's trace cache.
+_GATHERS: dict = {}
+
+
+def _replicate_gather(mesh: Mesh):
+    fn = _GATHERS.get(mesh)
+    if fn is None:
+        repl = NamedSharding(mesh, P())
+        fn = _GATHERS[mesh] = jax.jit(lambda t: t, out_shardings=repl)
+    return fn
 
 
 def consolidate_opt_state(sharded_opt_state, orig_dims, mesh: Mesh):
     """Gather + unpad a ZeRO-sharded optimizer state back to full shapes
     (the reference's consolidate_state_dict before checkpoint save)."""
-    repl = NamedSharding(mesh, P())
-    gather = jax.jit(lambda t: t, out_shardings=repl)
+    gather = _replicate_gather(mesh)
 
     def unpad(x, d0):
         x = gather(x)
@@ -131,3 +260,117 @@ def unshard_tree(tree_shard, template, axis: str):
         return full[: t.shape[0]]
 
     return jax.tree.map(ug, tree_shard, template)
+
+
+def unshard_tree_dims(tree_shard, dims, axis: str):
+    """all_gather each sharded leaf back to its original leading dim, given
+    the ``*_dims`` tree a :class:`ZeroSharding` carries (None = scalar,
+    replicated) instead of a full-shape template — the stage-2 param gather,
+    where no full-shape tree exists inside the step.  Runs inside
+    shard_map."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree_shard)
+    dim_leaves = treedef.flatten_up_to(dims)
+
+    def ug(xs, d0):
+        if d0 is None:
+            return xs
+        full = jax.lax.all_gather(xs, axis, axis=0, tiled=True)
+        return full[:d0]
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [ug(x, d) for x, d in zip(leaves, dim_leaves)])
+
+
+def consolidate_state(state, zs: ZeroSharding, mesh: Mesh):
+    """Gather a ZeRO-sharded TrainState back to fully-replicated, unpadded
+    form — the one transform every serialization path (best-model pickle,
+    orbax periodic checkpoint, resume bundle) runs before saving, so
+    checkpoints are stage-agnostic and a resumed run may re-shard under any
+    stage (numerics are exact for elementwise optimizers)."""
+    state = state.replace(
+        opt_state=consolidate_opt_state(state.opt_state, zs.opt_dims, mesh))
+    if zs.stage >= 2 and zs.param_dims is not None:
+        state = state.replace(
+            params=consolidate_opt_state(state.params, zs.param_dims, mesh))
+    return state
+
+
+# ---------------------------------------------------------------------------
+# resident-byte accounting (telemetry `sharding` block, bench --zero)
+# ---------------------------------------------------------------------------
+
+
+def _tree_device_bytes(tree, dims, n: int):
+    """(per_device, replicated_equivalent, padded_waste_per_device) bytes of
+    a tree sharded per ``dims`` (None = replicated leaf) over ``n`` shards —
+    analytic, from shapes alone.  ``dims=None`` means the whole tree is
+    replicated."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if dims is None:
+        dim_leaves = [None] * len(leaves)
+    else:
+        dim_leaves = treedef.flatten_up_to(dims)
+    per_dev = repl = pads_total = 0
+    for x, d0 in zip(leaves, dim_leaves):
+        shape = tuple(np.shape(x))
+        itemsize = np.dtype(
+            getattr(x, "dtype", np.asarray(x).dtype)).itemsize
+        full = int(np.prod(shape, dtype=np.int64)) * itemsize
+        if d0 is None or not shape:
+            per_dev += full
+            repl += full
+            continue
+        # the placed leaf's leading dim is already padded to a multiple of n
+        rest = int(np.prod(shape[1:], dtype=np.int64)) * itemsize
+        pd = _padded_dim(int(d0), n)
+        per_dev += (pd // n) * rest
+        repl += int(d0) * rest
+        pads_total += (pd - int(d0)) * rest
+    # ceil so per_device <= replicated/n + waste holds as an exact bound
+    waste = -(-pads_total // n)
+    return per_dev, repl, waste
+
+
+def measured_device_bytes(tree, device=None) -> int:
+    """MEASURED resident bytes of one device's shards of a placed pytree
+    (first device of each leaf's sharding by default) — the number the
+    analytic :func:`sharding_report` is checked against in tests and
+    bench --zero."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            total += int(getattr(leaf, "nbytes", np.asarray(leaf).nbytes))
+            continue
+        dev = device if device is not None else shards[0].device
+        for s in shards:
+            if s.device == dev:
+                total += int(s.data.nbytes)
+                break
+        else:  # device holds no shard of this leaf (non-addressable)
+            total += int(shards[0].data.nbytes)
+    return total
+
+
+def sharding_report(state, zs: Optional[ZeroSharding]) -> dict:
+    """Per-device resident param/opt-state bytes under the active sharding,
+    next to their fully-replicated equivalents — the telemetry ``sharding``
+    block, so the ~1/N saving is a measured number, not a claim.
+    ``zs=None`` reports the replicated (stage-0) layout."""
+    n = zs.n if zs is not None else 1
+    stage = zs.stage if zs is not None else 0
+    p_dev, p_repl, p_waste = _tree_device_bytes(
+        state.params,
+        zs.param_dims if (zs is not None and zs.stage >= 2) else None, n)
+    o_dev, o_repl, o_waste = _tree_device_bytes(
+        state.opt_state, zs.opt_dims if zs is not None else None, n)
+    return {
+        "zero_stage": stage,
+        "axis": zs.axis if zs is not None else None,
+        "axis_size": n,
+        "param_bytes_per_device": int(p_dev),
+        "param_bytes_replicated": int(p_repl),
+        "opt_bytes_per_device": int(o_dev),
+        "opt_bytes_replicated": int(o_repl),
+        "padded_waste_bytes_per_device": int(p_waste + o_waste),
+    }

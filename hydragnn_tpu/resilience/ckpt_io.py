@@ -54,10 +54,13 @@ def with_retries(
     outcome — any rank's failure makes EVERY rank report failure (and
     degrade identically); no per-rank retry.
     """
+    from hydragnn_tpu.utils.checkpoint import CheckpointDeclinedError
+
     retries = max(0, int(retries))
     if cross_rank:
         retries = 0
     last: Optional[BaseException] = None
+    permanent = False
     for attempt in range(retries + 1):
         failed = False
         try:
@@ -67,6 +70,11 @@ def with_retries(
         except Exception as e:  # noqa: BLE001 — any I/O failure is retryable
             last = e
             failed = True
+            # a DECLINED save (stale higher-step checkpoints) is permanent,
+            # not an I/O flake: fall through to the on_fail ladder after
+            # this attempt instead of burning backoff sleeps inside a
+            # preemption grace window
+            permanent = isinstance(e, CheckpointDeclinedError)
             if telemetry is not None:
                 telemetry.health("ckpt_retry", what=what,
                                  attempt=attempt + 1, error=str(e)[:200])
@@ -83,11 +91,13 @@ def with_retries(
                 failed = True
         if not failed:
             return True
+        if permanent:
+            break
         if attempt < retries and backoff > 0:
             time.sleep(min(backoff * (2 ** attempt), 30.0))
     if on_fail == "warn":
         warnings.warn(
-            f"{what} failed after {retries + 1} attempt(s) — continuing "
+            f"{what} failed after {attempt + 1} attempt(s) — continuing "
             f"WITHOUT it: {last!r}", stacklevel=2)
         if telemetry is not None:
             telemetry.health("ckpt_giveup", what=what,

@@ -19,7 +19,19 @@ finalized FIRST (with retry/backoff through ckpt_io), the meta json is
 atomically replaced LAST, and load verifies meta.saved_step against the
 orbax latest step — a bundle interrupted mid-save is detected and
 ignored (the caller falls back to the epoch-granular checkpoints) rather
-than half-restored.  RNG state needs no extra capture: dropout folds the
+than half-restored.
+
+ZeRO contract (docs/SCALING.md §4): the trainer CONSOLIDATES a sharded
+train state (all_gather + unpad, ``parallel/zero.py:consolidate_state``)
+before handing it here, so bundles are always full/replicated and
+stage-agnostic — the load side restores into an ordinary skeleton and the
+trainer re-shards under whatever ``zero_stage`` the resumed run was
+launched with.  Elementwise optimizers partition exactly, so the
+consolidate/re-shard round trip preserves the bit-parity guarantee
+(proven by ``tools/crashtest.py --zero`` and
+``tests/test_zero.py::test_trainer_zero1_parity_and_resume_bit_exact``);
+``meta.pipeline.zero_stage`` records the saver's stage for provenance,
+not as a resume constraint.  RNG state needs no extra capture: dropout folds the
 step counter (saved in state) and the per-epoch shuffle folds
 ``seed + epoch`` (saved in meta), so replaying ``set_epoch(epoch)`` and
 skipping the first ``items_consumed`` units reproduces the exact batch

@@ -101,8 +101,17 @@ def _run_training_dict(config: dict, logs_dir: str, seed: int):
     cfg = ModelConfig.from_config(config["NeuralNetwork"])
     model = create_model(cfg)
 
+    # the CONFIG-DECLARED ZeRO stage (env=False: no HYDRAGNN_ZERO overlay)
+    # is resolved HERE so select_optimizer can refuse non-elementwise
+    # optimizers at config time; an env-FORCED stage instead reaches the
+    # trainer's warn-and-disable fallback (docs/SCALING.md LAMB caveat) —
+    # a fleet-wide HYDRAGNN_ZERO=1 must not kill existing LAMB configs
+    from hydragnn_tpu.parallel.zero import zero_stage_from_training
+
     opt_spec = select_optimizer(
-        config["NeuralNetwork"]["Training"]["Optimizer"])
+        config["NeuralNetwork"]["Training"]["Optimizer"],
+        zero_stage=zero_stage_from_training(
+            config["NeuralNetwork"]["Training"], env=False))
 
     example = next(iter(train_loader))
     state = create_train_state(model, example, opt_spec, seed=seed)

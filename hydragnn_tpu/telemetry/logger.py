@@ -218,6 +218,9 @@ class MetricsLogger:
         # monotonic counter — they interleave with training steps in
         # shared logs and must not perturb the trainer's step axis
         self._serve_steps = 0
+        # parameter/opt-state sharding layout (log_sharding) — folded into
+        # the end-of-run manifest
+        self._sharding: Optional[Dict[str, Any]] = None
         if self.enabled and self.rank == 0:
             self.sinks = build_sinks(
                 self.cfg.sinks, self.out_dir, self.run_id,
@@ -385,6 +388,26 @@ class MetricsLogger:
                             if isinstance(v, (int, float))
                             and not isinstance(v, bool)})
             self._emit(rec)
+
+    # -- sharding block (ZeRO, docs/SCALING.md §4) ---------------------------
+
+    def log_sharding(self, info: Dict[str, Any]) -> None:
+        """Record the run's parameter/optimizer-state sharding layout
+        (zero_stage requested + effective, axis size, per-device resident
+        bytes, padded-slice waste, fallback reason).  Stored ALWAYS — the
+        end-of-run manifest carries it even for sink-less ranks — and
+        emitted as a ``sharding`` event when the subsystem is on, so
+        tools/teleview.py can warn when ZeRO was requested but the run
+        fell back to replicated."""
+        self._sharding = dict(info)
+        if self.enabled:
+            self._emit({
+                "event": "sharding",
+                "run_id": self.run_id,
+                "rank": self.rank,
+                "t": time.time(),
+                **self._sharding,
+            })
 
     def resume_counts(self, global_step: int) -> None:
         """Continue the step/dispatch numbering of a preempted run so the
@@ -588,6 +611,8 @@ class MetricsLogger:
                 rec["timers"] = timers
             if self._health_counts:
                 rec["health"] = dict(self._health_counts)
+            if self._sharding is not None:
+                rec["sharding"] = dict(self._sharding)
             # fused-vs-fallback dispatch tally (this run's delta over the
             # process-cumulative trace-time counts): a run that silently
             # fell off the fast path shows ``<op>:scatter`` entries here

@@ -24,6 +24,10 @@ class OptimizerSpec:
     tx: optax.GradientTransformation
     learning_rate: float
     use_zero_redundancy: bool = False
+    # config name of the optimizer ("" for hand-built specs) — the ZeRO
+    # layer needs it to refuse non-elementwise optimizers, whose per-tensor
+    # statistics (LAMB's trust ratio) would silently change under slicing
+    name: str = ""
 
 
 _FACTORIES = {
@@ -45,16 +49,36 @@ _FACTORIES = {
 }
 
 
-def select_optimizer(opt_config: Dict[str, Any]) -> OptimizerSpec:
-    """Build from the Training.Optimizer config section."""
+def select_optimizer(opt_config: Dict[str, Any],
+                     zero_stage: int = 0) -> OptimizerSpec:
+    """Build from the Training.Optimizer config section.
+
+    ``zero_stage`` is the run's CONFIG-DECLARED ZeRO stage
+    (``zero_stage_from_training(training, env=False)`` — no HYDRAGNN_ZERO
+    overlay): combining it — or the legacy ``use_zero_redundancy`` flag —
+    with a non-elementwise optimizer raises here, at config time, instead
+    of silently training with a trust ratio computed per SLICE rather
+    than per tensor.  An env-FORCED stage over a LAMB config instead hits
+    the trainer's warn-and-disable fallback (docs/SCALING.md)."""
+    from hydragnn_tpu.parallel.zero import NON_ELEMENTWISE_OPTIMIZERS
+
     opt_type = opt_config.get("type", "AdamW")
     lr = float(opt_config.get("learning_rate", 1e-3))
     if opt_type not in _FACTORIES:
         raise NameError(f"The string {opt_type} does not name a valid optimizer")
+    use_zero = bool(opt_config.get("use_zero_redundancy", False))
+    if (use_zero or int(zero_stage) > 0) \
+            and opt_type in NON_ELEMENTWISE_OPTIMIZERS:
+        raise ValueError(
+            f"ZeRO sharding is incompatible with {opt_type}: its per-tensor "
+            "trust ratio changes under slice partitioning (see "
+            "parallel/zero.py).  Use an elementwise optimizer (Adam/AdamW/"
+            "SGD/...) or set zero_stage=0 / use_zero_redundancy=false.")
     return OptimizerSpec(
         tx=_FACTORIES[opt_type](lr),
         learning_rate=lr,
-        use_zero_redundancy=bool(opt_config.get("use_zero_redundancy", False)),
+        use_zero_redundancy=use_zero,
+        name=str(opt_type),
     )
 
 

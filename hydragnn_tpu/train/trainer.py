@@ -691,6 +691,30 @@ def train_validate_test(
 
     res_cfg = ResilienceConfig.from_training(training)
     chaos = Chaos.from_env(training.get("Chaos"))
+    # ZeRO sharding request (Training.zero_stage + HYDRAGNN_ZERO env, plus
+    # the legacy Optimizer.use_zero_redundancy flag) — resolved before the
+    # step builders because the partition is a trace-time choice
+    from hydragnn_tpu.parallel.zero import (
+        NON_ELEMENTWISE_OPTIMIZERS,
+        zero_stage_from_training,
+    )
+
+    zero_requested = zero_stage_from_training(training, opt_spec)
+    zero_stage = zero_requested
+    zero_fallback = None
+    if zero_requested and getattr(opt_spec, "name", "") \
+            in NON_ELEMENTWISE_OPTIMIZERS:
+        # env-forced ZeRO on a LAMB run: warn-and-disable rather than
+        # changing numerics (config-declared combinations already raised in
+        # select_optimizer)
+        import warnings
+
+        warnings.warn(
+            f"ZeRO stage {zero_requested} requested but optimizer "
+            f"{opt_spec.name} is not elementwise — training REPLICATED "
+            "(per-tensor trust ratios would change under slicing)",
+            stacklevel=2)
+        zero_stage, zero_fallback = 0, "non_elementwise_optimizer"
     # an explicit (ensemble-branch) mesh means other branches run disjoint
     # programs concurrently — global host collectives (telemetry cross-rank
     # reduction) would interleave with theirs and deadlock; remember before
@@ -767,15 +791,25 @@ def train_validate_test(
         from hydragnn_tpu.parallel.mesh import mesh_dp_axes
 
         dp_axes = mesh_dp_axes(mesh)
-        zero_specs = zero_dims = None
-        if opt_spec.use_zero_redundancy:
-            # ZeRO-1: optimizer state lives sharded along the innermost mesh
-            # axis (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
-            from hydragnn_tpu.parallel.zero import shard_state_for_zero
+        zero_sh = None
+        if zero_stage > 0:
+            # ZeRO: optimizer state (stage 1) — and params (stage 2) — live
+            # sharded along the innermost mesh axis for the whole run
+            # (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
+            from hydragnn_tpu.parallel.zero import zero_shard_state
 
-            state, zero_specs, zero_dims = shard_state_for_zero(state, mesh)
+            state, zero_sh = zero_shard_state(state, mesh, stage=zero_stage)
         else:
             state = replicate_state(state, mesh)
+        # per-device resident bytes under the chosen layout — the manifest
+        # `sharding` block, so the ~1/N saving is a measured number
+        from hydragnn_tpu.parallel.zero import sharding_report
+
+        telemetry.log_sharding({
+            "zero_stage_requested": zero_requested,
+            **({"fallback": zero_fallback} if zero_fallback else {}),
+            **sharding_report(state, zero_sh),
+        })
         single_proc = mesh_process_count(mesh) == 1
         # scan chunking works on the multi-host path too: every process
         # assembles [K, d_local, ...] superbatches that GlobalBatchLoader
@@ -786,10 +820,11 @@ def train_validate_test(
         steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
         train_step = make_dp_train_step(
             model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
-            zero_specs=zero_specs, steps=steps_per_dispatch,
+            zero_specs=zero_sh, steps=steps_per_dispatch,
             telemetry_metrics=telemetry.enabled,
             nonfinite_guard=res_cfg.nonfinite_guard)
-        eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes)
+        eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes,
+                                      zero=zero_sh)
         _align_bucket_group(
             train_loader, n_local_devices * steps_per_dispatch)
         train_loader = DeviceStackLoader(
@@ -838,6 +873,26 @@ def train_validate_test(
                 test_loader = ResidentDeviceLoader(
                     test_loader, sharding=eval_shard)
     else:
+        zero_sh = None
+        if zero_stage > 0:
+            # ZeRO needs the mesh path (there is no axis to shard along on
+            # the local-jit path) — warn-and-fall-back, and record the
+            # fallback so teleview can surface it loudly
+            import warnings
+
+            warnings.warn(
+                f"ZeRO stage {zero_stage} requested but this run takes the "
+                "single-device local-jit path — training REPLICATED "
+                "(sharding needs the mesh path: >1 local device, "
+                "multi-process, or use_mesh_dp=True)", stacklevel=2)
+            zero_fallback = zero_fallback or "local_path"
+            zero_stage = 0
+        if zero_requested:
+            telemetry.log_sharding({
+                "zero_stage_requested": zero_requested,
+                "fallback": zero_fallback,
+                "zero_stage": 0, "axis": None, "axis_size": 1,
+            })
         steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
         if steps_per_dispatch > 1:
             # amortize per-step Python dispatch + arg-ingest latency by
@@ -887,11 +942,10 @@ def train_validate_test(
     # mesh — a collective EVERY process participates in) before any
     # serialization; one definition serves the pickle and orbax paths.
     consolidate = lambda s: s  # noqa: E731
-    if use_mesh_dp and zero_dims is not None:
-        from hydragnn_tpu.parallel.zero import consolidate_opt_state
+    if use_mesh_dp and zero_sh is not None:
+        from hydragnn_tpu.parallel.zero import consolidate_state
 
-        consolidate = lambda s: s.replace(  # noqa: E731
-            opt_state=consolidate_opt_state(s.opt_state, zero_dims, mesh))
+        consolidate = lambda s: consolidate_state(s, zero_sh, mesh)  # noqa: E731
 
     checkpointer = None
     if training.get("Checkpoint"):
@@ -948,6 +1002,7 @@ def train_validate_test(
         # disagree near the residency budget boundary)
         "pipeline": {"steps_per_dispatch": steps_per_dispatch,
                      "resident": bool(resident_on),
+                     "zero_stage": zero_stage,
                      "auto_selected":
                          "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
@@ -1008,6 +1063,10 @@ def train_validate_test(
             "pipeline": {"steps_per_dispatch": steps_per_dispatch,
                          "resident": bool(resident_on),
                          "use_mesh_dp": bool(use_mesh_dp),
+                         # the bundle's state is CONSOLIDATED (stage-
+                         # agnostic); recorded for provenance only — a
+                         # resume may re-shard under any stage exactly
+                         "zero_stage": zero_stage,
                          "n_local_devices": n_local_devices},
             "world_size": world_size,
         }
@@ -1203,11 +1262,10 @@ def train_validate_test(
         timer = tr.get("timer")
         telemetry.finalize(
             history, timers=timer.summary() if timer is not None else None)
-    if use_mesh_dp and zero_dims is not None:
-        from hydragnn_tpu.parallel.zero import consolidate_opt_state
-
-        state = state.replace(
-            opt_state=consolidate_opt_state(state.opt_state, zero_dims, mesh))
+    if use_mesh_dp and zero_sh is not None:
+        # hand back a fully-replicated, unpadded state: callers (final
+        # save_state, run_prediction, tests) are stage-agnostic
+        state = consolidate(state)
     return state, history
 
 

@@ -24,6 +24,13 @@ import jax
 _MANAGERS: Dict[str, Any] = {}
 
 
+class CheckpointDeclinedError(RuntimeError):
+    """Orbax refused the save (step <= the directory's latest) — a
+    PERMANENT condition, not an I/O flake: resilience/ckpt_io.with_retries
+    fails fast on it instead of burning retry/backoff time (which on the
+    preemption path runs inside the SIGTERM grace window)."""
+
+
 def _manager(directory: str, max_to_keep: int = 3):
     """Cached per-directory CheckpointManager (created on first use)."""
     import orbax.checkpoint as ocp
@@ -78,15 +85,27 @@ def save_checkpoint(state, directory: str, step: Optional[int] = None,
     finalized would turn a failed re-save into data loss — callers that
     can legitimately hit the same step twice (the resume bundle) skip the
     redundant save instead (resilience/resume.py).
+
+    Orbax silently DECLINES (returns False, no exception) a save at a
+    step <= the directory's latest — e.g. a stale checkpoint tree from an
+    earlier run with a different steps-per-epoch numbering.  That is
+    raised here as an error: callers' retry/degradation ladders
+    (resilience/ckpt_io.with_retries) must see "nothing was saved", not
+    report success and leave the old state as the latest checkpoint.
     """
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory, max_to_keep)
     step = int(state.step) if step is None else int(step)
     _reload(mgr)
-    mgr.save(step, args=ocp.args.StandardSave(
+    saved = mgr.save(step, args=ocp.args.StandardSave(
         {"state": jax.device_get(state)}))
     mgr.wait_until_finished()
+    if not saved:
+        raise CheckpointDeclinedError(
+            f"orbax declined to save step {step} in {directory} "
+            f"(latest={mgr.latest_step()}) — stale higher-step checkpoints "
+            "present?")
 
 
 def restore_checkpoint(state, directory: str,

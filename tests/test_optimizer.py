@@ -23,6 +23,13 @@ def test_optimizers(opt_type, use_zero):
     config["NeuralNetwork"]["Training"]["Optimizer"]["type"] = opt_type
     config["NeuralNetwork"]["Training"]["Optimizer"]["use_zero_redundancy"] = use_zero
     _generate_data(config, num_samples_tot=60)
+    if use_zero and opt_type == "FusedLAMB":
+        # ZeRO + a per-tensor optimizer is REFUSED at config time: LAMB's
+        # trust ratio would silently change under slice partitioning
+        # (parallel/zero.py, docs/SCALING.md §4)
+        with pytest.raises(ValueError, match="elementwise"):
+            hydragnn_tpu.run_training(config)
+        return
     hydragnn_tpu.run_training(config)
 
 

@@ -12,6 +12,12 @@ Usage:
         [--chaos-step K]       deterministic injected preemption at train
                                dispatch K instead of a wall-clock SIGTERM
         [--mesh]               run the mesh-DP path (local devices)
+        [--zero N]             ZeRO stage (1 or 2; implies --mesh): the
+                               victim's optimizer state (and stage-2
+                               params) train SHARDED, the resume bundle is
+                               consolidated on save and re-sharded on load
+                               — proving the PR-3 bit-parity guarantee
+                               survives the shard/consolidate round trip
 
 Exit code 0 and "PARITY PASS" when the resumed run's params are identical
 to the uninterrupted run's; non-zero otherwise.  Runs anywhere (CPU ok);
@@ -120,9 +126,12 @@ def run_child(args) -> int:
 
         train_l = SlowLoader(train_l, args.epoch_sleep)
 
+    training = {"num_epoch": args.epochs}
+    if args.zero:
+        training["zero_stage"] = args.zero
     state, history = train_validate_test(
         model, cfg, state, opt, train_l, val_l, test_l,
-        {"Training": {"num_epoch": args.epochs},
+        {"Training": training,
          "Variables_of_interest": {"output_names": ["e"]}},
         log_name=log_name, verbosity=1, logs_dir=logs_dir,
         use_mesh_dp=args.mesh, resume_meta=resume_meta)
@@ -146,6 +155,14 @@ def run_child(args) -> int:
 def _spawn(args, mode, extra_env=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                **(extra_env or {}))
+    if args.mesh or args.zero:
+        # the mesh/ZeRO paths need >1 device to mean anything: force a
+        # virtual 4-device CPU mesh unless the caller (e.g. pytest's
+        # conftest, 8 devices) already forced a count
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4").strip()
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--mode", mode, "--workdir", args.workdir,
            "--epochs", str(args.epochs),
@@ -153,6 +170,8 @@ def _spawn(args, mode, extra_env=None):
            "--epoch-sleep", str(args.epoch_sleep)]
     if args.mesh:
         cmd.append("--mesh")
+    if args.zero:
+        cmd += ["--zero", str(args.zero)]
     return subprocess.Popen(cmd, cwd=REPO, env=env,
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
@@ -166,6 +185,20 @@ def _drain(proc, prefix):
 
 def run_parent(args) -> int:
     os.makedirs(args.workdir, exist_ok=True)
+    # the workdir is reused across invocations (and across --mesh/--zero
+    # flag combinations that change the steps-per-epoch numbering): stale
+    # orbax checkpoints at a HIGHER step make the victim's bundle save a
+    # silent no-op (orbax declines steps <= latest), so every run starts
+    # from a clean scratch tree
+    import shutil
+
+    for stale in ("logs", "baseline_final.pk", "victim_final.pk",
+                  "resume_final.pk"):
+        path = os.path.join(args.workdir, stale)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
     print(f"crashtest: workdir {args.workdir}")
 
     print("crashtest: phase 1/3 — uninterrupted baseline")
@@ -226,13 +259,29 @@ def run_parent(args) -> int:
     lr_ = jax.tree_util.tree_leaves(res["params"])
     mismatch = [i for i, (a, b) in enumerate(zip(lb, lr_))
                 if not np.array_equal(np.asarray(a), np.asarray(b))]
+    # under --zero the dumped states are CONSOLIDATED — comparing the
+    # optimizer moments too proves the consolidate/re-shard round trip
+    # preserved them bit-for-bit, not just the params
+    ob = jax.tree_util.tree_leaves(base["opt_state"])
+    or_ = jax.tree_util.tree_leaves(res["opt_state"])
+    opt_mismatch = [i for i, (a, b) in enumerate(zip(ob, or_))
+                    if not np.array_equal(np.asarray(a), np.asarray(b))]
     steps = (int(base["step"]), int(res["step"]))
-    if not mismatch and steps[0] == steps[1]:
-        print(f"crashtest: PARITY PASS — {len(lb)} param leaves identical, "
-              f"step {steps[0]} == {steps[1]}")
+    tag = f" (zero_stage={args.zero})" if args.zero else ""
+    # zip truncates: unequal leaf COUNTS (a consolidate/re-shard that drops
+    # or fails to restore trailing leaves) must fail, not pass on the prefix
+    if len(lb) != len(lr_) or len(ob) != len(or_):
+        print(f"crashtest: PARITY FAIL{tag} — leaf count mismatch "
+              f"(params {len(lb)} vs {len(lr_)}, opt {len(ob)} vs "
+              f"{len(or_)})")
+        return 1
+    if not mismatch and not opt_mismatch and steps[0] == steps[1]:
+        print(f"crashtest: PARITY PASS{tag} — {len(lb)} param + {len(ob)} "
+              f"opt-state leaves identical, step {steps[0]} == {steps[1]}")
         return 0
-    print(f"crashtest: PARITY FAIL — {len(mismatch)}/{len(lb)} param "
-          f"leaves differ, steps {steps[0]} vs {steps[1]}")
+    print(f"crashtest: PARITY FAIL{tag} — {len(mismatch)}/{len(lb)} param "
+          f"and {len(opt_mismatch)}/{len(ob)} opt-state leaves differ, "
+          f"steps {steps[0]} vs {steps[1]}")
     return 1
 
 
@@ -250,10 +299,17 @@ def main(argv=None) -> int:
                          "of a real SIGTERM (fully deterministic)")
     ap.add_argument("--mesh", action="store_true",
                     help="exercise the mesh-DP path")
+    ap.add_argument("--zero", type=int, nargs="?", const=1, default=0,
+                    choices=(0, 1, 2),
+                    help="ZeRO stage for all three phases (implies --mesh): "
+                         "proves consolidate-on-save / re-shard-on-resume "
+                         "preserves mid-epoch bit parity")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--mode", choices=("baseline", "victim", "resume"),
                     default="baseline", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.zero:
+        args.mesh = True
     if args.child:
         return run_child(args)
     return run_parent(args)

@@ -256,6 +256,52 @@ def serving_section(health: List[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def _mb(v: Optional[float]) -> str:
+    # decimal MB: the same divisor bench.py --zero and docs/SCALING.md use,
+    # so cross-checking this section against BENCH_zero.json lines up
+    return "-" if v is None else f"{float(v) / 1e6:.2f} MB"
+
+
+def sharding_section(shardings: List[Dict[str, Any]],
+                     manifests: List[Dict[str, Any]]) -> str:
+    """ZeRO sharding layout (docs/SCALING.md §4): effective stage, axis
+    size, per-device resident param/opt bytes vs the replicated
+    equivalents, padded-slice waste — and a WARNING when ZeRO was
+    requested but the run fell back to replicated."""
+    s: Dict[str, Any] = {}
+    for m in manifests[-1:]:
+        s = dict(m.get("sharding") or {})
+    if not s and shardings:
+        s = dict(shardings[-1])
+    if not s:
+        return "  (no sharding record)"
+    stage = int(s.get("zero_stage", 0) or 0)
+    req = int(s.get("zero_stage_requested", stage) or 0)
+    lines = [f"  zero_stage={stage} (requested {req})  "
+             f"axis={s.get('axis')} x{s.get('axis_size', 1)}"]
+    pr, pd_ = s.get("param_bytes_replicated"), s.get("param_bytes_per_device")
+    orp, od = s.get("opt_bytes_replicated"), s.get("opt_bytes_per_device")
+    if od is not None:
+        def _ratio(dev, repl):
+            return (f" ({float(repl) / float(dev):.1f}x saving)"
+                    if dev and repl and repl > dev else "")
+
+        lines.append(
+            f"  params {_mb(pd_)}/device (replicated {_mb(pr)}"
+            f"{_ratio(pd_, pr)})  opt state {_mb(od)}/device "
+            f"(replicated {_mb(orp)}{_ratio(od, orp)})")
+        waste = s.get("padded_waste_bytes_per_device")
+        if waste:
+            lines.append(f"  padded-slice waste {_mb(waste)}/device")
+    if req > stage:
+        lines.append(
+            f"  WARNING ZeRO stage {req} was requested but the run fell "
+            f"back to replicated"
+            + (f" ({s['fallback']})" if s.get("fallback") else "")
+            + " — optimizer state is NOT sharded")
+    return "\n".join(lines)
+
+
 def epoch_rows(epochs: List[Dict[str, Any]]) -> str:
     rows = []
     for r in epochs:
@@ -293,6 +339,7 @@ def main(argv=None) -> int:
     epochs = [r for r in records if r.get("event") == "epoch"]
     manifests = [r for r in records if r.get("event") == "manifest"]
     health = [r for r in records if r.get("event") == "health"]
+    shardings = [r for r in records if r.get("event") == "sharding"]
 
     if args.json:
         sel = epochs if args.epochs else steps[-args.tail:] + epochs
@@ -311,6 +358,9 @@ def main(argv=None) -> int:
     if health or any(m.get("health") for m in manifests):
         print("\nhealth:")
         print(health_section(health, manifests))
+    if shardings or any(m.get("sharding") for m in manifests):
+        print("\nsharding:")
+        print(sharding_section(shardings, manifests))
     if any(r.get("kind") in _SERVING_KINDS for r in health) or any(
             k in _SERVING_KINDS for m in manifests
             for k in (m.get("health") or {})):
