@@ -44,7 +44,11 @@ from hydragnn_tpu.resilience.breaker import (  # noqa: F401
     BreakerOpenError,
     CircuitBreaker,
 )
-from hydragnn_tpu.resilience.chaos import Chaos, ServeChaos  # noqa: F401
+from hydragnn_tpu.resilience.chaos import (  # noqa: F401
+    Chaos,
+    FleetChaos,
+    ServeChaos,
+)
 from hydragnn_tpu.resilience.ckpt_io import (  # noqa: F401
     atomic_write_json,
     atomic_write_pickle,

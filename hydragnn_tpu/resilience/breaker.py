@@ -74,6 +74,14 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    @property
+    def degraded(self) -> bool:
+        """Is this breaker actually gating traffic right now?  True only
+        when it is ENABLED (threshold > 0) and not closed — the single
+        definition /healthz degradation (serve/server.py) and fleet
+        replica ejection (serve/fleet.py) share."""
+        return self.threshold > 0 and self.state != "closed"
+
     def time_to_retry(self) -> float:
         """Seconds until an open breaker will admit a probe (0 when not
         open)."""

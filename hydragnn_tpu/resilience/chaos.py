@@ -44,6 +44,30 @@ knobs (flush indices are 1-based over attempted predict flushes):
                                first n hot-reload candidate checkpoints
                                with NaN (reload validation must reject
                                and roll back)
+
+The FLEET layer (serve/fleet.py, serve/router.py) adds
+:class:`FleetChaos`, driving the failover tier-1 tests
+(tests/test_serve_fleet.py) and the ``tools/servebench.py --fleet``
+chaos-kill bench through ``HYDRAGNN_CHAOS_REPLICA_*`` knobs.  Indices
+are 1-based over SUPERVISOR PROBE TICKS (one per ``fleet_probe_s``);
+each comma part is ``<tick>`` / ``<tick>+`` with an optional
+``:<replica>`` pinning the target (default: round-robin over live
+replicas):
+
+  HYDRAGNN_CHAOS_REPLICA_KILL  "3" | "3:1" | "2,7" | "5+"  — hard-kill
+                               a replica at those probe ticks (SIGKILL
+                               for subprocess replicas; in-process
+                               replicas fail all in-flight work and go
+                               dead) — the supervisor must restart it
+                               and the router must retry elsewhere
+  HYDRAGNN_CHAOS_REPLICA_HANG  same spec — wedge a replica's predict
+                               path (SIGSTOP / a blocking predict body)
+                               so the watchdog + breaker must eject it
+  HYDRAGNN_CHAOS_REPLICA_FLAP  same spec (usually "k+") — kill at EVERY
+                               armed tick with rotating targets; the
+                               restart loop turns this into up/down
+                               flapping that exercises backoff and the
+                               restart-storm cap
 """
 
 from __future__ import annotations
@@ -247,3 +271,75 @@ class ServeChaos:
 
         return state.replace(
             params=jax.tree_util.tree_map(_nan, state.params))
+
+
+def _parse_replica_spec(spec: str):
+    """'3' / '3:1' / '2,7' / '5+' / '5+:0' -> list of
+    ``(tick, every_tick_from, replica_idx_or_None)`` triples."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        idx: Optional[int] = None
+        if ":" in part:
+            part, _, i = part.partition(":")
+            idx = int(i)
+        if part.endswith("+"):
+            out.append((int(part[:-1]), True, idx))
+        else:
+            out.append((int(part), False, idx))
+    return out
+
+
+class FleetChaos:
+    """Fault injector for the replica fleet (serve/fleet.py): hard
+    kills, predict hangs, and up/down flapping, armed per SUPERVISOR
+    PROBE TICK (1-based; one tick per ``fleet_probe_s``).  Construction
+    mirrors :class:`Chaos` (``HYDRAGNN_CHAOS_REPLICA_*`` env knobs
+    overlay an optional ``Serving.FleetChaos`` config dict, None when
+    nothing is armed — zero production overhead)."""
+
+    ACTIONS = ("kill", "hang", "flap")
+
+    def __init__(self, kill=(), hang=(), flap=()):
+        self.kill = list(kill)
+        self.hang = list(hang)
+        self.flap = list(flap)
+        self._tick = 0
+        self.injected = {a: 0 for a in self.ACTIONS}
+
+    @classmethod
+    def from_env(cls, section: Optional[Dict[str, Any]] = None
+                 ) -> Optional["FleetChaos"]:
+        """HYDRAGNN_CHAOS_REPLICA_KILL/_HANG/_FLAP env knobs overlaying
+        an optional ``Serving.FleetChaos`` dict (env wins); None when
+        nothing is armed."""
+        s = dict(section or {})
+        kill = os.environ.get("HYDRAGNN_CHAOS_REPLICA_KILL",
+                              str(s.get("kill", "") or ""))
+        hang = os.environ.get("HYDRAGNN_CHAOS_REPLICA_HANG",
+                              str(s.get("hang", "") or ""))
+        flap = os.environ.get("HYDRAGNN_CHAOS_REPLICA_FLAP",
+                              str(s.get("flap", "") or ""))
+        kill_s = _parse_replica_spec(kill) if kill else []
+        hang_s = _parse_replica_spec(hang) if hang else []
+        flap_s = _parse_replica_spec(flap) if flap else []
+        if not kill_s and not hang_s and not flap_s:
+            return None
+        return cls(kill_s, hang_s, flap_s)
+
+    def on_probe(self):
+        """Count one supervisor probe tick; return the armed actions as
+        ``(action, replica_idx_or_None)`` pairs (None = the supervisor
+        picks a live replica round-robin).  ``flap`` arms a kill every
+        matching tick — the supervisor's restart loop supplies the "up"
+        half of the flap."""
+        self._tick += 1
+        acts = []
+        for action in self.ACTIONS:
+            for (tick, every, idx) in getattr(self, action):
+                if (self._tick >= tick) if every else (self._tick == tick):
+                    self.injected[action] += 1
+                    acts.append((action, idx))
+        return acts

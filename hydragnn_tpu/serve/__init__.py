@@ -12,6 +12,17 @@ HTTP: /predict, /reload, /healthz, /metrics, graceful SIGTERM drain).
 saved config.json.  Overload semantics: docs/SERVING.md "Overload
 behavior & operational runbook".
 
+Fault-tolerant FLEET topology (``--fleet N`` / ``Serving.fleet_*``,
+docs/SERVING.md "Replica fleet"): N supervised replicas — each a full
+engine+batcher, subprocess by default or in-process via
+:meth:`InferenceEngine.fork` — behind :class:`FleetRouter`
+(power-of-two-choices least-outstanding routing, failover retry under
+the request deadline, breaker-driven ejection, 429 only when the whole
+fleet is saturated, 503 only when it is empty) with
+:class:`FleetSupervisor` restarting crashed replicas under exponential
+backoff + a storm cap and fanning hot reloads out as a rolling
+one-replica-at-a-time update.
+
 Exports resolve lazily (PEP 562): ``config.finalize`` imports
 ``serve.config`` for the written-back Serving defaults, and that must
 not drag the engine/server stack (flax, http.server, the trainer) into
@@ -34,6 +45,15 @@ _EXPORTS = {
     "RequestShedError": "hydragnn_tpu.serve.batcher",
     "ServingConfig": "hydragnn_tpu.serve.config",
     "serving_defaults": "hydragnn_tpu.serve.config",
+    "FleetSupervisor": "hydragnn_tpu.serve.fleet",
+    "InProcessReplica": "hydragnn_tpu.serve.fleet",
+    "PredictRequest": "hydragnn_tpu.serve.fleet",
+    "ReplicaDeadError": "hydragnn_tpu.serve.fleet",
+    "SubprocessReplica": "hydragnn_tpu.serve.fleet",
+    "spawn_argv": "hydragnn_tpu.serve.fleet",
+    "FleetEmptyError": "hydragnn_tpu.serve.router",
+    "FleetRouter": "hydragnn_tpu.serve.router",
+    "FleetSaturatedError": "hydragnn_tpu.serve.router",
     "BucketOverflowError": "hydragnn_tpu.serve.engine",
     "InferenceEngine": "hydragnn_tpu.serve.engine",
     "InferenceState": "hydragnn_tpu.serve.engine",

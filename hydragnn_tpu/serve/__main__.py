@@ -1,7 +1,8 @@
 """Serve a trained run over HTTP.
 
     python -m hydragnn_tpu.serve --config logs/<run>/config.json \
-        [--logs-dir ./logs/] [--host H] [--port P]
+        [--logs-dir ./logs/] [--host H] [--port P] \
+        [--fleet N [--fleet-inprocess]]
 
 ``--config`` is the FINALIZED config run_training saved next to the
 checkpoint (it carries output dims, head layout and the written-back
@@ -10,6 +11,15 @@ checkpoint (it carries output dims, head layout and the written-back
 the ``HYDRAGNN_SERVE_MAX_NODES``/``HYDRAGNN_SERVE_MAX_EDGES`` env knobs.
 Telemetry env knobs (HYDRAGNN_TELEMETRY=1 etc.) give the server a JSONL
 event log viewable with tools/teleview.py.
+
+``--fleet N`` (or ``Serving.fleet_replicas``) runs N supervised engine
+replicas behind the failover router instead of one server: each replica
+is a child ``python -m hydragnn_tpu.serve`` process on an ephemeral
+loopback port (``--fleet-inprocess`` keeps them as threads sharing one
+compile cache — the CPU/dev topology), crashed replicas restart with
+exponential backoff, and ``POST /reload`` becomes a rolling
+one-replica-at-a-time fleet update (docs/SERVING.md "Replica fleet").
+``--reload-watch`` applies to single-server mode only.
 """
 
 from __future__ import annotations
@@ -35,6 +45,14 @@ def main(argv=None) -> int:
     ap.add_argument("--reload-watch-s", type=float, default=None,
                     help="file-watch poll interval in seconds "
                          "(default 5 when --reload-watch is set)")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="run N supervised replicas behind the failover "
+                         "router (overrides Serving.fleet_replicas; "
+                         "0 = single server)")
+    ap.add_argument("--fleet-inprocess", action="store_true",
+                    help="fleet replicas as in-process threads sharing "
+                         "one compile cache (CPU/dev) instead of "
+                         "subprocesses")
     args = ap.parse_args(argv)
 
     with open(args.config) as f:
@@ -57,7 +75,52 @@ def main(argv=None) -> int:
             else (serving.reload_watch_s or 5.0)
     elif args.reload_watch_s is not None:
         serving.reload_watch_s = args.reload_watch_s
+    if args.fleet is not None:
+        serving.fleet_replicas = max(0, int(args.fleet))
+    if args.fleet_inprocess:
+        serving.fleet_inprocess = True
     telemetry = MetricsLogger.from_env(run_name="serve")
+
+    if serving.fleet_replicas > 0:
+        from hydragnn_tpu.resilience import FleetChaos
+        from hydragnn_tpu.serve import (
+            FleetRouter, FleetSupervisor, InProcessReplica,
+            SubprocessReplica, spawn_argv)
+
+        n = serving.fleet_replicas
+        if serving.fleet_inprocess:
+            base = InferenceEngine.from_config(
+                config, logs_dir=args.logs_dir, serving=serving,
+                telemetry=telemetry)
+            base.warmup()  # forks share this one compiled cache
+            replicas = [
+                InProcessReplica(i, base.fork, serving, telemetry)
+                for i in range(n)
+            ]
+            cfg, pbc = base.cfg, base.pbc
+        else:
+            builder = spawn_argv(args.config, logs_dir=args.logs_dir)
+            replicas = [
+                SubprocessReplica(i, builder, serving, telemetry)
+                for i in range(n)
+            ]
+            cfg, pbc = None, False
+        fleet = FleetSupervisor(replicas, serving, telemetry=telemetry,
+                                chaos=FleetChaos.from_env(
+                                    config.get("Serving", {}).get(
+                                        "FleetChaos")))
+        router = FleetRouter(fleet, serving=serving, cfg=cfg, pbc=pbc,
+                             telemetry=telemetry)
+        mode = "in-process" if serving.fleet_inprocess else "subprocess"
+        print(f"fleet of {n} {mode} replicas — router on "
+              f"http://{serving.host}:{router.port} — SIGTERM drains "
+              "gracefully", flush=True)
+        try:
+            router.run()
+        finally:
+            telemetry.finalize()
+        return 0
+
     engine = InferenceEngine.from_config(
         config, logs_dir=args.logs_dir, serving=serving, telemetry=telemetry)
     server = InferenceServer(engine, serving=serving)

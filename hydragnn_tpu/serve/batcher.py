@@ -198,6 +198,13 @@ class MicroBatcher:
             self._thread.start()
         return self
 
+    def worker_alive(self) -> bool:
+        """Is the consumer thread running?  The fleet supervisor's
+        liveness probe for in-process replicas (serve/fleet.py) — False
+        before start(), after close(), and if the worker ever died."""
+        t = self._thread
+        return t is not None and t.is_alive()
+
     # -- load shedding -------------------------------------------------------
 
     def _est_wait_s(self, depth: int) -> Optional[float]:
@@ -433,6 +440,12 @@ class MicroBatcher:
         now = time.perf_counter()
         dead, live = [], []
         for r in group:
+            if r.future.done():
+                # already answered elsewhere (a fleet router that timed
+                # out and failed over CANCELS its abandoned submit) —
+                # don't spend a bucket slot computing an answer nobody
+                # will read
+                continue
             (dead if self._expired(r, now) else live).append(r)
         if dead:
             self._shed_expired(dead)

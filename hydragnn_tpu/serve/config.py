@@ -41,8 +41,15 @@ class ServingConfig:
     HYDRAGNN_SERVE_PREDICT_TIMEOUT_S, HYDRAGNN_SERVE_BREAKER_THRESHOLD,
     HYDRAGNN_SERVE_BREAKER_COOLDOWN_S, HYDRAGNN_SERVE_RELOAD_WATCH,
     HYDRAGNN_SERVE_RELOAD_WATCH_S (docs/SERVING.md "Overload behavior"),
-    and the quantization knobs HYDRAGNN_SERVE_QUANT_POLICY /
-    HYDRAGNN_SERVE_QUANT_TOL (docs/SERVING.md "Quantized inference").
+    the quantization knobs HYDRAGNN_SERVE_QUANT_POLICY /
+    HYDRAGNN_SERVE_QUANT_TOL (docs/SERVING.md "Quantized inference"),
+    and the replica-fleet knobs HYDRAGNN_SERVE_FLEET,
+    HYDRAGNN_SERVE_FLEET_INPROCESS, HYDRAGNN_SERVE_FLEET_PROBE_S,
+    HYDRAGNN_SERVE_FLEET_BACKOFF_S, HYDRAGNN_SERVE_FLEET_BACKOFF_MAX_S,
+    HYDRAGNN_SERVE_FLEET_MAX_RESTARTS,
+    HYDRAGNN_SERVE_FLEET_RESTART_WINDOW_S, HYDRAGNN_SERVE_FLEET_DRAIN_S,
+    HYDRAGNN_SERVE_FLEET_STARTUP_S, HYDRAGNN_SERVE_FLEET_QUORUM
+    (docs/SERVING.md "Replica fleet").
     """
 
     # batch-capacity ladder (graphs per bucket), ascending; each entry
@@ -111,6 +118,35 @@ class ServingConfig:
     # may introduce and still be accepted (absolute, on the raw model
     # outputs); 0 = strictest (any drift rejects)
     quant_tolerance: float = 0.05
+    # -- replica fleet (serve/fleet.py, serve/router.py;
+    #    docs/SERVING.md "Replica fleet") --
+    # number of supervised engine replicas behind the failover router;
+    # 0 = single-server mode (the pre-fleet topology)
+    fleet_replicas: int = 0
+    # run replicas as threads in THIS process (shared compile cache via
+    # engine.fork()) instead of subprocesses — the CPU/dev topology;
+    # subprocess replicas are the production default
+    fleet_inprocess: bool = False
+    # supervisor health-probe interval (chaos ticks count these)
+    fleet_probe_s: float = 1.0
+    # exponential restart backoff: base, doubling per restart up to max,
+    # forgiven after a quiet fleet_restart_window_s
+    fleet_restart_backoff_s: float = 1.0
+    fleet_restart_backoff_max_s: float = 30.0
+    # restart-storm cap: more than this many restarts of one replica
+    # within fleet_restart_window_s marks it "failed" (no more
+    # restarts — operator attention required); 0 = never restart
+    fleet_max_restarts: int = 5
+    fleet_restart_window_s: float = 300.0
+    # drain-and-replace budget: how long the supervisor waits for a
+    # draining replica's in-flight requests before recycling it
+    fleet_drain_timeout_s: float = 10.0
+    # subprocess replicas: how long one may take to answer its first
+    # /healthz after spawn (jax import + AOT warmup)
+    fleet_startup_timeout_s: float = 300.0
+    # live replicas below this -> fleet_degraded telemetry + teleview
+    # WARNING; 0 = majority (N//2 + 1)
+    fleet_quorum: int = 0
 
     def __post_init__(self):
         self.buckets = _parse_buckets(self.buckets)
@@ -134,11 +170,31 @@ class ServingConfig:
             raise ValueError(f"Serving.port out of range: {self.port}")
         for name in ("request_deadline_ms", "predict_timeout_s",
                      "breaker_cooldown_s", "reload_probation_s",
-                     "reload_watch_s"):
+                     "reload_watch_s", "fleet_restart_backoff_s",
+                     "fleet_restart_backoff_max_s",
+                     "fleet_restart_window_s", "fleet_drain_timeout_s",
+                     "fleet_startup_timeout_s"):
             if float(getattr(self, name)) < 0:
                 raise ValueError(
                     f"Serving.{name} must be >= 0, "
                     f"got {getattr(self, name)}")
+        for name in ("fleet_replicas", "fleet_max_restarts",
+                     "fleet_quorum"):
+            if int(getattr(self, name)) < 0:
+                raise ValueError(
+                    f"Serving.{name} must be >= 0, "
+                    f"got {getattr(self, name)}")
+        if float(self.fleet_probe_s) <= 0:
+            raise ValueError(
+                f"Serving.fleet_probe_s must be > 0, "
+                f"got {self.fleet_probe_s}")
+        # only meaningful when the replica count comes from config too
+        # (directly-constructed fleets size themselves)
+        if int(self.fleet_replicas) > 0 \
+                and int(self.fleet_quorum) > int(self.fleet_replicas):
+            raise ValueError(
+                f"Serving.fleet_quorum ({self.fleet_quorum}) exceeds "
+                f"fleet_replicas ({self.fleet_replicas})")
         if int(self.breaker_threshold) < 0:
             raise ValueError(
                 f"Serving.breaker_threshold must be >= 0 (0 disables), "
@@ -190,6 +246,24 @@ class ServingConfig:
             quant_policy=str(s.get("quant_policy", d.quant_policy)),
             quant_tolerance=float(s.get("quant_tolerance",
                                         d.quant_tolerance)),
+            fleet_replicas=int(s.get("fleet_replicas", d.fleet_replicas)),
+            fleet_inprocess=bool(s.get("fleet_inprocess",
+                                       d.fleet_inprocess)),
+            fleet_probe_s=float(s.get("fleet_probe_s", d.fleet_probe_s)),
+            fleet_restart_backoff_s=float(s.get(
+                "fleet_restart_backoff_s", d.fleet_restart_backoff_s)),
+            fleet_restart_backoff_max_s=float(s.get(
+                "fleet_restart_backoff_max_s",
+                d.fleet_restart_backoff_max_s)),
+            fleet_max_restarts=int(s.get("fleet_max_restarts",
+                                         d.fleet_max_restarts)),
+            fleet_restart_window_s=float(s.get(
+                "fleet_restart_window_s", d.fleet_restart_window_s)),
+            fleet_drain_timeout_s=float(s.get(
+                "fleet_drain_timeout_s", d.fleet_drain_timeout_s)),
+            fleet_startup_timeout_s=float(s.get(
+                "fleet_startup_timeout_s", d.fleet_startup_timeout_s)),
+            fleet_quorum=int(s.get("fleet_quorum", d.fleet_quorum)),
         )
         if "HYDRAGNN_SERVE_BUCKETS" in os.environ:
             cfg.buckets = _parse_buckets(os.environ["HYDRAGNN_SERVE_BUCKETS"])
@@ -234,6 +308,36 @@ class ServingConfig:
         if "HYDRAGNN_SERVE_QUANT_TOL" in os.environ:
             cfg.quant_tolerance = float(
                 os.environ["HYDRAGNN_SERVE_QUANT_TOL"])
+        if "HYDRAGNN_SERVE_FLEET" in os.environ:
+            cfg.fleet_replicas = env_int("HYDRAGNN_SERVE_FLEET",
+                                         d.fleet_replicas)
+        if "HYDRAGNN_SERVE_FLEET_INPROCESS" in os.environ:
+            cfg.fleet_inprocess = bool(env_int(
+                "HYDRAGNN_SERVE_FLEET_INPROCESS", 0))
+        if "HYDRAGNN_SERVE_FLEET_PROBE_S" in os.environ:
+            cfg.fleet_probe_s = float(
+                os.environ["HYDRAGNN_SERVE_FLEET_PROBE_S"])
+        if "HYDRAGNN_SERVE_FLEET_BACKOFF_S" in os.environ:
+            cfg.fleet_restart_backoff_s = float(
+                os.environ["HYDRAGNN_SERVE_FLEET_BACKOFF_S"])
+        if "HYDRAGNN_SERVE_FLEET_BACKOFF_MAX_S" in os.environ:
+            cfg.fleet_restart_backoff_max_s = float(
+                os.environ["HYDRAGNN_SERVE_FLEET_BACKOFF_MAX_S"])
+        if "HYDRAGNN_SERVE_FLEET_MAX_RESTARTS" in os.environ:
+            cfg.fleet_max_restarts = env_int(
+                "HYDRAGNN_SERVE_FLEET_MAX_RESTARTS", d.fleet_max_restarts)
+        if "HYDRAGNN_SERVE_FLEET_RESTART_WINDOW_S" in os.environ:
+            cfg.fleet_restart_window_s = float(
+                os.environ["HYDRAGNN_SERVE_FLEET_RESTART_WINDOW_S"])
+        if "HYDRAGNN_SERVE_FLEET_DRAIN_S" in os.environ:
+            cfg.fleet_drain_timeout_s = float(
+                os.environ["HYDRAGNN_SERVE_FLEET_DRAIN_S"])
+        if "HYDRAGNN_SERVE_FLEET_STARTUP_S" in os.environ:
+            cfg.fleet_startup_timeout_s = float(
+                os.environ["HYDRAGNN_SERVE_FLEET_STARTUP_S"])
+        if "HYDRAGNN_SERVE_FLEET_QUORUM" in os.environ:
+            cfg.fleet_quorum = env_int("HYDRAGNN_SERVE_FLEET_QUORUM",
+                                       d.fleet_quorum)
         # re-validate after the env overlay (the dataclass validated the
         # config values; env strings can be just as wrong)
         cfg.__post_init__()
@@ -266,4 +370,14 @@ def serving_defaults() -> Dict[str, Any]:
         "reload_root": d.reload_root,
         "quant_policy": d.quant_policy,
         "quant_tolerance": d.quant_tolerance,
+        "fleet_replicas": d.fleet_replicas,
+        "fleet_inprocess": d.fleet_inprocess,
+        "fleet_probe_s": d.fleet_probe_s,
+        "fleet_restart_backoff_s": d.fleet_restart_backoff_s,
+        "fleet_restart_backoff_max_s": d.fleet_restart_backoff_max_s,
+        "fleet_max_restarts": d.fleet_max_restarts,
+        "fleet_restart_window_s": d.fleet_restart_window_s,
+        "fleet_drain_timeout_s": d.fleet_drain_timeout_s,
+        "fleet_startup_timeout_s": d.fleet_startup_timeout_s,
+        "fleet_quorum": d.fleet_quorum,
     }

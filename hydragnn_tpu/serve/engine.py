@@ -302,6 +302,40 @@ class InferenceEngine:
                    post_collate=post_collate,
                    pbc=bool(arch.get("periodic_boundary_conditions")))
 
+    def fork(self) -> "InferenceEngine":
+        """A new engine over the SAME model/buckets/weights that SHARES
+        this engine's compiled-executable cache (and its lock) but owns
+        its own serving state — reload/rollback machinery, quant gate,
+        hit/miss counters.
+
+        This is the in-process replica-fleet topology (serve/fleet.py):
+        the executables are pure functions of the (state, batch) avals,
+        so N structurally-identical replicas must not pay N AOT warmups
+        or hold N copies of the compiled programs — a fork's
+        :meth:`warmup` cache-hits every bucket and only replays the
+        golden batch.  The forked state references the same device
+        buffers until a hot reload swaps one replica's copy out (params
+        are read-only on the predict path, so sharing is safe).
+        """
+        eng = InferenceEngine(
+            self.cfg, self.state, self.head_specs, self.pad_specs,
+            serving=self.serving, telemetry=self.telemetry,
+            y_minmax=self.y_minmax, post_collate=self.post_collate,
+            pbc=self.pbc)
+        # share the compiled programs AND the lock that guards them —
+        # two locks over one dict would not be mutual exclusion
+        eng._compiled = self._compiled
+        eng._evals = self._evals
+        eng._lock = self._lock
+        # the quant gate already ran on the parent: adopt its verdict
+        # (a fork re-running _activate_policy would re-quantize and
+        # re-replay for an identical answer)
+        eng._policy = self._policy
+        eng._quant = dict(self._quant)
+        eng._golden_f32 = self._golden_f32
+        eng._golden = self._golden
+        return eng
+
     # -- bucket selection ----------------------------------------------------
 
     def _needs(self, samples: Sequence[GraphSample]):

@@ -1,0 +1,1026 @@
+"""Supervised replica fleet: N engine+batcher workers behind one router.
+
+The single-server stack (serve/server.py) fails as a unit: one wedged
+predict or one SIGKILL takes the whole service down, and the circuit
+breaker (PR 5) can only fail *fast*, not fail *over*.  This module adds
+the process-supervision layer the ROADMAP's "millions of users" north
+star needs:
+
+- **Replicas.**  Each replica is a full engine + micro-batcher +
+  circuit breaker.  :class:`SubprocessReplica` runs one as a child
+  process (``python -m hydragnn_tpu.serve --port P``) — the production
+  topology, where a crash is a real SIGKILL and isolation is the OS's.
+  :class:`InProcessReplica` runs one as threads in this process — the
+  CPU/test topology, where N replicas share ONE compiled-executable
+  cache via :meth:`InferenceEngine.fork` (structurally identical
+  replicas must not pay N AOT warmups) and a "kill" is the SIGKILL
+  analog: in-flight work fails (the router retries it elsewhere) and
+  the worker goes away without drain.
+
+- **Supervision.**  :class:`FleetSupervisor` owns the replicas and runs
+  a probe loop (``Serving.fleet_probe_s``): dead replicas (process
+  exit, worker-thread exit, chaos kill) are restarted with exponential
+  backoff (``fleet_restart_backoff_s`` doubling up to
+  ``fleet_restart_backoff_max_s``, reset after a quiet
+  ``fleet_restart_window_s``) under a restart-storm cap
+  (``fleet_max_restarts`` restarts within the window marks the replica
+  ``failed`` — a crash-looping replica must not burn the fleet's
+  attention forever); replicas whose breaker is OPEN are ejected from
+  routing and re-admitted once the cooldown elapses, so the next routed
+  request is the breaker's half-open probe — the PR 5 state machine,
+  reused per replica rather than reinvented.
+
+- **Drain-and-replace.**  :meth:`FleetSupervisor.drain_and_replace`
+  recycles a live replica with zero dropped requests: stop routing
+  (state ``draining``), wait for the router's outstanding count to hit
+  zero, graceful-stop (the batcher answers everything queued), start a
+  fresh incarnation.
+
+- **Rolling reload.**  :meth:`FleetSupervisor.rolling_reload` fans the
+  PR 5 hot-reload machinery fleet-wide, ONE replica at a time (>= N-1
+  replicas keep serving throughout): each replica validates the
+  candidate against its own golden batch and swaps atomically; a
+  validation failure on the first replica aborts before any other
+  replica is touched, and a failure later rolls the already-swapped
+  replicas back.  The per-replica breaker probation (a trip shortly
+  after a swap auto-rolls that replica back) stays armed as usual.
+
+Fault injection: :class:`~hydragnn_tpu.resilience.chaos.FleetChaos`
+(``HYDRAGNN_CHAOS_REPLICA_KILL`` / ``_HANG`` / ``_FLAP``) is consulted
+once per probe tick, so every failover path above is exercised by
+tests (tests/test_serve_fleet.py) and by the chaos-kill bench
+(``tools/servebench.py --fleet``), not just by argument.
+
+Telemetry: ``fleet_start`` / ``replica_start`` / ``replica_dead`` /
+``replica_restart`` / ``replica_eject`` / ``replica_readmit`` /
+``replica_drain`` / ``rolling_reload_start`` / ``rolling_reload_ok`` /
+``rolling_reload_rollback`` / ``fleet_degraded`` health events through
+the shared MetricsLogger (docs/TELEMETRY.md "Fleet events").
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+# py3.10: concurrent.futures.TimeoutError is not yet the builtin one
+from concurrent.futures import TimeoutError as _FutureTimeout
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from hydragnn_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
+from hydragnn_tpu.serve.batcher import (
+    MicroBatcher,
+    PredictTimeoutError,
+    QueueFullError,
+    RequestShedError,
+)
+from hydragnn_tpu.serve.config import ServingConfig
+
+
+class ReplicaDeadError(RuntimeError):
+    """The replica died under this request (SIGKILL, worker exit,
+    connection reset) — the router retries on a DIFFERENT replica."""
+
+
+@dataclass
+class PredictRequest:
+    """One parsed-and-validated /predict request as the router hands it
+    to a replica: ``sample`` drives in-process dispatch, ``body`` (the
+    JSON-encoded graph) drives the subprocess HTTP proxy — the deadline
+    always travels separately as the REMAINING budget, so a retried
+    request never re-spends time a previous replica already burned."""
+
+    sample: Any = None          # GraphSample (in-process replicas)
+    body: Optional[bytes] = None  # raw JSON body (subprocess replicas)
+    num_nodes: int = 0
+
+
+def free_port() -> int:
+    """An ephemeral port for a subprocess replica to bind."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _ReplicaChaos:
+    """Per-replica chaos slot threaded into the batcher at construction:
+    delegates to an optional inner :class:`ServeChaos` and lets the
+    fleet layer wedge (hang) or kill the predict path of ONE incarnation
+    at runtime.  Runs inside the batcher's watchdog thread, so a hang is
+    detected by the predict watchdog -> breaker -> ejection chain, not
+    by magic."""
+
+    def __init__(self, inner=None):
+        self.inner = inner
+        self._dead = False
+        self._hang: Optional[threading.Event] = None
+
+    def kill(self) -> None:
+        self._dead = True
+        self.release()
+
+    def hang(self) -> None:
+        if self._hang is None:
+            self._hang = threading.Event()
+
+    def release(self) -> None:
+        """Unwedge a hung predict (replica recycle): the blocked thread
+        wakes and fails its stale flush instead of sleeping forever."""
+        h, self._hang = self._hang, None
+        if h is not None:
+            h.set()
+
+    def on_predict(self) -> None:
+        h = self._hang
+        if h is not None:
+            # wedged until the supervisor recycles this incarnation (the
+            # bounded wait is a leak guard, not a behavior knob)
+            h.wait(timeout=600.0)
+            raise ReplicaDeadError("replica predict path was wedged "
+                                   "(chaos hang) and the replica recycled")
+        if self._dead:
+            raise ReplicaDeadError("replica is dead (chaos kill)")
+        if self.inner is not None:
+            self.inner.on_predict()
+
+    def on_reload_state(self, state):
+        if self.inner is not None:
+            return self.inner.on_reload_state(state)
+        return state
+
+
+class InProcessReplica:
+    """One engine + batcher + breaker as threads in this process — the
+    CPU and test topology (docs/SERVING.md "Replica fleet").
+
+    ``engine_factory`` builds (or forks) the replica's engine per
+    incarnation; a factory returning :meth:`InferenceEngine.fork` of a
+    warmed base engine gives N replicas one shared compile cache and
+    near-free restarts.  ``chaos_factory`` (optional) supplies a fresh
+    inner ServeChaos per incarnation — per-replica fault injection for
+    the breaker/ejection tests.
+    """
+
+    kind = "inprocess"
+
+    def __init__(self, idx: int, engine_factory: Callable[[], Any],
+                 serving: ServingConfig, telemetry,
+                 chaos_factory: Optional[Callable[[], Any]] = None):
+        self.idx = int(idx)
+        self._engine_factory = engine_factory
+        self._chaos_factory = chaos_factory
+        self.serving = serving
+        self.telemetry = telemetry
+        self.state = "stopped"
+        self.restarts = 0
+        self.port: Optional[int] = None
+        self.engine = None
+        self.batcher: Optional[MicroBatcher] = None
+        self.breaker: Optional[CircuitBreaker] = None
+        self.chaos: Optional[_ReplicaChaos] = None
+        self.outstanding = 0
+        self._out_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.state = "starting"
+        self.chaos = _ReplicaChaos(
+            self._chaos_factory() if self._chaos_factory else None)
+        self.engine = self._engine_factory()
+        # forks arrive warmed (shared compile cache + copied golden);
+        # a fresh engine pays the one AOT warmup here
+        if self.engine._golden is None:
+            self.engine.warmup()
+        s = self.serving
+        self.breaker = CircuitBreaker(
+            threshold=s.breaker_threshold, cooldown_s=s.breaker_cooldown_s,
+            what=f"replica{self.idx}", telemetry=self.telemetry,
+            on_open=self._on_breaker_open)
+        self.batcher = MicroBatcher(
+            self.engine, max_wait_ms=s.max_wait_ms, max_queue=s.max_queue,
+            telemetry=self.telemetry,
+            default_deadline_ms=s.request_deadline_ms,
+            predict_timeout_s=s.predict_timeout_s, breaker=self.breaker,
+            chaos=self.chaos).start()
+        self.state = "live"
+
+    def _on_breaker_open(self) -> None:
+        # same probation rule as the single server: a breaker trip right
+        # after a hot reload rolls THIS replica's checkpoint back
+        if self.engine is not None and self.engine.in_probation(
+                self.serving.reload_probation_s):
+            if self.engine.rollback(reason="breaker_trip"):
+                self.breaker.reset(to="half_open")
+
+    def stop(self, drain: bool = True) -> None:
+        if self.chaos is not None:
+            self.chaos.release()
+        if self.batcher is not None:
+            self.batcher.close(drain=drain,
+                               timeout=self.serving.drain_timeout_s)
+        self.state = "stopped"
+
+    def restart(self) -> None:
+        """Recycle: tear the old incarnation down hard, start fresh."""
+        self.stop(drain=False)
+        self.restarts += 1
+        self.start()
+
+    def kill(self) -> None:
+        """The SIGKILL analog: every in-flight and queued request FAILS
+        (the router retries them on other replicas) and the worker goes
+        away without drain.  The STATE transition stays with the
+        supervisor (mark_dead schedules the backoff restart) — exactly
+        like a real SIGKILL, which the victim never observes."""
+        if self.chaos is not None:
+            self.chaos.kill()
+        if self.batcher is not None:
+            self.batcher.close(drain=False)
+
+    def hang(self) -> None:
+        """Wedge the predict path: the watchdog (predict_timeout_s) must
+        time the flushes out and the breaker must eject the replica."""
+        if self.chaos is not None:
+            self.chaos.hang()
+
+    # -- probes --------------------------------------------------------------
+
+    def alive(self) -> bool:
+        b = self.batcher
+        if b is None or not b.worker_alive():
+            return False
+        return not (self.chaos is not None and self.chaos._dead)
+
+    def probe(self) -> str:
+        """Liveness + breaker verdict: ``ok`` / ``open`` / ``dead``.
+        Half-open is NOT reported as open — the breaker's recovery probe
+        needs traffic, so a half-open replica stays routable."""
+        if not self.alive():
+            return "dead"
+        if self.breaker is not None and self.breaker.state == "open":
+            return "open"
+        return "ok"
+
+    def ready_to_readmit(self) -> bool:
+        """An ejected replica re-enters routing once its breaker
+        cooldown has elapsed — the next routed flush is the half-open
+        probe that decides recovery."""
+        return self.breaker is not None \
+            and self.breaker.time_to_retry() == 0.0
+
+    # -- routing hooks -------------------------------------------------------
+
+    def inc_outstanding(self) -> None:
+        with self._out_lock:
+            self.outstanding += 1
+
+    def dec_outstanding(self) -> None:
+        with self._out_lock:
+            self.outstanding = max(0, self.outstanding - 1)
+
+    def retry_after_s(self) -> float:
+        b = self.batcher
+        return b.retry_after_s() if b is not None else 1.0
+
+    def predict(self, req: PredictRequest,
+                deadline_s: Optional[float]) -> Dict[str, Any]:
+        """One attempt on THIS replica; shed/breaker/timeout/dead errors
+        propagate for the router to map or fail over."""
+        fut = self.batcher.submit(req.sample, deadline_s=deadline_s)
+        if deadline_s is None:
+            wait = 30.0
+        else:
+            # the request's own budget plus the worst predict it could
+            # sit behind (same rule as InferenceServer._wait_s)
+            wait = deadline_s + max(1.0, self.serving.predict_timeout_s)
+        try:
+            res = fut.result(timeout=wait)
+        except (_FutureTimeout, TimeoutError):
+            # abandoning the wait to fail over: cancel the queued entry
+            # so this replica doesn't burn a bucket slot computing an
+            # answer nobody reads (the batcher skips done futures)
+            fut.cancel()
+            raise
+        return {name: np.asarray(arr).tolist() for name, arr in res.items()}
+
+    # -- control -------------------------------------------------------------
+
+    def reload(self, path: str) -> Dict[str, Any]:
+        return self.engine.reload_from_checkpoint(
+            path, chaos=self.chaos, source="rolling")
+
+    def rollback(self) -> bool:
+        return self.engine.rollback(reason="rolling_reload")
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "replica": self.idx,
+            "kind": self.kind,
+            "state": self.state,
+            "restarts": self.restarts,
+            "outstanding": self.outstanding,
+        }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
+        if self.batcher is not None:
+            st = self.batcher.stats()
+            out["queue_depth"] = st["queue_depth"]
+            out["drain_rate_rps"] = st["drain_rate_rps"]
+            out["requests"] = st["requests"]
+            out["batches"] = st["batches"]
+        if self.engine is not None:
+            out["reload"] = self.engine.reload_stats()
+            cache = self.engine.cache_stats()
+            out["cache"] = {k: cache[k] for k in
+                            ("hits", "misses", "warmup_compiles")}
+        return out
+
+
+class SubprocessReplica:
+    """One replica as a child ``python -m hydragnn_tpu.serve`` process —
+    the production topology: a crash is a real SIGKILL, a hang is a real
+    SIGSTOP, and memory/device isolation is the operating system's.
+
+    ``argv_builder(port)`` returns the child's command line; the
+    supervisor assigns an ephemeral port per incarnation and waits for
+    ``/healthz`` before admitting the replica to routing.  The child
+    env gets ``HYDRAGNN_SERVE_FLEET=0`` so a fleet-configured config
+    can never recurse into fleets of fleets.
+    """
+
+    kind = "subprocess"
+
+    def __init__(self, idx: int, argv_builder: Callable[[int], List[str]],
+                 serving: ServingConfig, telemetry,
+                 env: Optional[Dict[str, str]] = None):
+        self.idx = int(idx)
+        self._argv_builder = argv_builder
+        self.serving = serving
+        self.telemetry = telemetry
+        self._env = dict(env if env is not None else os.environ)
+        self._env["HYDRAGNN_SERVE_FLEET"] = "0"
+        self.state = "stopped"
+        self.restarts = 0
+        self.port: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self.outstanding = 0
+        self._out_lock = threading.Lock()
+        self._last_health: Dict[str, Any] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.state = "starting"
+        self.port = free_port()
+        self._proc = subprocess.Popen(self._argv_builder(self.port),
+                                      env=self._env)
+        deadline = time.monotonic() + self.serving.fleet_startup_timeout_s
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                self.state = "dead"
+                raise ReplicaDeadError(
+                    f"replica {self.idx} exited with rc "
+                    f"{self._proc.returncode} during startup")
+            try:
+                if self._get("/healthz", timeout=2.0) is not None:
+                    self.state = "live"
+                    return
+            except Exception:  # noqa: BLE001 — not listening yet
+                pass
+            time.sleep(0.2)
+        self.state = "dead"
+        raise ReplicaDeadError(
+            f"replica {self.idx} did not become healthy within "
+            f"{self.serving.fleet_startup_timeout_s:.0f} s")
+
+    def stop(self, drain: bool = True) -> None:
+        p = self._proc
+        if p is not None and p.poll() is None:
+            try:
+                # SIGCONT first: a SIGSTOPped (chaos-hung) child cannot
+                # handle the SIGTERM drain
+                p.send_signal(signal.SIGCONT)
+                p.send_signal(signal.SIGTERM if drain else signal.SIGKILL)
+                p.wait(timeout=self.serving.drain_timeout_s + 5.0
+                       if drain else 5.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=5.0)
+        self.state = "stopped"
+
+    def restart(self) -> None:
+        self.stop(drain=False)
+        self.restarts += 1
+        self.start()
+
+    def kill(self) -> None:
+        # the state transition stays with the supervisor (mark_dead)
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()  # SIGKILL — the real thing
+
+    def hang(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGSTOP)
+
+    # -- probes --------------------------------------------------------------
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def probe(self) -> str:
+        if not self.alive():
+            return "dead"  # process exit: definitive, no tolerance
+        try:
+            h = self._get("/healthz", timeout=2.0)
+        except Exception:  # noqa: BLE001 — slow or wedged (e.g. SIGSTOP)
+            # NOT "dead": one missed 2 s probe on a busy-but-healthy
+            # child must not SIGKILL its whole queue — the supervisor
+            # requires consecutive misses before declaring death
+            return "unresponsive"
+        self._last_health = h or {}
+        br = (h or {}).get("breaker") or {}
+        return "open" if br.get("state") == "open" else "ok"
+
+    def ready_to_readmit(self) -> bool:
+        try:
+            h = self._get("/healthz", timeout=2.0)
+        except Exception:  # noqa: BLE001
+            return False
+        br = (h or {}).get("breaker") or {}
+        return br.get("state") != "open" \
+            or float(br.get("time_to_retry_s", 1.0)) == 0.0
+
+    # -- routing hooks -------------------------------------------------------
+
+    def inc_outstanding(self) -> None:
+        with self._out_lock:
+            self.outstanding += 1
+
+    def dec_outstanding(self) -> None:
+        with self._out_lock:
+            self.outstanding = max(0, self.outstanding - 1)
+
+    def retry_after_s(self) -> float:
+        return 1.0
+
+    def _url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def _get(self, path: str, timeout: float = 10.0):
+        with urllib.request.urlopen(self._url(path), timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def predict(self, req: PredictRequest,
+                deadline_s: Optional[float]) -> Dict[str, Any]:
+        """Proxy one attempt to the child's /predict.  The REMAINING
+        budget rides the ``X-Timeout-Ms`` header, which wins over any
+        (stale) ``timeout_ms`` field in the forwarded body."""
+        headers = {"Content-Type": "application/json"}
+        wait = 30.0
+        if deadline_s is not None:
+            headers["X-Timeout-Ms"] = str(max(0.0, deadline_s * 1e3))
+            wait = deadline_s + max(1.0, self.serving.predict_timeout_s)
+        request = urllib.request.Request(self._url("/predict"),
+                                         data=req.body, headers=headers)
+        try:
+            with urllib.request.urlopen(request, timeout=wait) as r:
+                return json.loads(r.read())["heads"]
+        except urllib.error.HTTPError as e:
+            raise _error_from_status(e) from None
+        except urllib.error.URLError as e:
+            raise ReplicaDeadError(
+                f"replica {self.idx} unreachable: {e.reason!r}") from None
+        except (ConnectionError, socket.timeout, TimeoutError) as e:
+            raise ReplicaDeadError(
+                f"replica {self.idx} connection failed: {e!r}") from None
+
+    # -- control -------------------------------------------------------------
+
+    def reload(self, path: str) -> Dict[str, Any]:
+        from hydragnn_tpu.serve.engine import ReloadValidationError
+
+        body = json.dumps({"checkpoint": path}).encode()
+        request = urllib.request.Request(
+            self._url("/reload"), data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(request, timeout=120.0) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                pass
+            if e.code == 409:
+                raise ReloadValidationError(
+                    payload.get("error", "candidate rejected")) from None
+            if e.code == 404:
+                raise FileNotFoundError(
+                    payload.get("error", path)) from None
+            raise RuntimeError(
+                f"replica {self.idx} reload failed: "
+                f"{e.code} {payload.get('error')}") from None
+
+    def rollback(self) -> bool:
+        """POST /rollback on the child: restore its retained pre-reload
+        state (the rolling-reload abort path — a later replica rejected
+        the candidate this one already swapped in)."""
+        request = urllib.request.Request(
+            self._url("/rollback"), data=b"{}",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(request, timeout=30.0) as r:
+                return json.loads(r.read()).get("status") == "rolled_back"
+        except Exception:  # noqa: BLE001 — nothing retained / child gone
+            return False
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "replica": self.idx,
+            "kind": self.kind,
+            "state": self.state,
+            "restarts": self.restarts,
+            "outstanding": self.outstanding,
+            "port": self.port,
+            "pid": self._proc.pid if self._proc is not None else None,
+        }
+        try:
+            m = self._get("/metrics", timeout=2.0)
+            out["breaker"] = m.get("breaker")
+            bat = m.get("batcher") or {}
+            out["queue_depth"] = bat.get("queue_depth")
+            out["drain_rate_rps"] = bat.get("drain_rate_rps", 0.0)
+            out["requests"] = bat.get("requests")
+            out["batches"] = bat.get("batches")
+            out["reload"] = m.get("reload")
+            eng = m.get("engine") or {}
+            out["cache"] = {k: int(eng.get(k, 0)) for k in
+                            ("hits", "misses", "warmup_compiles")}
+        except Exception:  # noqa: BLE001 — dead/hung child: states only
+            pass
+        return out
+
+
+def _error_from_status(e: "urllib.error.HTTPError") -> Exception:
+    """Map a child replica's HTTP error onto the SAME exception types the
+    in-process dispatch raises, so the router's failover logic has one
+    vocabulary."""
+    try:
+        payload = json.loads(e.read())
+    except Exception:  # noqa: BLE001
+        payload = {}
+    msg = str(payload.get("error", f"replica returned {e.code}"))
+    retry = float(e.headers.get("Retry-After", 1.0) or 1.0)
+    if e.code == 429:
+        return RequestShedError(msg, retry_after_s=retry)
+    if e.code == 503:
+        if payload.get("breaker") == "open":
+            return BreakerOpenError(msg, retry_after_s=retry)
+        return QueueFullError(msg)
+    if e.code == 504:
+        return PredictTimeoutError(msg)
+    if e.code == 413:
+        from hydragnn_tpu.serve.engine import BucketOverflowError
+
+        return BucketOverflowError(msg)
+    if e.code == 400:
+        return ValueError(msg)
+    return RuntimeError(f"replica error {e.code}: {msg}")
+
+
+class FleetSupervisor:
+    """Owns the replica pool: health probing, backoff restarts under a
+    storm cap, breaker-driven ejection/readmission, drain-and-replace,
+    and rolling fleet reload (module docstring for the full story)."""
+
+    # consecutive unresponsive /healthz probes before a live replica is
+    # declared dead (process exit is always immediate)
+    UNRESPONSIVE_PROBES = 3
+
+    def __init__(self, replicas: List[Any], serving: ServingConfig,
+                 telemetry=None, chaos=None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.serving = serving
+        if telemetry is None:
+            from hydragnn_tpu.telemetry import MetricsLogger
+
+            telemetry = MetricsLogger.disabled()
+        self.telemetry = telemetry
+        self.chaos = chaos  # resilience.chaos.FleetChaos or None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        base = max(0.05, serving.fleet_restart_backoff_s)
+        self._base_backoff = base
+        self._backoff: Dict[int, float] = {}
+        self._restart_at: Dict[int, float] = {}
+        self._last_restart: Dict[int, float] = {}
+        self._restart_times: Dict[int, collections.deque] = {}
+        self._rr = 0  # chaos target round-robin cursor
+        self._was_degraded = False
+        self._rolling_lock = threading.Lock()
+        # consecutive "unresponsive" probe verdicts per replica (a slow
+        # /healthz is not death; this many in a row is)
+        self._unresponsive: Dict[int, int] = {}
+        # the fleet's desired checkpoint: set by a successful rolling
+        # reload so replicas that restart (from the ORIGINAL weights)
+        # or rejoin later are brought onto the same version instead of
+        # silently serving stale predictions
+        self._desired_ckpt: Optional[str] = None
+        self._reload_gen = 0
+        self._replica_gen: Dict[int, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def quorum(self) -> int:
+        q = int(self.serving.fleet_quorum)
+        return q if q > 0 else len(self.replicas) // 2 + 1
+
+    def start(self) -> "FleetSupervisor":
+        started: List[Any] = []
+        try:
+            for r in self.replicas:
+                r.start()
+                started.append(r)
+                self.telemetry.health("replica_start", replica=r.idx,
+                                      port=r.port or 0,
+                                      restarts=r.restarts)
+        except Exception:
+            # partial startup must not leak live replicas (subprocess
+            # mode: orphaned jax children holding memory and ports)
+            for r in started:
+                try:
+                    r.stop(drain=False)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+            raise
+        self.telemetry.health("fleet_start", replicas=len(self.replicas),
+                              mode=self.replicas[0].kind,
+                              quorum=self.quorum)
+        self._thread = threading.Thread(
+            target=self._probe_loop, name="fleet-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for r in self.replicas:
+            try:
+                r.stop(drain=drain)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    # -- routing view --------------------------------------------------------
+
+    def routable(self) -> List[Any]:
+        return [r for r in self.replicas if r.state == "live"]
+
+    def live_count(self) -> int:
+        return len(self.routable())
+
+    def mark_dead(self, r, reason: str) -> None:
+        """Router- or probe-reported death: stop routing, schedule the
+        backoff restart."""
+        with self._lock:
+            if r.state in ("dead", "failed", "restarting", "stopped"):
+                return
+            r.state = "dead"
+            backoff = self._backoff.get(r.idx, self._base_backoff)
+            self._restart_at[r.idx] = time.monotonic() + backoff
+        self.telemetry.health("replica_dead", replica=r.idx, reason=reason)
+
+    def eject(self, r, reason: str) -> None:
+        """Breaker-driven ejection: the replica is alive but its predict
+        path is circuit-broken — take it out of routing until the
+        cooldown elapses (readmission makes the next routed flush the
+        half-open probe)."""
+        with self._lock:
+            if r.state != "live":
+                return
+            r.state = "ejected"
+        self.telemetry.health("replica_eject", replica=r.idx, reason=reason)
+
+    # -- probe loop ----------------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.serving.fleet_probe_s):
+            try:
+                self.probe_once()
+            except Exception as e:  # noqa: BLE001 — must survive a bad tick
+                self.telemetry.health("fleet_probe_error",
+                                      error=repr(e)[:200])
+
+    def probe_once(self) -> None:
+        """One supervision tick (public so tests and the bench can drive
+        deterministic ticks): apply armed chaos, check every replica,
+        update the quorum latch."""
+        if self.chaos is not None:
+            for action, idx in self.chaos.on_probe():
+                self._apply_chaos(action, idx)
+        now = time.monotonic()
+        for r in self.replicas:
+            self._check(r, now)
+        self._check_quorum()
+
+    def _apply_chaos(self, action: str, idx: Optional[int]) -> None:
+        if idx is not None:
+            target = self.replicas[idx] if 0 <= idx < len(self.replicas) \
+                else None
+        else:
+            live = self.routable()
+            if not live:
+                return
+            target = live[self._rr % len(live)]
+            self._rr += 1
+        if target is None:
+            return
+        if action in ("kill", "flap"):
+            target.kill()
+            self.mark_dead(target, reason=f"chaos_{action}")
+        elif action == "hang":
+            target.hang()
+
+    def _check(self, r, now: float) -> None:
+        st = r.state
+        if st == "live":
+            verdict = r.probe()
+            if verdict == "unresponsive":
+                # a busy-but-healthy replica can miss one 2 s probe —
+                # only consecutive misses are death
+                n = self._unresponsive.get(r.idx, 0) + 1
+                self._unresponsive[r.idx] = n
+                if n >= self.UNRESPONSIVE_PROBES:
+                    self._unresponsive[r.idx] = 0
+                    self.mark_dead(r, reason="unresponsive")
+                return
+            self._unresponsive[r.idx] = 0
+            if verdict == "dead":
+                self.mark_dead(r, reason="probe_dead")
+            elif verdict == "open":
+                self.eject(r, reason="breaker_open")
+            elif not self._sync_checkpoint(r):
+                # serving STALE weights (restarted/rejoined across a
+                # rolling reload) and the re-reload failed: out of
+                # routing until a sync succeeds
+                with self._lock:
+                    if r.state == "live":
+                        r.state = "ejected"
+            elif self._backoff.get(r.idx, 0.0) > self._base_backoff \
+                    and now - self._last_restart.get(r.idx, now) \
+                    > self.serving.fleet_restart_window_s:
+                # survived a full window since its last restart: the
+                # crash is over, forgive the accumulated backoff
+                self._backoff[r.idx] = self._base_backoff
+        elif st == "ejected":
+            if not r.alive():
+                self.mark_dead(r, reason="probe_dead")
+            elif r.ready_to_readmit() and self._sync_checkpoint(r):
+                with self._lock:
+                    if r.state == "ejected":
+                        r.state = "live"
+                self.telemetry.health("replica_readmit", replica=r.idx)
+        elif st == "dead":
+            if now >= self._restart_at.get(r.idx, 0.0):
+                self._try_restart(r, now)
+
+    def _sync_checkpoint(self, r) -> bool:
+        """Is ``r`` on the fleet's desired checkpoint (re-reloading it
+        when a restart/rejoin left it behind a rolling reload)?  False
+        means the caller must keep it out of routing — a mixed-version
+        fleet answering from stale weights is a silent correctness bug,
+        not a degraded mode."""
+        if self._desired_ckpt is None \
+                or self._replica_gen.get(r.idx, 0) == self._reload_gen:
+            return True
+        if not self._rolling_lock.acquire(blocking=False):
+            # a rolling reload is in flight; it (or the next tick)
+            # covers this replica
+            return True
+        try:
+            gen = self._reload_gen
+            try:
+                r.reload(self._desired_ckpt)
+            except Exception as e:  # noqa: BLE001 — keep it out of routing
+                self.telemetry.health(
+                    "replica_eject", replica=r.idx,
+                    reason="stale_checkpoint", error=str(e)[:200])
+                return False
+            self._replica_gen[r.idx] = gen
+            return True
+        finally:
+            self._rolling_lock.release()
+
+    def _try_restart(self, r, now: float) -> None:
+        if self._stop.is_set():
+            # shutting down: a restart here would spawn a replica the
+            # teardown sweep already missed (an orphaned jax child)
+            return
+        window = self.serving.fleet_restart_window_s
+        times = self._restart_times.setdefault(
+            r.idx, collections.deque())
+        while times and now - times[0] > window:
+            times.popleft()
+        if len(times) >= self.serving.fleet_max_restarts:
+            # restart storm: this replica is crash-looping — stop
+            # burning supervision on it (operator attention required)
+            with self._lock:
+                r.state = "failed"
+            self.telemetry.health(
+                "replica_eject", replica=r.idx, reason="restart_storm",
+                restarts_in_window=len(times))
+            return
+        with self._lock:
+            r.state = "restarting"
+        backoff = self._backoff.get(r.idx, self._base_backoff)
+        try:
+            r.restart()
+        except Exception as e:  # noqa: BLE001 — keep backing off
+            nxt = min(backoff * 2.0,
+                      self.serving.fleet_restart_backoff_max_s)
+            with self._lock:
+                r.state = "dead"
+                self._backoff[r.idx] = nxt
+                self._restart_at[r.idx] = time.monotonic() + nxt
+            self.telemetry.health("replica_dead", replica=r.idx,
+                                  reason="restart_failed",
+                                  error=repr(e)[:200])
+            return
+        if self._stop.is_set():
+            # stop() raced the restart (its teardown sweep may have run
+            # before this incarnation existed): don't leak it
+            r.stop(drain=False)
+            return
+        times.append(now)
+        with self._lock:
+            self._last_restart[r.idx] = now
+            self._backoff[r.idx] = min(
+                backoff * 2.0, self.serving.fleet_restart_backoff_max_s)
+        self.telemetry.health("replica_restart", replica=r.idx,
+                              restarts=r.restarts,
+                              backoff_s=round(backoff, 3))
+        # a restart rebuilds from the ORIGINAL weights: the fresh
+        # incarnation is NOT on any rolled-out generation (clear the
+        # old incarnation's mark), so sync re-reloads the fleet's
+        # desired checkpoint before it takes traffic
+        self._replica_gen.pop(r.idx, None)
+        if not self._sync_checkpoint(r):
+            with self._lock:
+                if r.state == "live":
+                    r.state = "ejected"
+
+    def _check_quorum(self) -> None:
+        live = self.live_count()
+        degraded = live < self.quorum
+        if degraded and not self._was_degraded:
+            self.telemetry.health("fleet_degraded", live=live,
+                                  total=len(self.replicas),
+                                  quorum=self.quorum)
+        self._was_degraded = degraded
+
+    # -- drain-and-replace ---------------------------------------------------
+
+    def drain_and_replace(self, idx: int) -> bool:
+        """Gracefully recycle replica ``idx``: stop routing to it, wait
+        for in-flight work to finish, drain-stop, start fresh.  Zero
+        dropped requests by construction; returns False when the
+        replica was not live."""
+        r = self.replicas[idx]
+        with self._lock:
+            if r.state != "live":
+                return False
+            r.state = "draining"
+        self.telemetry.health("replica_drain", replica=r.idx)
+        deadline = time.monotonic() + self.serving.fleet_drain_timeout_s
+        while r.outstanding > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        r.stop(drain=True)
+        r.restarts += 1
+        r.start()
+        self.telemetry.health("replica_restart", replica=r.idx,
+                              restarts=r.restarts, backoff_s=0.0,
+                              reason="drain_replace")
+        # the fresh incarnation rebuilt from the original weights: put
+        # it on the fleet's desired checkpoint before it takes traffic
+        self._replica_gen.pop(r.idx, None)
+        if not self._sync_checkpoint(r):
+            with self._lock:
+                if r.state == "live":
+                    r.state = "ejected"
+        return True
+
+    # -- rolling reload ------------------------------------------------------
+
+    def rolling_reload(self, path: str) -> Dict[str, Any]:
+        """Fan a hot checkpoint reload fleet-wide, one replica at a
+        time: each replica leaves rotation only for its own validate +
+        swap (>= N-1 serving throughout).  A validation failure on the
+        FIRST replica aborts before any other replica is touched; a
+        failure later rolls the already-swapped replicas back.  Raises
+        the failing replica's error (ReloadValidationError -> HTTP
+        409)."""
+        with self._rolling_lock:
+            targets = [r for r in self.replicas if r.state == "live"]
+            if not targets:
+                raise ReplicaDeadError("no live replicas to reload")
+            self.telemetry.health("rolling_reload_start",
+                                  replicas=len(targets))
+            done: List[Any] = []
+            report: Dict[str, Any] = {}
+            for r in targets:
+                with self._lock:
+                    if r.state != "live":
+                        continue
+                    r.state = "reloading"
+                try:
+                    report = r.reload(path)
+                except Exception as e:  # noqa: BLE001 — abort + roll back
+                    rolled = 0
+                    for d in reversed(done):
+                        if d.rollback():
+                            rolled += 1
+                    self.telemetry.health(
+                        "rolling_reload_rollback", replica=r.idx,
+                        swapped=len(done), rolled_back=rolled,
+                        error=str(e)[:200])
+                    raise
+                finally:
+                    with self._lock:
+                        if r.state == "reloading":
+                            r.state = "live"
+                done.append(r)
+            # the fleet's desired version from here on: replicas that
+            # restart (from the original weights) or rejoin later are
+            # re-reloaded onto it by _sync_checkpoint before they take
+            # traffic — no silent mixed-version fleet
+            self._reload_gen += 1
+            self._desired_ckpt = path
+            for d in done:
+                self._replica_gen[d.idx] = self._reload_gen
+            self.telemetry.health("rolling_reload_ok",
+                                  replicas=len(done),
+                                  step=report.get("step"))
+            return {"replicas": len(done), **report}
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        reps = [r.snapshot() for r in self.replicas]
+        by_state: Dict[str, int] = {}
+        for s in reps:
+            by_state[s["state"]] = by_state.get(s["state"], 0) + 1
+        live = by_state.get("live", 0)
+        # the autoscaling signal ROADMAP item 1 names: the sum of the
+        # per-replica drain-rate EWMAs is the fleet's measured service
+        # capacity in requests/second — scale out when offered load
+        # approaches it, in when it dwarfs the offered load
+        drain_sum = sum(float(s.get("drain_rate_rps") or 0.0)
+                        for s in reps)
+        cache = {k: sum(int((s.get("cache") or {}).get(k, 0))
+                        for s in reps)
+                 for k in ("hits", "misses", "warmup_compiles")}
+        return {
+            "replicas": reps,
+            "total": len(self.replicas),
+            "live": live,
+            "by_state": by_state,
+            "quorum": self.quorum,
+            "below_quorum": live < self.quorum,
+            "restarts_total": sum(int(s.get("restarts", 0)) for s in reps),
+            "drain_rate_rps_sum": round(drain_sum, 2),
+            # fleet-wide compile-cache totals: steady state must stay at
+            # zero misses across EVERY replica, restarts included
+            "cache": cache,
+        }
+
+
+def spawn_argv(config_path: str, logs_dir: str = "./logs/") -> Any:
+    """argv builder for subprocess replicas: each child is a plain
+    single-engine ``python -m hydragnn_tpu.serve`` bound to the port the
+    supervisor assigns."""
+    def build(port: int) -> List[str]:
+        return [sys.executable, "-m", "hydragnn_tpu.serve",
+                "--config", config_path, "--logs-dir", logs_dir,
+                "--host", "127.0.0.1", "--port", str(port)]
+
+    return build

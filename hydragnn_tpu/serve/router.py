@@ -1,0 +1,502 @@
+"""Failover router: one HTTP front end spreading load over the fleet.
+
+The client-facing half of the replica fleet (serve/fleet.py): requests
+are parsed/validated ONCE, dispatched to a replica chosen by
+least-outstanding-requests with power-of-two-choices (pick two live
+replicas at random, route to the one with fewer requests in flight —
+O(1) per request, provably near-optimal balance without a global
+queue), and, on replica failure, retried against a DIFFERENT replica
+under the request's existing deadline budget — /predict is idempotent,
+so failover is free of duplicate-effect hazards.
+
+Degradation ladder (the fleet contract, docs/SERVING.md):
+
+- **Any replica can serve it** -> 200.  A killed/hung/crashed replica
+  mid-request surfaces as a retryable error; the router fails over and
+  the client never sees it (zero 5xx under single-replica loss).
+- **Replica circuit-broken** -> ejected from routing (the supervisor
+  readmits it after the cooldown, making the next routed flush the
+  half-open probe); the request retries elsewhere.
+- **Whole fleet saturated** (every live replica shed or queue-full) ->
+  429 whose ``Retry-After`` is the MINIMUM surviving-replica drain
+  estimate — the soonest ANY replica will have capacity, not whichever
+  replica happened to be asked first.
+- **Fleet empty** (no live replicas at all) -> 503 + Retry-After.
+
+Aggregated observability: ``GET /healthz`` reports per-replica states
+and quorum; ``GET /metrics`` adds per-replica detail (breaker
+snapshots, restart counts, queue depths) plus fleet totals and the
+drain-rate EWMA SUM — the autoscaling signal (ROADMAP item 1).
+``POST /reload`` performs the rolling one-replica-at-a-time fleet
+reload with first-replica rollback (FleetSupervisor.rolling_reload).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+# py3.10: concurrent.futures.TimeoutError is not yet the builtin one
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Any, Dict, List, Optional
+
+from hydragnn_tpu.resilience.breaker import BreakerOpenError
+from hydragnn_tpu.serve.batcher import (
+    BatcherClosedError,
+    DeadlineExpiredError,
+    PredictTimeoutError,
+    QueueFullError,
+    RequestShedError,
+)
+from hydragnn_tpu.serve.config import ServingConfig
+from hydragnn_tpu.serve.fleet import (
+    FleetSupervisor,
+    PredictRequest,
+    ReplicaDeadError,
+)
+from hydragnn_tpu.serve.server import (
+    JsonRequestHandler,
+    _BodyTooLarge,
+    extract_deadline_s,
+    reload_request_denied,
+    sample_from_json,
+)
+
+
+class FleetSaturatedError(RequestShedError):
+    """Every live replica shed the request (HTTP 429).  ``retry_after_s``
+    is the MINIMUM drain estimate across the surviving replicas — the
+    soonest any of them expects capacity."""
+
+
+class FleetEmptyError(RuntimeError):
+    """No live replicas at all (HTTP 503 — the only fleet 5xx)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(1.0, float(retry_after_s))
+
+
+class FleetRouter:
+    """HTTP front end + failover dispatch over a FleetSupervisor.
+
+    ``cfg``/``pbc`` enable local request validation and in-process
+    dispatch (required for InProcessReplica fleets; for subprocess
+    fleets they are optional — without them the router forwards raw
+    bodies and lets replicas validate).
+    """
+
+    def __init__(self, fleet: FleetSupervisor,
+                 serving: Optional[ServingConfig] = None,
+                 cfg=None, pbc: bool = False, telemetry=None,
+                 request_timeout_s: float = 30.0):
+        self.fleet = fleet
+        self.serving = serving or fleet.serving
+        self.telemetry = telemetry if telemetry is not None \
+            else fleet.telemetry
+        self.cfg = cfg
+        self.pbc = bool(pbc)
+        inproc = fleet.replicas[0].kind == "inprocess"
+        if inproc and cfg is None:
+            raise ValueError(
+                "an in-process fleet needs the model config for request "
+                "parsing: pass cfg=engine.cfg")
+        self._parse = cfg is not None
+        self.request_timeout_s = float(request_timeout_s)
+        self._rng = random.Random(0x5EED)
+        self._lock = threading.Lock()
+        self._n: Dict[str, int] = {
+            "requests": 0, "responses_200": 0, "failovers": 0,
+            "shed_attempts": 0, "saturated_429": 0, "empty_503": 0,
+            "errors": 0}
+        self._per_replica: Dict[int, int] = {}
+        self._was_empty = False
+        self._t0 = time.time()
+        # bind in the constructor (same contract as InferenceServer):
+        # the ephemeral port is known before start(), and a request
+        # racing fleet startup just sees an empty fleet (503)
+        self.httpd = self._build_httpd()
+        self.port: int = int(self.httpd.server_address[1])
+        self._serve_thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- replica selection ---------------------------------------------------
+
+    def _pick(self, cands: List[Any]):
+        """Power-of-two-choices over outstanding counts; ``sample``
+        randomizes the pair order, so ties break randomly too."""
+        if len(cands) == 1:
+            return cands[0]
+        a, b = self._rng.sample(cands, 2)
+        return a if a.outstanding <= b.outstanding else b
+
+    def _empty_retry_after(self) -> float:
+        # a dead fleet usually comes back within one restart backoff +
+        # startup; there is no measured drain rate to do better with
+        return max(1.0, self.fleet.serving.fleet_restart_backoff_s)
+
+    # -- failover dispatch ---------------------------------------------------
+
+    def route_predict(self, req: PredictRequest,
+                      deadline_s: Optional[float]) -> Dict[str, Any]:
+        """Dispatch with failover: try replicas (po2, least-outstanding)
+        until one answers, a terminal client error surfaces, the
+        request's deadline budget runs out, every live replica shed it
+        (:class:`FleetSaturatedError` -> 429 with the MIN surviving
+        drain estimate), or none remain (:class:`FleetEmptyError` ->
+        503).  Returns ``{"heads": ..., "replica": idx}``."""
+        deadline_abs = None if deadline_s is None \
+            else time.perf_counter() + deadline_s
+        tried: set = set()
+        shed_estimates: List[float] = []
+        last_exc: Optional[Exception] = None
+        with self._lock:
+            self._n["requests"] += 1
+        while True:
+            live = self.fleet.routable()
+            if not live:
+                with self._lock:
+                    self._n["empty_503"] += 1
+                    first = not self._was_empty
+                    self._was_empty = True
+                if first:
+                    self.telemetry.health("fleet_empty",
+                                          total=len(self.fleet.replicas))
+                raise FleetEmptyError(
+                    "no live replicas — the fleet is restarting or gone",
+                    retry_after_s=self._empty_retry_after())
+            self._was_empty = False
+            cands = [r for r in live if r.idx not in tried]
+            if not cands:
+                # every live replica was tried: saturation (429) when
+                # they shed, otherwise surface the last real failure
+                if shed_estimates:
+                    with self._lock:
+                        self._n["saturated_429"] += 1
+                    raise FleetSaturatedError(
+                        f"all {len(live)} live replicas shed the request",
+                        retry_after_s=min(shed_estimates))
+                with self._lock:
+                    self._n["errors"] += 1
+                raise last_exc if last_exc is not None else RuntimeError(
+                    "no replica could serve the request")
+            remaining: Optional[float] = None
+            if deadline_abs is not None:
+                remaining = deadline_abs - time.perf_counter()
+                if remaining <= 0:
+                    with self._lock:
+                        self._n["saturated_429"] += 1
+                    raise FleetSaturatedError(
+                        "deadline budget exhausted during failover",
+                        retry_after_s=min(shed_estimates)
+                        if shed_estimates else 1.0)
+            r = self._pick(cands)
+            tried.add(r.idx)
+            r.inc_outstanding()
+            try:
+                heads = r.predict(req, remaining)
+                with self._lock:
+                    self._n["responses_200"] += 1
+                    self._per_replica[r.idx] = \
+                        self._per_replica.get(r.idx, 0) + 1
+                return {"heads": heads, "replica": r.idx}
+            except DeadlineExpiredError as e:
+                # the request's own budget died in r's queue: there is
+                # nothing left to retry WITH — 429 now, min estimate
+                shed_estimates.append(e.retry_after_s)
+                with self._lock:
+                    self._n["saturated_429"] += 1
+                raise FleetSaturatedError(
+                    str(e), retry_after_s=min(shed_estimates)) from None
+            except RequestShedError as e:
+                # admission shed: THIS replica's backlog can't make the
+                # deadline — another replica's might
+                shed_estimates.append(e.retry_after_s)
+                with self._lock:
+                    self._n["shed_attempts"] += 1
+                continue
+            except QueueFullError:
+                shed_estimates.append(r.retry_after_s())
+                with self._lock:
+                    self._n["shed_attempts"] += 1
+                continue
+            except BreakerOpenError:
+                # circuit-broken replica: eject it (the supervisor
+                # readmits after cooldown) and fail over
+                self.fleet.eject(r, reason="breaker_open")
+                self._note_failover(r, "breaker_open")
+                continue
+            except (ReplicaDeadError, BatcherClosedError) as e:
+                # the replica died under us: stop routing to it,
+                # schedule its restart, retry elsewhere
+                self.fleet.mark_dead(r, reason="predict_failure")
+                self._note_failover(r, repr(e))
+                last_exc = e
+                continue
+            except PredictTimeoutError as e:
+                # its watchdog tripped (breaker already recorded the
+                # failure); the retry may still make the deadline
+                self._note_failover(r, "predict_timeout")
+                last_exc = e
+                continue
+            except (_FutureTimeout, TimeoutError):
+                # the replica never answered within budget + grace:
+                # failover; exhausted -> 504, not 500
+                self._note_failover(r, "result_timeout")
+                last_exc = PredictTimeoutError(
+                    "replica did not answer within the request budget")
+                continue
+            except (ValueError, FileNotFoundError):
+                # client error (subprocess replicas validate bodies
+                # themselves): not retryable, not a replica fault
+                raise
+            except Exception as e:  # noqa: BLE001 — engine failure
+                self._note_failover(r, repr(e))
+                last_exc = e
+                continue
+            finally:
+                r.dec_outstanding()
+
+    def _note_failover(self, r, why: str) -> None:
+        with self._lock:
+            self._n["failovers"] += 1
+        self.telemetry.health("fleet_retry", replica=r.idx,
+                              error=str(why)[:200])
+
+    # -- HTTP ----------------------------------------------------------------
+
+    def _build_httpd(self):
+        from http.server import ThreadingHTTPServer
+
+        class _RouterHTTPServer(ThreadingHTTPServer):
+            # the router fronts the WHOLE fleet's capacity, so bursts
+            # arrive N times harder than at a single server — the
+            # stdlib's listen backlog of 5 drops (RSTs) connections the
+            # fleet could happily serve
+            request_queue_size = 128
+
+        router = self
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path == "/healthz":
+                    self._reply(200, router.health())
+                elif self.path == "/metrics":
+                    self._reply(200, router.metrics())
+                else:
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):  # noqa: N802 — stdlib API
+                if self.path == "/reload":
+                    self._do_reload()
+                    return
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+                    return
+                t0 = time.perf_counter()
+                try:
+                    obj = self._read_json()
+                    deadline_s = extract_deadline_s(self.headers, obj)
+                    req = router.build_request(obj)
+                except _BodyTooLarge as e:
+                    self._reply(413, {"error": str(e)})
+                    return
+                except (ValueError, TypeError, IndexError, KeyError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                if deadline_s is None \
+                        and router.serving.request_deadline_ms > 0:
+                    # apply the server default AT THE ROUTER: failover
+                    # needs the budget to ration retries against
+                    deadline_s = router.serving.request_deadline_ms / 1e3
+                try:
+                    out = router.route_predict(req, deadline_s)
+                except FleetEmptyError as e:
+                    self._reply(503, {"error": str(e), "fleet": "empty"},
+                                headers=self._retry_after(e.retry_after_s))
+                    return
+                except FleetSaturatedError as e:
+                    self._reply(429, {"error": str(e)},
+                                headers=self._retry_after(e.retry_after_s))
+                    return
+                except RequestShedError as e:
+                    self._reply(429, {"error": str(e)},
+                                headers=self._retry_after(e.retry_after_s))
+                    return
+                except BreakerOpenError as e:
+                    self._reply(503, {"error": str(e), "breaker": "open"},
+                                headers=self._retry_after(e.retry_after_s))
+                    return
+                except PredictTimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    from hydragnn_tpu.serve.engine import \
+                        BucketOverflowError
+
+                    if isinstance(e, BucketOverflowError):
+                        self._reply(413, {"error": str(e)})
+                    elif isinstance(e, (ValueError, FileNotFoundError)):
+                        self._reply(400, {"error": str(e)})
+                    elif isinstance(e, TimeoutError):
+                        self._reply(504, {"error": "request timed out"})
+                    else:
+                        self._reply(500, {"error": repr(e)})
+                    return
+                self._reply(200, {
+                    **out,
+                    "num_nodes": int(req.num_nodes),
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3),
+                })
+
+            def _do_reload(self) -> None:
+                try:
+                    obj = self._read_json()
+                    path = obj.get("checkpoint") \
+                        if isinstance(obj, dict) else None
+                    if not path or not isinstance(path, str):
+                        self._reply(400, {
+                            "error": "reload body needs "
+                                     "{\"checkpoint\": \"path\"}"})
+                        return
+                except _BodyTooLarge:
+                    self._reply(413, {"error": "reload body too large"})
+                    return
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                # the single server's trust boundary, one implementation
+                denied = reload_request_denied(path, router.serving,
+                                               self.client_address[0])
+                if denied:
+                    self._reply(403, {"error": denied})
+                    return
+                from hydragnn_tpu.serve.engine import ReloadValidationError
+
+                try:
+                    report = router.fleet.rolling_reload(path)
+                except FileNotFoundError:
+                    self._reply(404, {"error": f"no checkpoint at {path}"})
+                    return
+                except ReloadValidationError as e:
+                    self._reply(409, {"status": "rolled_back",
+                                      "error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": repr(e)})
+                    return
+                self._reply(200, {"status": "ok", **report})
+
+        return _RouterHTTPServer(
+            (self.serving.host, int(self.serving.port)), Handler)
+
+    def build_request(self, obj: Dict[str, Any]) -> PredictRequest:
+        """Parse/validate once at the router (in-process fleets), or
+        package the raw body for proxying (subprocess fleets)."""
+        if self._parse:
+            sample = sample_from_json(
+                obj, self.cfg,
+                edge_length_norm=self.serving.edge_length_norm,
+                pbc=self.pbc,
+                build_max_neighbours=self.serving.edge_build_max_neighbours)
+            body = None
+            if self.fleet.replicas[0].kind == "subprocess":
+                body = json.dumps(obj).encode()
+            return PredictRequest(sample=sample, body=body,
+                                  num_nodes=int(sample.num_nodes))
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        n = len(obj.get("x") or ())
+        return PredictRequest(body=json.dumps(obj).encode(), num_nodes=n)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        """Start the replicas (supervised), then accept traffic."""
+        self.fleet.start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fleet-router",
+            daemon=True)
+        self._serve_thread.start()
+        self.telemetry.health("serve_start", port=self.port,
+                              replicas=len(self.fleet.replicas))
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._serve_thread is not None:
+            # shutdown() handshakes with serve_forever — calling it
+            # with no serve loop running would block forever
+            self.httpd.shutdown()
+            self._serve_thread.join(timeout=5.0)
+        self.httpd.server_close()
+        self.fleet.stop(drain=drain)
+        self.telemetry.health("serve_drain", drained=bool(drain))
+
+    def run(self, poll_s: float = 0.05) -> None:
+        """Blocking serve loop with the shared SIGTERM/SIGINT graceful
+        drain (resilience/preempt.py) — same contract as the single
+        server's run()."""
+        from hydragnn_tpu.resilience import PreemptionHandler
+
+        handler = PreemptionHandler(cross_rank=False).install()
+        try:
+            # start() inside the try: a replica failing to come up must
+            # still tear the rest down (FleetSupervisor.start cleans its
+            # own partial state; shutdown() handles the never-started
+            # serve thread)
+            self.start()
+            while not handler.poll():
+                time.sleep(poll_s)
+        finally:
+            handler.uninstall()
+            self.shutdown(drain=True)
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        snap = self.fleet.snapshot()
+        live, total = snap["live"], snap["total"]
+        status = "ok" if live == total else (
+            "empty" if live == 0 else "degraded")
+        return {
+            "status": status,
+            "uptime_s": round(time.time() - self._t0, 3),
+            "live": live,
+            "total": total,
+            "quorum": snap["quorum"],
+            "below_quorum": snap["below_quorum"],
+            "replicas": [{"replica": s["replica"], "state": s["state"],
+                          "restarts": s["restarts"]}
+                         for s in snap["replicas"]],
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        snap = self.fleet.snapshot()
+        with self._lock:
+            router = dict(self._n)
+            per_replica = {str(k): v
+                           for k, v in sorted(self._per_replica.items())}
+        cache = dict(snap["cache"])
+        total = cache["hits"] + cache["misses"]
+        cache["hit_rate"] = (cache["hits"] / total) if total else 1.0
+        return {
+            "uptime_s": round(time.time() - self._t0, 3),
+            "fleet": snap,
+            # fleet-aggregated cache totals under the same key the
+            # single server uses, so tools/servebench.py --url reads
+            # one shape from either front end
+            "engine": cache,
+            "router": {**router, "per_replica_200": per_replica},
+            # the autoscaling signal (ROADMAP item 1): fleet service
+            # capacity as the sum of per-replica drain-rate EWMAs
+            "autoscale": {"signal": "drain_rate_rps_sum",
+                          "value": snap["drain_rate_rps_sum"],
+                          "live": snap["live"]},
+            "health_events": self.telemetry.health_counts,
+        }

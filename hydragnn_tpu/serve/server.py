@@ -38,6 +38,11 @@ Endpoints:
   unpickling a client-named path is code execution, so non-loopback
   clients are refused (403) unless ``Serving.reload_root`` allowlists a
   checkpoint directory the path must resolve into.
+- ``POST /rollback`` — restore the retained pre-reload state (the
+  manual spelling of the probation rollback; the fleet supervisor uses
+  it to roll already-swapped subprocess replicas back when a later
+  replica rejects a rolling-reload candidate).  409 when nothing is
+  retained; same trust boundary as ``/reload``.
 - ``GET /healthz`` — liveness + warmup state; ``status`` degrades to
   ``"degraded"`` while the circuit breaker is open/half-open.
 - ``GET /metrics`` — engine compile-cache stats, batcher stats
@@ -93,6 +98,92 @@ class _BodyTooLarge(ValueError):
     def __init__(self, n: int):
         super().__init__(f"body of {n} bytes over the cap")
         self.n = n
+
+
+def reload_request_denied(path: str, serving,
+                          client_ip: str) -> Optional[str]:
+    """The /reload trust boundary, shared by the single server and the
+    fleet router (serve/router.py): ``pickle.load`` of a client-named
+    path is code execution, so non-loopback clients may only name paths
+    resolving inside the allowlisted ``Serving.reload_root`` (without
+    one, reload is loopback-only).  Returns the 403 error string, or
+    None when the request is allowed — ONE implementation, so a future
+    hardening reaches every front end."""
+    root = serving.reload_root
+    if root:
+        real = os.path.realpath(path)
+        if not real.startswith(os.path.realpath(root) + os.sep):
+            return (f"checkpoint path outside the allowlisted "
+                    f"reload_root {root}")
+        return None
+    if client_ip not in ("127.0.0.1", "::1"):
+        return ("reload is loopback-only unless Serving.reload_root "
+                "allowlists a checkpoint directory")
+    return None
+
+
+def extract_deadline_s(headers, obj) -> Optional[float]:
+    """Per-request deadline from the transport: the ``X-Timeout-Ms``
+    header wins over the ``timeout_ms`` body field; absent -> None (the
+    batcher's configured default applies).  NOTE client semantics differ
+    from the server knob: a client that wants NO deadline omits the
+    field (timeout_ms=0 means zero tolerance -> immediate shed), while
+    ``Serving.request_deadline_ms=0`` disables the server default.
+    Raises ValueError on a negative value (HTTP layer: 400, not a
+    silent clamp).  Shared by the single server's handler and the fleet
+    router (serve/router.py) so both spellings behave identically at
+    every layer."""
+    tmo = headers.get("X-Timeout-Ms")
+    if tmo is None and isinstance(obj, dict):
+        tmo = obj.get("timeout_ms")
+    if tmo is None:
+        return None
+    deadline_s = float(tmo) / 1e3
+    if deadline_s < 0:
+        raise ValueError("timeout_ms must be >= 0 (omit it for the "
+                         "server default deadline)")
+    return deadline_s
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared JSON-over-HTTP handler plumbing (bounded body reads,
+    JSON replies, Retry-After headers, quiet logging) — the base of the
+    single server's handler below AND the fleet router's
+    (serve/router.py)."""
+
+    # socket timeout: a client declaring Content-Length N but sending
+    # fewer bytes must not pin its handler thread (and fd) forever —
+    # the stdlib catches socket.timeout and reaps the connection
+    timeout = 30.0
+
+    # quiet: no per-request stderr lines (telemetry carries the
+    # signal); override to keep test output clean
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def _reply(self, code: int, payload: Dict[str, Any],
+               headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _retry_after(self, seconds: float) -> Dict[str, str]:
+        return {"Retry-After": str(max(1, math.ceil(seconds)))}
+
+    def _read_json(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0))
+        if n < 0:
+            # rfile.read(-1) would read until EOF — the unbounded
+            # buffering the cap exists to prevent
+            raise ValueError("invalid Content-Length")
+        if n > MAX_REQUEST_BYTES:
+            raise _BodyTooLarge(n)
+        return json.loads(self.rfile.read(n) or b"{}")
 
 
 def sample_from_json(obj: Dict[str, Any], cfg,
@@ -250,42 +341,7 @@ class InferenceServer:
         self._t0 = time.time()
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            # socket timeout: a client declaring Content-Length N but
-            # sending fewer bytes must not pin its handler thread (and
-            # fd) forever — the stdlib catches socket.timeout and reaps
-            # the connection
-            timeout = 30.0
-
-            # quiet: no per-request stderr lines (telemetry carries the
-            # signal); override to keep test output clean
-            def log_message(self, fmt, *args):  # noqa: A003
-                pass
-
-            def _reply(self, code: int, payload: Dict[str, Any],
-                       headers: Optional[Dict[str, str]] = None) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _retry_after(self, seconds: float) -> Dict[str, str]:
-                return {"Retry-After": str(max(1, math.ceil(seconds)))}
-
-            def _read_json(self) -> Dict[str, Any]:
-                n = int(self.headers.get("Content-Length", 0))
-                if n < 0:
-                    # rfile.read(-1) would read until EOF — the
-                    # unbounded buffering the cap exists to prevent
-                    raise ValueError("invalid Content-Length")
-                if n > MAX_REQUEST_BYTES:
-                    raise _BodyTooLarge(n)
-                return json.loads(self.rfile.read(n) or b"{}")
-
+        class Handler(JsonRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib API
                 if self.path == "/healthz":
                     self._reply(200, server.health())
@@ -298,29 +354,16 @@ class InferenceServer:
                 if self.path == "/reload":
                     self._do_reload()
                     return
+                if self.path == "/rollback":
+                    self._do_rollback()
+                    return
                 if self.path != "/predict":
                     self._reply(404, {"error": f"unknown path {self.path}"})
                     return
                 t0 = time.perf_counter()
                 try:
                     obj = self._read_json()
-                    # per-request deadline: header wins over body field,
-                    # absent -> the batcher's configured default.  NOTE
-                    # client semantics differ from the server knob: a
-                    # client that wants NO deadline omits the field
-                    # (timeout_ms=0 means zero tolerance -> immediate
-                    # shed), while Serving.request_deadline_ms=0
-                    # disables the server default
-                    tmo = self.headers.get("X-Timeout-Ms")
-                    if tmo is None and isinstance(obj, dict):
-                        tmo = obj.get("timeout_ms")
-                    deadline_s = None
-                    if tmo is not None:
-                        deadline_s = float(tmo) / 1e3
-                        if deadline_s < 0:
-                            raise ValueError(
-                                "timeout_ms must be >= 0 (omit it for "
-                                "the server default deadline)")
+                    deadline_s = extract_deadline_s(self.headers, obj)
                     sample = sample_from_json(
                         obj, server.engine.cfg,
                         edge_length_norm=server.serving.edge_length_norm,
@@ -380,6 +423,29 @@ class InferenceServer:
                     "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
                 })
 
+            def _do_rollback(self) -> None:
+                """Restore the retained pre-reload state (the manual
+                spelling of the breaker-probation rollback).  Control
+                surface like /reload: loopback-only unless a reload_root
+                is configured (a remote caller allowed to reload may
+                also un-reload).  409 when there is nothing retained.
+                The fleet supervisor uses this to roll already-swapped
+                SUBPROCESS replicas back when a later replica rejects a
+                rolling-reload candidate (serve/fleet.py)."""
+                if not server.serving.reload_root \
+                        and self.client_address[0] not in ("127.0.0.1",
+                                                           "::1"):
+                    self._reply(403, {
+                        "error": "rollback is loopback-only unless "
+                                 "Serving.reload_root is configured"})
+                    return
+                if server.engine.rollback(reason="api"):
+                    self._reply(200, {"status": "rolled_back"})
+                else:
+                    self._reply(409, {
+                        "error": "nothing to roll back: no previous "
+                                 "state is retained"})
+
             def _do_reload(self) -> None:
                 try:
                     obj = self._read_json()
@@ -396,24 +462,10 @@ class InferenceServer:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._reply(400, {"error": str(e)})
                     return
-                # trust boundary: pickle.load of a client-named path is
-                # code execution.  Non-loopback clients may only name
-                # paths inside the allowlisted Serving.reload_root;
-                # without one, /reload is loopback-only.
-                root = server.serving.reload_root
-                if root:
-                    real = os.path.realpath(path)
-                    if not real.startswith(
-                            os.path.realpath(root) + os.sep):
-                        self._reply(403, {
-                            "error": f"checkpoint path outside the "
-                                     f"allowlisted reload_root {root}"})
-                        return
-                elif self.client_address[0] not in ("127.0.0.1", "::1"):
-                    self._reply(403, {
-                        "error": "reload is loopback-only unless "
-                                 "Serving.reload_root allowlists a "
-                                 "checkpoint directory"})
+                denied = reload_request_denied(path, server.serving,
+                                               self.client_address[0])
+                if denied:
+                    self._reply(403, {"error": denied})
                     return
                 try:
                     report = server.reload(path)
@@ -547,8 +599,7 @@ class InferenceServer:
         breaker = self.breaker.snapshot()
         # the breaker only degrades /healthz when it actually gates
         # traffic (threshold 0 = disabled)
-        degraded = self.breaker.threshold > 0 \
-            and breaker["state"] != "closed"
+        degraded = self.breaker.degraded
         quant = self.engine.quant_stats()
         return {
             "status": "degraded" if degraded else "ok",

@@ -1,0 +1,724 @@
+"""Fault-tolerant replica fleet (docs/SERVING.md "Replica fleet"):
+power-of-two-choices routing balance, failover retry on replica death
+under the request deadline (the chaos-kill acceptance: zero 5xx while a
+replica is SIGKILLed and auto-restarted), breaker-driven ejection +
+readmission, crash restart with exponential backoff and the
+restart-storm cap, graceful drain-and-replace with zero drops, rolling
+fleet reload with first-replica rollback, fleet-aggregated
+/healthz + /metrics (drain-rate EWMA sum as the autoscaling signal),
+minimum-surviving-replica Retry-After propagation, and the
+HYDRAGNN_CHAOS_REPLICA_* knob parsing.
+
+Tier-1 budget discipline: ONE tiny SAGE engine with ONE bucket is
+compiled once for the whole module; every replica is an
+``engine.fork()`` sharing that compile cache, so fleets (and replica
+restarts) cost milliseconds.
+"""
+
+import json
+import pickle
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.resilience import FleetChaos, ServeChaos
+from hydragnn_tpu.serve import (
+    FleetRouter,
+    FleetSupervisor,
+    InProcessReplica,
+    InferenceEngine,
+    InferenceState,
+    ServingConfig,
+)
+from hydragnn_tpu.serve.batcher import RequestShedError
+from hydragnn_tpu.serve.fleet import ReplicaDeadError
+from hydragnn_tpu.serve.router import FleetSaturatedError
+
+
+def _sample(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    pos = rng.rand(n, 3).astype(np.float32) * 2.0
+    return GraphSample(x=rng.rand(n, 1).astype(np.float32), pos=pos,
+                       edge_index=radius_graph(pos, 1.2, 8))
+
+
+_HEADS = [HeadSpec("energy", "graph", 1)]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One tiny SAGE engine, ONE bucket, compiled once for the module;
+    all fleet replicas fork it (shared executable cache)."""
+    import jax
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    pads = [PadSpec.for_batch(4, 16, 64)]
+    example = collate([_sample()], pads[0], _HEADS)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    eng = InferenceEngine(cfg, state, _HEADS, pads)
+    eng.warmup()
+    return eng
+
+
+class _Tel:
+    """Recording telemetry stub for the SUPERVISOR (replicas use the
+    disabled MetricsLogger): keeps the (kind, fields) stream so tests
+    can assert on event reasons, not just counts."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def health(self, kind, **fields):
+        with self._lock:
+            self.events.append((kind, fields))
+
+    @property
+    def health_counts(self):
+        with self._lock:
+            out = {}
+            for k, _ in self.events:
+                out[k] = out.get(k, 0) + 1
+            return out
+
+    def kinds(self, kind):
+        with self._lock:
+            return [f for k, f in self.events if k == kind]
+
+
+def _mk_router(engine, n=3, fleet_chaos=None, chaos_factories=None,
+               start=True, **overrides):
+    kw = dict(port=0, max_wait_ms=2, request_deadline_ms=10_000.0,
+              breaker_threshold=2, breaker_cooldown_s=0.25,
+              predict_timeout_s=5.0, fleet_probe_s=0.03,
+              fleet_restart_backoff_s=0.05,
+              fleet_restart_backoff_max_s=0.4, fleet_max_restarts=6,
+              fleet_restart_window_s=30.0, fleet_drain_timeout_s=5.0)
+    kw.update(overrides)
+    serving = ServingConfig(**kw)
+    tel = _Tel()
+    cf = chaos_factories or {}
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    replicas = [
+        InProcessReplica(i, engine.fork, serving,
+                         MetricsLogger.disabled(),
+                         chaos_factory=cf.get(i))
+        for i in range(n)
+    ]
+    fleet = FleetSupervisor(replicas, serving, telemetry=tel,
+                            chaos=fleet_chaos)
+    router = FleetRouter(fleet, serving=serving, cfg=engine.cfg,
+                         telemetry=tel)
+    if start:
+        router.start()
+    return router
+
+
+def _wait_until(cond, timeout=10.0, step=0.01):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _post(port, path, obj, timeout=30.0, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _sample_json(s, **extra):
+    return {"x": s.x.tolist(), "pos": s.pos.tolist(),
+            "edge_index": s.edge_index.tolist(), **extra}
+
+
+# ---------------------------------------------------------------------------
+# Routing + aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_routing_balance_and_aggregated_metrics(engine):
+    """po2 least-outstanding routing spreads 200s across ALL replicas,
+    and /healthz + /metrics aggregate per-replica state, breaker
+    snapshots, restart counts, fleet totals, and the drain-rate EWMA sum
+    (the autoscaling signal)."""
+    router = _mk_router(engine, n=3)
+    try:
+        for i in range(30):
+            code, out = _post(router.port, "/predict",
+                              _sample_json(_sample(5, seed=i)))
+            assert code == 200
+            assert len(out["heads"]["energy"]) == 1
+            assert out["replica"] in (0, 1, 2)
+        h = _get(router.port, "/healthz")
+        assert h["status"] == "ok"
+        assert h["live"] == h["total"] == 3
+        assert h["quorum"] == 2 and not h["below_quorum"]
+        assert [r["state"] for r in h["replicas"]] == ["live"] * 3
+        m = _get(router.port, "/metrics")
+        per = m["router"]["per_replica_200"]
+        # po2 over 3 replicas gives each ~1/3 of 30 requests; a replica
+        # with ZERO dispatches means routing is broken, not unlucky
+        # (P(zero) ~ 5e-6)
+        assert set(per) == {"0", "1", "2"}
+        assert all(v > 0 for v in per.values())
+        assert sum(per.values()) == m["router"]["responses_200"] == 30
+        fl = m["fleet"]
+        assert fl["live"] == fl["total"] == 3
+        assert fl["by_state"] == {"live": 3}
+        assert len(fl["replicas"]) == 3
+        for s in fl["replicas"]:
+            assert s["breaker"]["state"] == "closed"
+            assert s["restarts"] == 0
+        # the autoscaling signal: sum of per-replica drain-rate EWMAs,
+        # positive once flushes have run
+        assert m["autoscale"]["signal"] == "drain_rate_rps_sum"
+        assert m["autoscale"]["value"] > 0
+        assert m["fleet"]["drain_rate_rps_sum"] == m["autoscale"]["value"]
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Failover: replica death under load (the chaos-kill acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_kill_zero_5xx_and_auto_restart(engine):
+    """With 3 replicas serving concurrent load, a hard kill of one
+    (the SIGKILL analog: in-flight work FAILS, no drain) yields ZERO
+    non-200 responses — in-flight requests are retried on another
+    replica within their deadline — and the supervisor restarts and
+    re-admits the dead replica automatically."""
+    router = _mk_router(engine, n=3)
+    fleet = router.fleet
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def client(wid):
+        for i in range(8):
+            try:
+                code, out = _post(router.port, "/predict",
+                                  _sample_json(_sample(5, seed=wid * 31 + i),
+                                               timeout_ms=10_000))
+                with lock:
+                    results.append(code)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    errors.append(e.code)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+
+    try:
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        victim = fleet.replicas[1]
+        victim.kill()  # SIGKILL analog: no drain, in-flight fails
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 32 and all(c == 200 for c in results)
+        # the supervisor restarts and re-admits the victim
+        assert _wait_until(lambda: victim.state == "live", timeout=10)
+        assert victim.restarts == 1
+        counts = router.telemetry.health_counts
+        assert counts.get("replica_dead", 0) >= 1
+        assert counts.get("replica_restart", 0) >= 1
+        # and it serves again
+        assert _wait_until(
+            lambda: _post(router.port, "/predict",
+                          _sample_json(_sample(6, seed=99)))[0] == 200,
+            timeout=5)
+    finally:
+        router.shutdown()
+
+
+def test_in_flight_failover_is_deterministic(engine):
+    """Unit-level failover: a replica that dies UNDER a request (its
+    predict raises ReplicaDeadError) is marked dead and the request is
+    answered by a DIFFERENT replica — one retry, same budget."""
+    router = _mk_router(engine, n=2)
+    fleet = router.fleet
+    try:
+        r0 = fleet.replicas[0]
+
+        def dead_predict(req, deadline_s):
+            raise ReplicaDeadError("simulated mid-request death")
+
+        r0.predict = dead_predict
+        req = router.build_request(_sample_json(_sample(5, seed=7)))
+        # every request lands on replica 1 eventually, whatever po2 picks
+        for _ in range(4):
+            out = router.route_predict(req, deadline_s=10.0)
+            assert out["replica"] == 1
+        assert r0.state in ("dead", "restarting", "live")
+        m = router.metrics()
+        assert m["router"]["failovers"] >= 1
+        assert router.telemetry.health_counts.get("fleet_retry", 0) >= 1
+    finally:
+        router.shutdown()
+
+
+def test_fleet_chaos_kill_via_probe_ticks(engine):
+    """The HYDRAGNN_CHAOS_REPLICA_KILL path end-to-end: the supervisor
+    consults FleetChaos each probe tick, kills the armed replica, and
+    the fleet recovers on its own while requests keep flowing."""
+    chaos = FleetChaos(kill=[(2, False, 1)])  # kill replica 1 at tick 2
+    router = _mk_router(engine, n=3, fleet_chaos=chaos)
+    fleet = router.fleet
+    try:
+        assert _wait_until(lambda: chaos.injected["kill"] == 1, timeout=5)
+        assert _wait_until(
+            lambda: fleet.replicas[1].restarts == 1
+            and fleet.replicas[1].state == "live", timeout=10)
+        for i in range(6):
+            code, _ = _post(router.port, "/predict",
+                            _sample_json(_sample(5, seed=40 + i)))
+            assert code == 200
+        counts = router.telemetry.health_counts
+        assert counts.get("replica_dead", 0) >= 1
+        assert counts.get("replica_restart", 0) >= 1
+        dead = router.telemetry.kinds("replica_dead")
+        assert any(f.get("reason") == "chaos_kill" for f in dead)
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Breaker-driven ejection + readmission
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_ejection_and_readmission(engine):
+    """A replica whose predict path persistently fails trips ITS OWN
+    breaker: the router fails over (clients see 200s, never 5xx), the
+    supervisor ejects the replica from routing, and once the cooldown
+    elapses it is readmitted — the next routed flush is the half-open
+    probe, which (chaos now disarmed) closes the breaker."""
+    # replica 0's first 3 flushes raise; breaker threshold 2 trips it
+    router = _mk_router(
+        engine, n=2,
+        chaos_factories={0: lambda: ServeChaos(fail_steps={1, 2, 3})})
+    fleet = router.fleet
+    r0 = fleet.replicas[0]
+    try:
+        # keep offering load until replica 0 has failed enough to eject
+        def pump(i):
+            code, _ = _post(router.port, "/predict",
+                            _sample_json(_sample(5, seed=60 + i),
+                                         timeout_ms=10_000))
+            assert code == 200
+
+        i = 0
+        while r0.state != "ejected" and i < 200:
+            pump(i)
+            i += 1
+        assert r0.state == "ejected", \
+            f"never ejected after {i} requests ({r0.breaker.snapshot()})"
+        assert router.telemetry.health_counts.get("replica_eject", 0) >= 1
+        # readmission after the cooldown; the half-open probe flush may
+        # burn the last chaos failure, so keep pumping until it closes
+        assert _wait_until(
+            lambda: r0.state in ("live", "ejected"), timeout=5)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            pump(i)
+            i += 1
+            if r0.state == "live" and r0.breaker.state == "closed" \
+                    and r0.chaos.inner.injected_failures >= 3:
+                break
+        assert r0.breaker.state == "closed"
+        assert r0.state == "live"
+        assert router.telemetry.health_counts.get("replica_readmit", 0) >= 1
+        assert r0.chaos.inner.injected_failures == 3
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Restart backoff + storm cap
+# ---------------------------------------------------------------------------
+
+
+def test_restart_backoff_and_storm_cap(engine):
+    """Each crash doubles the restart backoff; more than
+    fleet_max_restarts restarts inside the window marks the replica
+    FAILED (no more restart attempts — a crash loop must not burn the
+    fleet's attention forever) while the rest keep serving."""
+    router = _mk_router(engine, n=2, fleet_max_restarts=2,
+                        fleet_restart_backoff_s=0.05,
+                        fleet_restart_backoff_max_s=0.2)
+    fleet = router.fleet
+    r1 = fleet.replicas[1]
+    try:
+        for k in range(1, 3):
+            r1.kill()
+            assert _wait_until(
+                lambda: r1.state == "live" and r1.restarts == k,
+                timeout=10), f"restart {k} never happened"
+        # backoff grew beyond the base across consecutive crashes
+        assert fleet._backoff[r1.idx] > fleet._base_backoff
+        # third crash exceeds the cap (2 restarts already in window)
+        r1.kill()
+        assert _wait_until(lambda: r1.state == "failed", timeout=10)
+        ejects = router.telemetry.kinds("replica_eject")
+        assert any(f.get("reason") == "restart_storm" for f in ejects)
+        # no further restarts, and the fleet keeps serving on replica 0
+        assert r1.restarts == 2
+        code, _ = _post(router.port, "/predict",
+                        _sample_json(_sample(5, seed=77)))
+        assert code == 200
+        h = _get(router.port, "/healthz")
+        assert h["status"] == "degraded" and h["live"] == 1
+        # below majority quorum (1 < 2) -> the teleview WARNING signal
+        assert h["below_quorum"]
+        assert router.telemetry.health_counts.get("fleet_degraded", 0) >= 1
+    finally:
+        router.shutdown()
+
+
+def test_fleet_empty_503_only_when_no_replica_remains(engine):
+    """503 is reserved for a truly EMPTY fleet: with restarts disabled
+    (fleet_max_restarts=0) and every replica killed, /predict answers
+    503 + Retry-After and /healthz reports status empty."""
+    router = _mk_router(engine, n=2, fleet_max_restarts=0)
+    fleet = router.fleet
+    try:
+        for r in fleet.replicas:
+            r.kill()
+        assert _wait_until(
+            lambda: all(r.state == "failed" for r in fleet.replicas),
+            timeout=10)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict", _sample_json(_sample(5, seed=3)))
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["fleet"] == "empty"
+        assert _get(router.port, "/healthz")["status"] == "empty"
+        assert router.metrics()["router"]["empty_503"] == 1
+        assert router.telemetry.health_counts.get("fleet_empty", 0) >= 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain-and-replace
+# ---------------------------------------------------------------------------
+
+
+def test_drain_and_replace_zero_drop(engine):
+    """drain_and_replace recycles a live replica with ZERO dropped
+    requests: routing stops first, in-flight work finishes, the batcher
+    drains, and a fresh incarnation rejoins."""
+    router = _mk_router(engine, n=2)
+    fleet = router.fleet
+    results, errors = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                code, _ = _post(router.port, "/predict",
+                                _sample_json(_sample(5, seed=200 + i)))
+                with lock:
+                    results.append(code)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+            i += 1
+
+    try:
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.05)
+        assert fleet.drain_and_replace(0) is True
+        time.sleep(0.05)
+        stop.set()
+        t.join(timeout=30)
+        assert not errors, errors
+        assert results and all(c == 200 for c in results)
+        r0 = fleet.replicas[0]
+        assert r0.state == "live" and r0.restarts == 1
+        counts = router.telemetry.health_counts
+        assert counts.get("replica_drain", 0) == 1
+        assert counts.get("replica_restart", 0) >= 1
+        # a non-live replica refuses the drain (no double recycle)
+        r0.state = "ejected"
+        assert fleet.drain_and_replace(0) is False
+        r0.state = "live"
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Rolling fleet reload
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_reload_and_first_replica_rollback(engine, tmp_path):
+    """POST /reload fans the PR 5 hot-reload out one replica at a time:
+    a good candidate swaps into EVERY replica (bit-identical answers);
+    a corrupt candidate is rejected BY THE FIRST replica (409
+    rolled_back) without touching the rest, and the fleet keeps
+    serving."""
+    import jax
+
+    router = _mk_router(engine, n=2)
+    fleet = router.fleet
+    try:
+        s0 = _sample(6, seed=80)
+        code, base = _post(router.port, "/predict", _sample_json(s0))
+        assert code == 200
+
+        r0 = fleet.replicas[0]
+        copy_params = jax.tree_util.tree_map(np.asarray,
+                                             r0.engine.state.params)
+        copy_stats = jax.tree_util.tree_map(np.asarray,
+                                            r0.engine.state.batch_stats)
+        ck = tmp_path / "cand.pk"
+        with open(ck, "wb") as f:
+            pickle.dump({"step": 21, "params": copy_params,
+                         "batch_stats": copy_stats}, f)
+        code, out = _post(router.port, "/reload", {"checkpoint": str(ck)})
+        assert code == 200 and out["status"] == "ok"
+        assert out["replicas"] == 2 and out["step"] == 21
+        for r in fleet.replicas:
+            assert r.engine.reload_stats()["reloads"] == 1
+            assert r.state == "live"
+        # same weights -> bit-identical across the rolling swap
+        code, after = _post(router.port, "/predict", _sample_json(s0))
+        assert code == 200 and after["heads"] == base["heads"]
+        counts = router.telemetry.health_counts
+        assert counts.get("rolling_reload_start", 0) == 1
+        assert counts.get("rolling_reload_ok", 0) == 1
+
+        # corrupt candidate: NaN params fail the FIRST replica's golden
+        # replay -> 409 rolled_back, the rest untouched
+        bad = ServeChaos(reload_corrupt=1).on_reload_state(
+            InferenceState(step=22, params=copy_params,
+                           batch_stats=copy_stats))
+        bad_ck = tmp_path / "bad.pk"
+        with open(bad_ck, "wb") as f:
+            pickle.dump({"step": 22, "params": bad.params,
+                         "batch_stats": bad.batch_stats}, f)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/reload", {"checkpoint": str(bad_ck)})
+        assert ei.value.code == 409
+        assert json.loads(ei.value.read())["status"] == "rolled_back"
+        # exactly one replica saw (and rejected) the candidate; nobody
+        # swapped, nobody left rotation
+        fails = [r.engine.reload_stats()["reload_failures"]
+                 for r in fleet.replicas]
+        assert sorted(fails) == [0, 1]
+        assert all(r.engine.reload_stats()["reloads"] == 1
+                   for r in fleet.replicas)
+        assert all(r.state == "live" for r in fleet.replicas)
+        rb = router.telemetry.kinds("rolling_reload_rollback")
+        assert len(rb) == 1 and rb[0]["swapped"] == 0
+        code, after = _post(router.port, "/predict", _sample_json(s0))
+        assert code == 200 and after["heads"] == base["heads"]
+        # 404 for a missing checkpoint, fleet untouched
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/reload",
+                  {"checkpoint": str(tmp_path / "no.pk")})
+        assert ei.value.code == 404
+
+        # version-skew guard: a replica that CRASHES after the rolling
+        # reload restarts from the ORIGINAL weights — the supervisor
+        # must re-reload it onto the fleet's desired checkpoint before
+        # it takes traffic (no silent mixed-version fleet)
+        r1 = fleet.replicas[1]
+        r1.kill()
+        assert _wait_until(
+            lambda: r1.state == "live" and r1.restarts == 1
+            and int(np.asarray(r1.engine.state.step)) == 21, timeout=10), \
+            (r1.state, r1.restarts, int(np.asarray(r1.engine.state.step)))
+        assert r1.engine.reload_stats()["reloads"] == 1  # fresh fork, synced
+        code, after = _post(router.port, "/predict", _sample_json(s0))
+        assert code == 200 and after["heads"] == base["heads"]
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Retry-After propagation (satellite: min across surviving replicas)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_after_is_min_across_surviving_replicas(engine):
+    """When the router retries and ultimately sheds, the client's
+    Retry-After is the MINIMUM surviving-replica drain estimate — the
+    soonest ANY replica expects capacity — not whichever replica was
+    asked first."""
+    router = _mk_router(engine, n=3)
+    fleet = router.fleet
+    try:
+        estimates = {0: 7.0, 1: 3.0, 2: 5.0}
+        for r in fleet.replicas:
+            est = estimates[r.idx]
+
+            def shed(req, deadline_s, _est=est):
+                raise RequestShedError("backlog exceeds deadline",
+                                       retry_after_s=_est)
+
+            r.predict = shed
+        req = router.build_request(_sample_json(_sample(5, seed=5)))
+        with pytest.raises(FleetSaturatedError) as ei:
+            router.route_predict(req, deadline_s=30.0)
+        assert ei.value.retry_after_s == 3.0
+        # and over HTTP: 429 whose Retry-After is ceil(min estimate)
+        with pytest.raises(urllib.error.HTTPError) as http_ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(5, seed=6), timeout_ms=30_000))
+        assert http_ei.value.code == 429
+        assert int(http_ei.value.headers["Retry-After"]) == 3
+        assert router.metrics()["router"]["saturated_429"] >= 2
+    finally:
+        router.shutdown()
+
+
+def test_router_429_both_deadline_spellings(engine):
+    """PR 5's two 429 spellings hold at the ROUTER layer too: a zero
+    budget via the timeout_ms body field and via the X-Timeout-Ms
+    header both shed with 429 + Retry-After, and a sane deadline is
+    served."""
+    router = _mk_router(engine, n=2)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(5, seed=50), timeout_ms=0))
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(5, seed=51)),
+                  headers={"X-Timeout-Ms": "0"})
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        code, out = _post(router.port, "/predict",
+                          _sample_json(_sample(5, seed=52),
+                                       timeout_ms=10_000))
+        assert code == 200 and len(out["heads"]["energy"]) == 1
+        # negative budget is a client error at the router too
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(5, seed=53), timeout_ms=-5))
+        assert ei.value.code == 400
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Chaos knob parsing + fleet config knobs
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_chaos_env_parsing(monkeypatch):
+    assert FleetChaos.from_env() is None  # nothing armed
+    monkeypatch.setenv("HYDRAGNN_CHAOS_REPLICA_KILL", "3:1")
+    monkeypatch.setenv("HYDRAGNN_CHAOS_REPLICA_HANG", "5")
+    monkeypatch.setenv("HYDRAGNN_CHAOS_REPLICA_FLAP", "2+")
+    c = FleetChaos.from_env()
+    assert c.kill == [(3, False, 1)]
+    assert c.hang == [(5, False, None)]
+    assert c.flap == [(2, True, None)]
+    # tick semantics: nothing at 1; flap from 2 on; pinned kill at 3
+    assert c.on_probe() == []
+    assert c.on_probe() == [("flap", None)]
+    assert c.on_probe() == [("kill", 1), ("flap", None)]
+    assert c.on_probe() == [("flap", None)]
+    assert c.injected == {"kill": 1, "hang": 0, "flap": 3}
+    # config-dict spelling, env wins
+    monkeypatch.delenv("HYDRAGNN_CHAOS_REPLICA_HANG")
+    monkeypatch.delenv("HYDRAGNN_CHAOS_REPLICA_FLAP")
+    c = FleetChaos.from_env({"kill": "9", "hang": "4,6"})
+    assert c.kill == [(3, False, 1)]  # env beats the config dict
+    assert c.hang == [(4, False, None), (6, False, None)]
+
+
+def test_fleet_config_knobs_and_env(monkeypatch):
+    d = ServingConfig()
+    assert d.fleet_replicas == 0 and d.fleet_probe_s > 0
+    with pytest.raises(ValueError):
+        ServingConfig(fleet_replicas=-1)
+    with pytest.raises(ValueError):
+        ServingConfig(fleet_probe_s=0)
+    with pytest.raises(ValueError):
+        ServingConfig(fleet_restart_backoff_s=-1)
+    with pytest.raises(ValueError):
+        ServingConfig(fleet_replicas=2, fleet_quorum=3)
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET", "3")
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_INPROCESS", "1")
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_PROBE_S", "0.5")
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_BACKOFF_S", "0.25")
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_MAX_RESTARTS", "7")
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_QUORUM", "2")
+    cfg = ServingConfig.from_section({"fleet_replicas": 9,
+                                      "fleet_probe_s": 9.0})
+    assert cfg.fleet_replicas == 3  # env wins over config
+    assert cfg.fleet_inprocess is True
+    assert cfg.fleet_probe_s == 0.5
+    assert cfg.fleet_restart_backoff_s == 0.25
+    assert cfg.fleet_max_restarts == 7
+    assert cfg.fleet_quorum == 2
+    from hydragnn_tpu.serve import serving_defaults
+
+    for key in ("fleet_replicas", "fleet_inprocess", "fleet_probe_s",
+                "fleet_restart_backoff_s", "fleet_restart_backoff_max_s",
+                "fleet_max_restarts", "fleet_restart_window_s",
+                "fleet_drain_timeout_s", "fleet_startup_timeout_s",
+                "fleet_quorum"):
+        assert key in serving_defaults()
+
+
+def test_engine_fork_shares_compile_cache(engine):
+    """fork() is what makes in-process fleets affordable: the fork
+    serves identical answers through the SHARED compiled executables
+    (zero new compiles) while owning its own reload machinery."""
+    before = engine.cache_stats()["warmup_compiles"]
+    fork = engine.fork()
+    assert fork._compiled is engine._compiled
+    fork.warmup()  # cache-hits every bucket
+    assert engine.cache_stats()["warmup_compiles"] == before
+    assert fork.cache_stats()["misses"] == 0
+    s = _sample(7, seed=90)
+    np.testing.assert_array_equal(
+        engine.predict_samples([s])[0]["energy"],
+        fork.predict_samples([s])[0]["energy"])
+    # independent reload state: rolling back the fork never touches the
+    # parent
+    assert fork.reload_stats()["reloads"] == 0
+    assert fork.rollback() is False

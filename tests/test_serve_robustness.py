@@ -442,6 +442,19 @@ def test_http_reload_healthz_and_breaker_rollback(server, engine, tmp_path):
     assert m["reload"]["rollbacks"] == base_stats["rollbacks"] + 1
     assert m["breaker"]["opens"] == 1  # breaker is per-server: fresh
 
+    # manual POST /rollback (the fleet's rolling-abort path for
+    # subprocess replicas): nothing retained -> 409; after a fresh
+    # reload it restores the pre-reload state -> 200
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.port, "/rollback", {})
+    assert ei.value.code == 409
+    code, _ = _post(server.port, "/reload", {"checkpoint": str(ck)})
+    assert code == 200
+    code, out = _post(server.port, "/rollback", {})
+    assert code == 200 and out["status"] == "rolled_back"
+    code, after = _post(server.port, "/predict", _sample_json(s0))
+    assert code == 200 and after["heads"] == base["heads"]
+
 
 def test_reload_under_load_zero_drops(server, engine, tmp_path):
     """A hot reload while requests are in flight drops nothing: every

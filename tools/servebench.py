@@ -8,6 +8,7 @@
     python tools/servebench.py --selftest --overload \
         [--rate 0] [--duration 8] [--deadline-ms 250]     # overload probe
     python tools/servebench.py --quant-ab                 # f32/bf16/int8 A/B
+    python tools/servebench.py --fleet 3 [--duration 8]   # chaos-kill bench
 
 Closed loop (default): each of ``--concurrency`` workers POSTs random
 graphs to ``/predict`` back-to-back (next request only after the
@@ -27,6 +28,16 @@ time, so queue-building is not hidden), and a zero-5xx check.
 ``--selftest`` builds a tiny fresh-initialized model + server in-process
 on an ephemeral port (no checkpoint needed), benches it, and shuts it
 down — the zero-setup smoke path CI and future perf PRs track.
+
+Fleet mode (``--fleet N``): N in-process replicas (engine forks sharing
+one compile cache) behind the failover router (serve/fleet.py,
+serve/router.py), hit with a closed-loop run AND an open-loop overload
+run, each with a mid-run CHAOS KILL of one replica (the SIGKILL analog:
+in-flight work fails and must be retried on another replica).  Records
+BENCH_serve_fleet.json with a per-second goodput timeline around the
+kill; the SLO is the ISSUE-8 acceptance: zero 5xx through the kill, and
+the dead replica restarted + re-admitted within the restart backoff +
+warmup allowance.
 
 Reported (and emitted as BENCH_serve[_overload].json): throughput,
 p50/p95/p99/max latency, batch fill %, compile-cache hit rate, flush
@@ -319,6 +330,40 @@ def run_overload(url: str, rate: float, duration_s: float, max_nodes: int,
     return result
 
 
+def _tiny_engine(serving, hidden_dim: int = 8):
+    """Fresh-initialized tiny SAGE InferenceEngine for the selftests —
+    no checkpoint, no dataset; shared by the single-server selftest,
+    the quant A/B, and the fleet bench."""
+    import jax
+
+    from hydragnn_tpu.graph.batch import (
+        GraphSample, HeadSpec, PadSpec, collate)
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.serve import InferenceEngine, InferenceState
+
+    h = int(hidden_dim)
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=h, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, h, 1, (h,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    pads = [PadSpec.for_batch(b, serving.max_nodes_per_graph,
+                              serving.max_edges_per_graph)
+            for b in serving.buckets]
+    example = collate(
+        [GraphSample(x=np.zeros((1, 1)), pos=np.zeros((1, 3)),
+                     edge_index=np.zeros((2, 1), np.int32))],
+        pads[0], [HeadSpec("energy", "graph", 1)])
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    return InferenceEngine(cfg, state, [HeadSpec("energy", "graph", 1)],
+                           pads, serving=serving)
+
+
 def _selftest_server(deadline_ms: float = 10_000.0,
                      chaos_predict_ms: float = 0.0,
                      buckets: Tuple[int, ...] = (1, 4, 16),
@@ -338,39 +383,13 @@ def _selftest_server(deadline_ms: float = 10_000.0,
     quant runs use a wider model (hidden 64) so the int8 per-channel
     scale overhead is amortized like a real checkpoint's.
     """
-    import jax
+    from hydragnn_tpu.serve import InferenceServer, ServingConfig
 
-    from hydragnn_tpu.graph.batch import (
-        GraphSample, HeadSpec, PadSpec, collate)
-    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
-    from hydragnn_tpu.models.create import create_model
-    from hydragnn_tpu.serve import (
-        InferenceEngine, InferenceServer, InferenceState, ServingConfig)
-
-    h = int(hidden_dim)
-    cfg = ModelConfig(
-        model_type="SAGE", input_dim=1, hidden_dim=h, output_dim=(1,),
-        output_type=("graph",), graph_head=GraphHeadCfg(1, h, 1, (h,)),
-        node_head=None, task_weights=(1.0,), num_conv_layers=2)
-    model = create_model(cfg)
-    example = collate(
-        [GraphSample(x=np.zeros((1, 1)), pos=np.zeros((1, 3)),
-                     edge_index=np.zeros((2, 1), np.int32))],
-        PadSpec.for_batch(1, 16, 64), [HeadSpec("energy", "graph", 1)])
-    variables = model.init(
-        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-        example, train=False)
-    state = InferenceState(step=0, params=variables["params"],
-                           batch_stats=variables.get("batch_stats", {}))
     serving = ServingConfig(buckets=buckets, max_nodes_per_graph=16,
                             max_edges_per_graph=128, max_wait_ms=10.0,
                             port=0, request_deadline_ms=deadline_ms,
                             quant_policy=quant_policy)
-    pads = [PadSpec.for_batch(b, serving.max_nodes_per_graph,
-                              serving.max_edges_per_graph)
-            for b in serving.buckets]
-    engine = InferenceEngine(cfg, state, [HeadSpec("energy", "graph", 1)],
-                             pads, serving=serving)
+    engine = _tiny_engine(serving, hidden_dim=hidden_dim)
     chaos = None
     if chaos_predict_ms > 0:
         from hydragnn_tpu.resilience import ServeChaos
@@ -516,6 +535,266 @@ def run_quant_ab(requests_total: int, max_nodes: int,
     return result
 
 
+def _selftest_fleet(n: int, chaos_predict_ms: float = 15.0,
+                    deadline_ms: float = 10_000.0,
+                    backoff_s: float = 0.5, probe_s: float = 0.1):
+    """Tiny fresh-initialized model behind an N-replica in-process
+    fleet: one warmed base engine, every replica an ``engine.fork()``
+    sharing its compile cache.  ``chaos_predict_ms`` arms per-flush
+    predict latency on EVERY replica so the tiny CPU model's capacity
+    is bounded and the goodput timeline is readable."""
+    from hydragnn_tpu.resilience import ServeChaos
+    from hydragnn_tpu.serve import (
+        FleetRouter, FleetSupervisor, InProcessReplica, ServingConfig)
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    serving = ServingConfig(
+        buckets=(1, 2, 4), max_nodes_per_graph=16, max_edges_per_graph=128,
+        max_wait_ms=5.0, port=0, request_deadline_ms=deadline_ms,
+        fleet_probe_s=probe_s, fleet_restart_backoff_s=backoff_s,
+        fleet_restart_backoff_max_s=8.0, fleet_max_restarts=10,
+        fleet_restart_window_s=60.0)
+    base = _tiny_engine(serving)
+    base.warmup()
+    tel = MetricsLogger.disabled()
+
+    def chaos_factory():
+        return ServeChaos(predict_ms=chaos_predict_ms, lat_from=1) \
+            if chaos_predict_ms > 0 else None
+
+    replicas = [InProcessReplica(i, base.fork, serving, tel,
+                                 chaos_factory=chaos_factory)
+                for i in range(n)]
+    fleet = FleetSupervisor(replicas, serving, telemetry=tel)
+    router = FleetRouter(fleet, serving=serving, cfg=base.cfg,
+                         telemetry=tel)
+    router.start()
+    return router
+
+
+def _fleet_phase(router, mode: str, duration_s: float, max_nodes: int,
+                 input_dim: int, kill_at_s: float, kill_idx: int = 1,
+                 concurrency: int = 8, rate: float = 0.0,
+                 deadline_ms: float = 10_000.0) -> Dict[str, Any]:
+    """One timed run against the fleet with a mid-run chaos kill of one
+    replica: closed loop (``mode="closed"``, ``concurrency`` workers
+    back-to-back) or open loop (``mode="open"``, fixed ``rate`` req/s
+    with per-request deadlines).  Completions are bucketed per second
+    into a goodput timeline so the kill dip and recovery are visible in
+    the recorded JSON, not just claimed."""
+    import urllib.error
+
+    url = f"http://127.0.0.1:{router.port}"
+    lock = threading.Lock()
+    events: List[Tuple[float, int]] = []  # (t_completed_rel, code)
+    transport_errors: List[str] = []
+    rng = np.random.RandomState(11)
+    bodies = [json.dumps({**random_graph(rng, max_nodes, input_dim),
+                          "timeout_ms": deadline_ms}).encode()
+              for _ in range(64)]
+    t0 = time.perf_counter() + 0.2
+    t_end = t0 + duration_s
+    kill_info: Dict[str, Any] = {}
+
+    def fire(i: int) -> None:
+        req = urllib.request.Request(
+            url + "/predict", data=bodies[i % len(bodies)],
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as r:
+                r.read()
+                code = r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.read()
+        except Exception as e:  # noqa: BLE001 — transport failure
+            with lock:
+                transport_errors.append(repr(e))
+            return
+        with lock:
+            events.append((time.perf_counter() - t0, code))
+
+    def closed_worker(wid: int) -> None:
+        i = wid * 1000
+        while time.perf_counter() < t_end:
+            fire(i)
+            i += 1
+
+    idx = [0]
+
+    def open_worker() -> None:
+        while True:
+            with lock:
+                i = idx[0]
+                if t0 + i / rate > t_end:
+                    return
+                idx[0] += 1
+            t_fire = t0 + i / rate
+            now = time.perf_counter()
+            if t_fire > now:
+                time.sleep(t_fire - now)
+            fire(i)
+
+    def killer() -> None:
+        now = time.perf_counter()
+        if t0 + kill_at_s > now:
+            time.sleep(t0 + kill_at_s - now)
+        victim = router.fleet.replicas[kill_idx]
+        t_kill = time.perf_counter() - t0
+        victim.kill()
+        # recovery = dead -> restarted -> back in rotation
+        while victim.state != "live" or victim.batcher is None \
+                or not victim.batcher.worker_alive():
+            if time.perf_counter() - t0 > duration_s + 30:
+                break
+            time.sleep(0.01)
+        kill_info.update(
+            t_kill_s=round(t_kill, 3),
+            t_live_s=round(time.perf_counter() - t0, 3),
+            replica=kill_idx, restarts=victim.restarts)
+
+    if mode == "closed":
+        threads = [threading.Thread(target=closed_worker, args=(w,))
+                   for w in range(concurrency)]
+    else:
+        n_workers = max(8, min(256, int(rate)))
+        threads = [threading.Thread(target=open_worker)
+                   for _ in range(n_workers)]
+    threads.append(threading.Thread(target=killer))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    codes: Dict[str, int] = {}
+    buckets: Dict[int, int] = {}
+    for t_rel, code in events:
+        codes[str(code)] = codes.get(str(code), 0) + 1
+        if code == 200:
+            buckets[int(t_rel)] = buckets.get(int(t_rel), 0) + 1
+    timeline = [buckets.get(s, 0) for s in range(int(duration_s) + 1)]
+    t_kill = float(kill_info.get("t_kill_s", kill_at_s))
+    recovery_s = float(kill_info.get("t_live_s", 1e9)) - t_kill
+    pre = [g for s, g in enumerate(timeline) if s < int(t_kill)]
+    post = [g for s, g in enumerate(timeline)
+            if s > int(t_kill + recovery_s) and s < int(duration_s)]
+    pre_rps = float(np.mean(pre)) if pre else 0.0
+    post_rps = float(np.mean(post)) if post else 0.0
+    n5xx = sum(v for k, v in codes.items() if int(k) >= 500)
+    return {
+        "mode": mode,
+        "duration_s": duration_s,
+        "offered_rps": round(rate, 2) if mode == "open" else None,
+        "concurrency": concurrency if mode == "closed" else None,
+        "responses": codes,
+        "errors_5xx": n5xx,
+        "transport_errors": len(transport_errors),
+        "transport_error_samples": transport_errors[:3],
+        "kill": kill_info,
+        "recovery_s": round(recovery_s, 3),
+        "goodput_timeline_rps": timeline,
+        "goodput_pre_kill_rps": round(pre_rps, 2),
+        "goodput_post_recovery_rps": round(post_rps, 2),
+    }
+
+
+def run_fleet_bench(n: int, duration_s: float, max_nodes: int,
+                    input_dim: int = 1,
+                    chaos_predict_ms: float = 15.0) -> Dict[str, Any]:
+    """The ISSUE-8 acceptance bench: an N-replica fleet under load,
+    one replica chaos-killed mid-run in BOTH load modes.  The SLO:
+    zero 5xx through the kill (in-flight work retried on the survivors
+    within its deadline), and the victim restarted + re-admitted within
+    the restart backoff + warmup allowance."""
+    if n < 2:
+        raise SystemExit(
+            "--fleet needs >= 2 replicas: the bench kills one mid-run "
+            "and measures the survivors' goodput")
+    backoff_s, probe_s = 0.5, 0.1
+    kill_at = max(1.0, duration_s / 3.0)
+
+    router = _selftest_fleet(n, chaos_predict_ms=chaos_predict_ms,
+                             backoff_s=backoff_s, probe_s=probe_s)
+    print(f"fleet selftest: {n} replicas on http://127.0.0.1:"
+          f"{router.port}", flush=True)
+    try:
+        closed = _fleet_phase(router, "closed", duration_s, max_nodes,
+                              input_dim, kill_at_s=kill_at)
+        metrics_closed = _get(f"http://127.0.0.1:{router.port}",
+                              "/metrics")
+    finally:
+        router.shutdown()
+
+    # fresh fleet for the open-loop phase (clean counters/timeline).
+    # Offered rate = 4x the closed-loop goodput: the closed loop is
+    # concurrency-bound while the fleet batches up to a full bucket per
+    # flush, so 2x would still fit under true capacity and never shed
+    rate = max(4.0 * closed["goodput_pre_kill_rps"], 8.0)
+    router = _selftest_fleet(n, chaos_predict_ms=chaos_predict_ms,
+                             backoff_s=backoff_s, probe_s=probe_s,
+                             deadline_ms=500.0)
+    try:
+        overload = _fleet_phase(router, "open", duration_s, max_nodes,
+                                input_dim, kill_at_s=kill_at, rate=rate,
+                                deadline_ms=500.0)
+        metrics_open = _get(f"http://127.0.0.1:{router.port}", "/metrics")
+    finally:
+        router.shutdown()
+
+    # recovery bound: one probe tick to notice + the scheduled backoff +
+    # restart/warmup allowance (forked engines re-warm in milliseconds,
+    # but the CPU box running the bench is also running the load)
+    recovery_bound_s = probe_s + backoff_s + 2.0
+    slo = {
+        "zero_5xx_closed": closed["errors_5xx"] == 0,
+        "zero_5xx_overload": overload["errors_5xx"] == 0,
+        "zero_transport_errors": closed["transport_errors"] == 0
+                                 and overload["transport_errors"] == 0,
+        "recovery_bound_s": recovery_bound_s,
+        "recovered_closed": closed["recovery_s"] <= recovery_bound_s,
+        "recovered_overload": overload["recovery_s"] <= recovery_bound_s,
+        # goodput survives the kill: post-recovery within 60% of pre
+        # (N-1/N capacity during restart is expected; full recovery
+        # after re-admission — 60% guards against a wedged fleet while
+        # tolerating CPU scheduler noise)
+        "goodput_recovered_closed":
+            closed["goodput_post_recovery_rps"]
+            >= 0.6 * closed["goodput_pre_kill_rps"],
+    }
+    slo["ok"] = all(bool(v) for k, v in slo.items()
+                    if k != "recovery_bound_s")
+    return {
+        "bench": "serve_fleet",
+        "config": {
+            "replicas": n,
+            "duration_s": duration_s,
+            "kill_at_s": kill_at,
+            "max_nodes": max_nodes,
+            "chaos_predict_ms": chaos_predict_ms,
+            "fleet_restart_backoff_s": backoff_s,
+            "fleet_probe_s": probe_s,
+            "overload_rate_rps": round(rate, 2),
+        },
+        "closed_loop": closed,
+        "overload": overload,
+        "fleet_metrics_closed": {
+            "router": metrics_closed.get("router"),
+            "fleet_restarts": metrics_closed.get("fleet", {}).get(
+                "restarts_total"),
+            "drain_rate_rps_sum": metrics_closed.get("fleet", {}).get(
+                "drain_rate_rps_sum"),
+            "health_events": metrics_closed.get("health_events"),
+        },
+        "fleet_metrics_overload": {
+            "router": metrics_open.get("router"),
+            "fleet_restarts": metrics_open.get("fleet", {}).get(
+                "restarts_total"),
+            "health_events": metrics_open.get("health_events"),
+        },
+        "slo": slo,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default=None,
@@ -539,6 +818,11 @@ def main(argv=None) -> int:
                     help="A/B the f32/bf16/int8 dtype policies against "
                          "in-process selftest servers; writes "
                          "BENCH_serve_quant.json")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet chaos-kill bench: N in-process replicas "
+                         "behind the failover router, one killed "
+                         "mid-run in closed-loop AND overload phases; "
+                         "writes BENCH_serve_fleet.json")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="overload arrival rate in req/s (0 = auto: 2x a "
                          "measured closed-loop capacity probe)")
@@ -556,9 +840,28 @@ def main(argv=None) -> int:
                          "or BENCH_serve_overload.json with --overload)")
     args = ap.parse_args(argv)
     out_path = args.out or (
-        "BENCH_serve_quant.json" if args.quant_ab
+        "BENCH_serve_fleet.json" if args.fleet > 0
+        else "BENCH_serve_quant.json" if args.quant_ab
         else "BENCH_serve_overload.json" if args.overload
         else "BENCH_serve.json")
+
+    if args.fleet > 0:
+        result = run_fleet_bench(args.fleet, args.duration, args.nodes,
+                                 input_dim=args.input_dim,
+                                 chaos_predict_ms=args.chaos_predict_ms)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result, indent=2))
+        print(f"\nwrote {out_path}")
+        slo = result["slo"]
+        c, o = result["closed_loop"], result["overload"]
+        print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: closed-loop "
+              f"goodput {c['goodput_pre_kill_rps']} -> "
+              f"{c['goodput_post_recovery_rps']} rps across the kill, "
+              f"recovery {c['recovery_s']}s (bound "
+              f"{slo['recovery_bound_s']}s), 5xx closed/overload "
+              f"{c['errors_5xx']}/{o['errors_5xx']}")
+        return 0 if slo["ok"] else 1
 
     if args.quant_ab:
         result = run_quant_ab(args.requests, args.nodes,

@@ -115,7 +115,10 @@ def health_section(health: List[Dict[str, Any]],
                   "depth", "port", "served",
                   # overload/reload events
                   "est_wait_ms", "deadline_ms", "waited_ms", "timeout_s",
-                  "cooldown_s", "source", "golden_max_delta"):
+                  "cooldown_s", "source", "golden_max_delta",
+                  # fleet events (docs/SERVING.md "Replica fleet")
+                  "replica", "replicas", "live", "total", "quorum",
+                  "backoff_s", "restarts", "swapped", "rolled_back"):
             if r.get(f) is not None:
                 where.append(f"{f}={r[f]}")
         lines.append(f"  {kind}: " + "  ".join(where))
@@ -140,6 +143,64 @@ _SHED_WARN_RATIO = 0.10
 # the signal that the ladder is mis-sized for the traffic and
 # tools/buckettune.py should re-solve it
 _BUCKET_WASTE_WARN_PCT = 50.0
+
+# replica-fleet event kinds (docs/TELEMETRY.md "Fleet events"): emitted
+# by serve/fleet.py (supervisor) and serve/router.py
+_FLEET_KINDS = ("fleet_start", "replica_start", "replica_dead",
+                "replica_restart", "replica_eject", "replica_readmit",
+                "replica_drain", "rolling_reload_start",
+                "rolling_reload_ok", "rolling_reload_rollback",
+                "fleet_retry", "fleet_degraded", "fleet_empty")
+
+
+def fleet_section(health: List[Dict[str, Any]],
+                  manifests: List[Dict[str, Any]]) -> str:
+    """Replica-fleet story: event counts plus the WARNINGs an operator
+    acts on — replicas observed below quorum, a fleet that went EMPTY
+    (503s were served), restart-storm ejections (a crash-looping
+    replica needs attention), and rolling reloads that rolled back."""
+    counts: Dict[str, int] = {}
+    for m in manifests[-1:]:
+        counts = {k: v for k, v in (m.get("health") or {}).items()
+                  if k in _FLEET_KINDS}
+    if not counts:
+        for r in health:
+            k = str(r.get("kind"))
+            if k in _FLEET_KINDS:
+                counts[k] = counts.get(k, 0) + int(r.get("count", 1) or 1)
+    lines = ["  " + "  ".join(f"{k}={counts[k]}" for k in sorted(counts))]
+    starts = [r for r in health if r.get("kind") == "fleet_start"]
+    if starts:
+        s = starts[-1]
+        lines.append(f"  fleet: {s.get('replicas')} {s.get('mode', '')} "
+                     f"replica(s), quorum {s.get('quorum')}")
+    n_deg = counts.get("fleet_degraded", 0)
+    if n_deg:
+        last = [r for r in health if r.get("kind") == "fleet_degraded"][-1:]
+        where = (f" (last: {last[0].get('live')}/{last[0].get('total')} "
+                 f"live vs quorum {last[0].get('quorum')})") if last else ""
+        lines.append(f"  WARNING replicas fell below quorum {n_deg} "
+                     f"time(s){where} — the fleet served degraded; check "
+                     "replica_dead/replica_eject reasons")
+    n_empty = counts.get("fleet_empty", 0)
+    if n_empty:
+        lines.append(f"  WARNING the fleet went EMPTY {n_empty} time(s) — "
+                     "clients saw 503s; every replica was dead/ejected "
+                     "at once")
+    storms = [r for r in health if r.get("kind") == "replica_eject"
+              and r.get("reason") == "restart_storm"]
+    if storms:
+        which = sorted({int(r.get("replica", -1)) for r in storms})
+        lines.append(f"  WARNING restart storm: replica(s) {which} were "
+                     "marked FAILED after exceeding the restart cap — "
+                     "they will not be restarted without operator action")
+    n_rb = counts.get("rolling_reload_rollback", 0)
+    if n_rb:
+        lines.append(f"  WARNING {n_rb} rolling reload(s) rolled back — "
+                     "a candidate failed validation on a replica "
+                     f"(rolling_reload_ok: "
+                     f"{counts.get('rolling_reload_ok', 0)})")
+    return "\n".join(lines)
 
 
 def serve_bucket_section(serve_steps: List[Dict[str, Any]]) -> str:
@@ -366,6 +427,11 @@ def main(argv=None) -> int:
             for k in (m.get("health") or {})):
         print("\nserving:")
         print(serving_section(health, manifests))
+    if any(r.get("kind") in _FLEET_KINDS for r in health) or any(
+            k in _FLEET_KINDS for m in manifests
+            for k in (m.get("health") or {})):
+        print("\nfleet:")
+        print(fleet_section(health, manifests))
     if serve_steps:
         print("\nserving buckets:")
         print(serve_bucket_section(serve_steps))
