@@ -1,0 +1,69 @@
+"""Small shared AST helpers used by the rule modules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as ``a.b.c`` (None for anything
+    else, e.g. a call result attribute)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def base_name(name: Optional[str]) -> Optional[str]:
+    """Last segment of a dotted name (``jax.jit`` -> ``jit``)."""
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents) -> Iterator[ast.AST]:
+    while node in parents:
+        node = parents[node]
+        yield node
+
+
+def enclosing_function(node: ast.AST, parents) -> Optional[ast.AST]:
+    for a in ancestors(node, parents):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return a
+    return None
+
+
+def walk_skip_nested_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree WITHOUT descending into nested function/class
+    definitions (their bodies execute in a different regime)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

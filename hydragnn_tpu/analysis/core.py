@@ -1,0 +1,173 @@
+"""Rule plugin API: severities, findings, registration, suppressions.
+
+A rule is a subclass of :class:`Rule` registered with :func:`register`.
+Per-file rules implement ``check_file(ctx)``; cross-artifact rules (the
+registry family) implement ``check_project(project)`` and run once after
+every file is parsed.  Findings carry a *fingerprint* — ``rule`` + path +
+the whitespace-normalized source line — so the baseline survives pure
+line drift (code moving down a file does not invalidate entries).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import hashlib
+import re
+from typing import Dict, Iterable, List, Optional, Type
+
+
+class Severity(enum.IntEnum):
+    """Per-rule severity.  The gate fails on any unsuppressed finding
+    regardless of severity; ``--min-severity`` filters reporting only."""
+
+    NOTE = 10
+    WARN = 20
+    ERROR = 30
+
+    @classmethod
+    def parse(cls, name: str) -> "Severity":
+        try:
+            return cls[name.upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {name!r} (note|warn|error)") from None
+
+
+def normalize_code(line: str) -> str:
+    """Whitespace-normalized source line — the drift-stable part of a
+    finding's identity."""
+    return " ".join(line.split())
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: Severity
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    code: str = ""  # normalized source line at `line`
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update(f"{self.rule}|{self.path}|{self.code}".encode())
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity.name.lower()}] {self.message}")
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.name.lower(),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "code": self.code,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class Rule:
+    """Base class for one lint rule.
+
+    Subclasses set ``id`` (stable, used in suppressions/baseline),
+    ``name`` (kebab-case slug), ``severity``, and ``doc`` (one-line
+    invariant statement; the full story lives in docs/ANALYSIS.md).
+    """
+
+    id: str = ""
+    name: str = ""
+    severity: Severity = Severity.WARN
+    doc: str = ""
+
+    def check_file(self, ctx) -> Iterable[Finding]:  # pragma: no cover
+        return ()
+
+    def check_project(self, project) -> Iterable[Finding]:  # pragma: no cover
+        return ()
+
+    def finding(self, ctx, node_or_line, message: str,
+                col: Optional[int] = None) -> Finding:
+        """Build a finding anchored at an AST node (or explicit line)."""
+        if isinstance(node_or_line, int):
+            line, c = node_or_line, col or 0
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            c = col if col is not None else getattr(
+                node_or_line, "col_offset", 0)
+        code = ""
+        if ctx is not None and 1 <= line <= len(ctx.lines):
+            code = normalize_code(ctx.lines[line - 1])
+        return Finding(rule=self.id, severity=self.severity,
+                       path=ctx.rel if ctx is not None else "<project>",
+                       line=line, col=c, message=message, code=code)
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: instantiate and register a rule by ``id``."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _RULES[rule_id]
+
+
+# -- suppressions ----------------------------------------------------------
+#
+# `# graftlint: disable=RULE1,RULE2 (reason)` — trailing on a line
+# suppresses that line; on a line of its own it suppresses the NEXT line
+# too (for statements too long to carry a trailing comment).
+# `# graftlint: disable-file=RULE` anywhere in the first 10 lines
+# suppresses the rule for the whole file.  `disable=all` matches every
+# rule.  Suppressions are counted and reported so they stay auditable.
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+def parse_suppressions(lines: List[str]):
+    """Return (per_line: dict[int, set[str]], file_wide: set[str])."""
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_FILE_RE.search(raw)
+        if m and i <= 10:
+            file_wide.update(
+                r.strip() for r in m.group(1).split(",") if r.strip())
+            continue
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        per_line.setdefault(i, set()).update(rules)
+        if raw.lstrip().startswith("#"):
+            # standalone comment line: also covers the following line
+            per_line.setdefault(i + 1, set()).update(rules)
+    return per_line, file_wide
+
+
+def is_suppressed(finding: Finding, per_line, file_wide) -> bool:
+    if "all" in file_wide or finding.rule in file_wide:
+        return True
+    rules = per_line.get(finding.line, ())
+    return "all" in rules or finding.rule in rules

@@ -1,0 +1,15 @@
+"""Fixture: unguarded write to a locked class's shared attr (LCK001)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0  # second thread may be inside bump() right now

@@ -1,0 +1,6 @@
+"""Fixture: env knob read but not declared in the registry (REG001)."""
+import os
+
+
+def read_knob():
+    return os.environ.get("HYDRAGNN_NOT_A_REAL_KNOB", "0")

@@ -1,0 +1,8 @@
+"""Fixture: declared knob + prefix construction (REG001 quiet)."""
+import os
+
+
+def read_knob(name):
+    on = os.environ.get("HYDRAGNN_TELEMETRY", "")
+    dyn = os.environ.get("HYDRAGNN_SERVE_" + name, "")
+    return on, dyn

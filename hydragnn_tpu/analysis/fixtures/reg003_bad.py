@@ -1,0 +1,6 @@
+"""Fixture: health kind not declared in the registry (REG003)."""
+
+
+class Emitter:
+    def emit(self, telemetry):
+        telemetry.health("definitely_not_a_kind", x=1)

@@ -1,0 +1,9 @@
+"""Fixture: declared kinds, incl. the two-literal IfExp (REG003 quiet)."""
+
+
+class Emitter:
+    def emit(self, telemetry, walltime):
+        telemetry.health("serve_start", port=1)
+        telemetry.health("walltime_save" if walltime else "preempt_save")
+        snapshot = telemetry.health_counts() if walltime else None
+        return snapshot

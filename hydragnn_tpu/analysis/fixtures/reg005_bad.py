@@ -1,0 +1,12 @@
+"""Fixture: finalize-written key never validated on read (REG005)."""
+
+
+def widget_defaults():
+    return {"alpha": 1, "beta": 2}
+
+
+class WidgetConfig:
+    @classmethod
+    def from_widget(cls, section):
+        s = dict(section or {})
+        return {"alpha": s.get("alpha", 1)}  # beta: written, never read

@@ -1,0 +1,12 @@
+"""Fixture: writer and reader agree key-for-key (REG005 quiet)."""
+
+
+def gadget_defaults():
+    return {"alpha": 1, "beta": 2}
+
+
+class GadgetConfig:
+    @classmethod
+    def from_gadget(cls, section):
+        s = dict(section or {})
+        return {"alpha": s.get("alpha", 1), "beta": s.get("beta", 2)}

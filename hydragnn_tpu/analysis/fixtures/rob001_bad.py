@@ -1,0 +1,8 @@
+"""Fixture: broad except swallows the error silently (ROB001)."""
+
+
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None

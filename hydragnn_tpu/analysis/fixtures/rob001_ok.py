@@ -1,0 +1,18 @@
+"""Fixture: narrow type / logged / error used (ROB001 quiet)."""
+
+
+def load(path, log):
+    try:
+        return open(path).read()
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        log.warning("load failed: %s", e)
+        return None
+
+
+def submit(fut, work):
+    try:
+        work()
+    except Exception as e:
+        fut.set_exception(e)

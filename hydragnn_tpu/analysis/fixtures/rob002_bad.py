@@ -1,0 +1,7 @@
+"""Fixture: torn-on-crash sidecar write (ROB002)."""
+import json
+
+
+def save(meta, path):
+    with open(path, "w") as fh:
+        json.dump(meta, fh)

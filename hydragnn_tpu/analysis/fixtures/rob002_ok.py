@@ -1,0 +1,10 @@
+"""Fixture: the tmp + os.replace idiom (ROB002 quiet)."""
+import json
+import os
+
+
+def save(meta, path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh)
+    os.replace(tmp, path)

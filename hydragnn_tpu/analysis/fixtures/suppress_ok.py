@@ -1,0 +1,10 @@
+"""Fixture: inline suppressions silence every raw finding here."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t = time.time()  # graftlint: disable=TRC001 (fixture: suppression mechanics)
+    return x + t

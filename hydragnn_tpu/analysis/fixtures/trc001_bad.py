@@ -1,0 +1,13 @@
+"""Fixture: host-sync calls inside a traced function (TRC001 fires)."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(state, batch):
+    loss = (state - batch).sum()
+    t = time.time()  # host clock read baked in at trace time
+    host = np.asarray(loss)  # device->host sync under tracing
+    return loss.item() + t + host

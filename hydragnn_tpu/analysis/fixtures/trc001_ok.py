@@ -1,0 +1,15 @@
+"""Fixture: the same calls OUTSIDE the traced region (TRC001 quiet)."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(state, batch):
+    return (state - batch).sum()
+
+
+def host_side(out):
+    t0 = time.time()
+    return float(np.asarray(out)), time.time() - t0

@@ -1,0 +1,11 @@
+"""Fixture: Python branch on a traced argument (TRC002 fires)."""
+import jax
+
+
+@jax.jit
+def guard(loss, scale):
+    if loss > 0:
+        return loss * scale
+    while scale:
+        scale = scale - 1
+    return loss

@@ -1,0 +1,15 @@
+"""Fixture: static/None/shape branches only (TRC002 quiet)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("training",))
+def guard(loss, training):
+    if training:
+        return loss * 2
+    if loss is None:
+        return loss
+    if loss.shape[0] > 4:
+        return loss[:4]
+    return jax.lax.cond(loss.sum() > 0, lambda l: l * 2, lambda l: l, loss)

@@ -1,0 +1,10 @@
+"""Fixture: jit wrapper constructed inside a loop (TRC003 fires)."""
+import jax
+
+
+def save_all(leaves):
+    out = []
+    for leaf in leaves:
+        gather = jax.jit(lambda x: x + 1)  # fresh trace every iteration
+        out.append(gather(leaf))
+    return out

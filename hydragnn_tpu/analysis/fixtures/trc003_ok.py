@@ -1,0 +1,11 @@
+"""Fixture: wrapper cached outside the loop (TRC003 quiet)."""
+import jax
+
+_gather = jax.jit(lambda x: x + 1)
+
+
+def save_all(leaves):
+    out = []
+    for leaf in leaves:
+        out.append(_gather(leaf))
+    return out

@@ -1,0 +1,8 @@
+"""Fixture: donated argument read after the call (TRC004 fires)."""
+import jax
+
+
+def train(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=0)
+    new_state = step(state, batch)
+    return state + new_state  # state's buffer was deleted by donation

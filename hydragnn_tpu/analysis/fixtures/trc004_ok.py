@@ -1,0 +1,15 @@
+"""Fixture: donated argument rebound by the call (TRC004 quiet)."""
+import jax
+
+
+def train(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=0)
+    state = step(state, batch)
+    return state + 1
+
+
+def report(state):
+    # same variable NAME as train()'s donated arg, different scope — the
+    # rule must not cross-match function bodies (regression fixture)
+    print(state)
+    return state

@@ -1,0 +1,73 @@
+"""File collection and parsing: the project model rules run against."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import parse_suppressions
+
+# Directories never scanned.  `fixtures` holds the rule test corpus —
+# files that VIOLATE invariants on purpose.
+EXCLUDE_DIRS = {"__pycache__", "fixtures", ".git", "node_modules"}
+
+
+@dataclasses.dataclass
+class FileCtx:
+    path: str  # absolute
+    rel: str  # repo-relative, posix
+    src: str
+    lines: List[str]
+    tree: ast.AST
+    suppressed_lines: Dict[int, Set[str]]
+    suppressed_file: Set[str]
+
+
+class Project:
+    """Parsed files plus access to non-Python artifacts (docs)."""
+
+    def __init__(self, root: str, files: List[FileCtx]):
+        self.root = root
+        self.files = files
+        self.by_rel = {f.rel: f for f in files}
+
+    def read_text(self, rel: str) -> Optional[str]:
+        p = os.path.join(self.root, rel)
+        if not os.path.exists(p):
+            return None
+        with open(p, encoding="utf-8") as fh:
+            return fh.read()
+
+
+def parse_file(path: str, root: str) -> FileCtx:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=path)  # SyntaxError propagates
+    per_line, file_wide = parse_suppressions(lines)
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return FileCtx(path=path, rel=rel, src=src, lines=lines, tree=tree,
+                   suppressed_lines=per_line, suppressed_file=file_wide)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDE_DIRS
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(dirpath, fn)))
+    return out
+
+
+def collect_project(root: str, paths: Sequence[str]) -> Project:
+    files = [parse_file(p, root) for p in iter_python_files(paths)]
+    return Project(root=os.path.abspath(root), files=files)

@@ -1,0 +1,420 @@
+"""The cross-artifact registries: env knobs and health-event kinds.
+
+These are the single declared sources the registry rules check code and
+docs against:
+
+- every ``HYDRAGNN_*`` string in code must name a knob declared in
+  :data:`KNOBS` (rule REG001), every declared knob must still be read
+  somewhere and appear in docs/KNOBS.md (REG002), and docs/KNOBS.md is
+  GENERATED from this table (``tools/graftlint.py --emit-docs``) so it
+  cannot drift;
+- every literal ``MetricsLogger.health(kind=...)`` emitted by the
+  package must name a kind declared in :data:`HEALTH_KINDS` (REG003),
+  and every declared kind must be emitted somewhere and documented in
+  docs/TELEMETRY.md (REG004).
+
+Adding a knob or a health kind therefore means: declare it here, use
+it, document it — the lint gate fails on any one of the three missing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str  # HYDRAGNN_* spelling
+    config: str  # config-file spelling ("" = env-only knob)
+    default: str  # effective default, as documented
+    module: str  # owning module (repo-relative)
+    desc: str  # one-line effect
+
+
+def _k(name, config, default, module, desc):
+    return Knob(name=name, config=config, default=default, module=module,
+                desc=desc)
+
+
+_KNOB_LIST = [
+    # -- data pipeline ----------------------------------------------------
+    _k("HYDRAGNN_NUM_WORKERS", "", "2",
+       "hydragnn_tpu/data/prefetch.py",
+       "prefetch worker thread count (dataloader auto-pipeline may set it)"),
+    _k("HYDRAGNN_COLLATE_PROCS", "", "4",
+       "hydragnn_tpu/data/prefetch.py",
+       "collate process-pool size (0 = in-thread collation)"),
+    _k("HYDRAGNN_COLLATE_SHM", "", "1",
+       "hydragnn_tpu/data/prefetch.py",
+       "ship collated batches via shared memory (0 = pickle over pipe)"),
+    _k("HYDRAGNN_AFFINITY", "", "0",
+       "hydragnn_tpu/data/prefetch.py",
+       "pin prefetch/collate workers to CPU cores"),
+    _k("HYDRAGNN_AFFINITY_WIDTH", "", "2",
+       "hydragnn_tpu/data/prefetch.py",
+       "cores per pinned worker"),
+    _k("HYDRAGNN_AFFINITY_OFFSET", "", "0",
+       "hydragnn_tpu/data/prefetch.py",
+       "first core index for worker pinning"),
+    _k("HYDRAGNN_NUM_BUCKETS", "", "0 (auto)",
+       "hydragnn_tpu/data/dataloader.py",
+       "PadSpec bucket-ladder size for the training loader"),
+    _k("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", "", "0",
+       "hydragnn_tpu/data/dataloader.py",
+       "legacy spelling: 4-bucket ladder for variable-size datasets"),
+    _k("HYDRAGNN_RESIDENT_DATASET", "", "auto",
+       "hydragnn_tpu/train/trainer.py",
+       "keep collated batches device-resident across epochs"),
+    _k("HYDRAGNN_RESIDENT_BUDGET_MB", "", "6144",
+       "hydragnn_tpu/train/trainer.py",
+       "HBM budget the auto-pipeline sizes the resident set against"),
+    _k("HYDRAGNN_DEVICE_PREFETCH", "", "0",
+       "hydragnn_tpu/train/trainer.py",
+       "overlap H2D transfer one batch ahead"),
+    # -- trainer / pipeline ----------------------------------------------
+    _k("HYDRAGNN_AUTO_PIPELINE", "", "1",
+       "hydragnn_tpu/train/trainer.py",
+       "derive pipeline knobs (scan K, resident, workers) automatically"),
+    _k("HYDRAGNN_STEPS_PER_DISPATCH", "", "auto",
+       "hydragnn_tpu/train/trainer.py",
+       "optimizer steps folded into one scanned dispatch"),
+    _k("HYDRAGNN_MAX_NUM_BATCH", "", "0 (all)",
+       "hydragnn_tpu/train/trainer.py",
+       "truncate each epoch to N batches (smoke runs)"),
+    _k("HYDRAGNN_VALTEST", "", "1",
+       "hydragnn_tpu/train/trainer.py",
+       "run the val/test phases each epoch (0 = train only)"),
+    _k("HYDRAGNN_DUMP_TESTDATA", "", "0",
+       "hydragnn_tpu/train/trainer.py",
+       "dump per-sample test predictions for postprocessing"),
+    _k("HYDRAGNN_NUM_SLICES", "", "0",
+       "hydragnn_tpu/train/trainer.py",
+       "force a (dcn, ici) multi-slice mesh shape"),
+    _k("HYDRAGNN_BN_MOMENTUM", "", "model default",
+       "hydragnn_tpu/models/layers.py",
+       "BatchNorm momentum override"),
+    # -- parallel / distributed ------------------------------------------
+    _k("HYDRAGNN_MASTER_ADDR", "", "127.0.0.1",
+       "hydragnn_tpu/parallel/mesh.py",
+       "jax.distributed coordinator address"),
+    _k("HYDRAGNN_MASTER_PORT", "", "8889",
+       "hydragnn_tpu/parallel/mesh.py",
+       "jax.distributed coordinator port"),
+    _k("HYDRAGNN_ZERO", "Training.zero_stage", "0",
+       "hydragnn_tpu/parallel/zero.py",
+       "ZeRO stage (0|1|2); env wins over the config stage"),
+    # -- kernels / fused-path gates --------------------------------------
+    _k("HYDRAGNN_AGGR_BACKEND", "", "scatter",
+       "hydragnn_tpu/ops/aggregate.py",
+       "aggregation backend: fused (Pallas) | scatter (XLA)"),
+    _k("HYDRAGNN_SCF_FUSED", "", "auto",
+       "hydragnn_tpu/models/schnet.py",
+       "SchNet fused CFConv pipeline gate"),
+    _k("HYDRAGNN_SCF_BE_R", "", "auto",
+       "hydragnn_tpu/ops/scf_mp.py",
+       "fused-CFConv edge-block residency override"),
+    _k("HYDRAGNN_GAT_FUSED", "", "auto",
+       "hydragnn_tpu/models/gat.py",
+       "GAT fused edge-attention gate"),
+    _k("HYDRAGNN_DN_TRI_OFF", "", "0",
+       "hydragnn_tpu/models/dimenet.py",
+       "disable the DimeNet fused-triplet kernel"),
+    _k("HYDRAGNN_DIMENET_FUSED_TRI", "", "0",
+       "hydragnn_tpu/models/dimenet.py",
+       "force the fused-triplet kernel past the dataset-bound gate"),
+    _k("HYDRAGNN_DN_ROW_MLP_OFF", "", "0",
+       "hydragnn_tpu/models/dimenet.py",
+       "disable the fused residual-MLP tail"),
+    _k("HYDRAGNN_DIMENET_REMAT", "", "0",
+       "hydragnn_tpu/models/dimenet.py",
+       "remat DimeNet interaction blocks"),
+    # -- telemetry --------------------------------------------------------
+    _k("HYDRAGNN_TELEMETRY", "Telemetry.enable", "0",
+       "hydragnn_tpu/telemetry/logger.py",
+       "enable the telemetry subsystem"),
+    _k("HYDRAGNN_TELEMETRY_SINKS", "Telemetry.sinks", "jsonl,stdout",
+       "hydragnn_tpu/telemetry/logger.py",
+       "comma list of sinks (jsonl,csv,stdout,tensorboard)"),
+    _k("HYDRAGNN_TELEMETRY_DIR", "Telemetry.dir",
+       "logs/<run>/telemetry", "hydragnn_tpu/telemetry/logger.py",
+       "telemetry output directory"),
+    _k("HYDRAGNN_TELEMETRY_HEARTBEAT", "Telemetry.heartbeat", "50",
+       "hydragnn_tpu/telemetry/logger.py",
+       "stdout heartbeat cadence (steps)"),
+    _k("HYDRAGNN_TELEMETRY_SYNC", "Telemetry.sync_steps", "0",
+       "hydragnn_tpu/telemetry/logger.py",
+       "block per step for true device step times"),
+    _k("HYDRAGNN_PEAK_FLOPS", "", "197e12 (v5e bf16)",
+       "hydragnn_tpu/telemetry/flops.py",
+       "MFU peak-flops basis override"),
+    # -- resilience (Training section) -----------------------------------
+    _k("HYDRAGNN_NONFINITE_GUARD", "Training.nonfinite_guard", "0",
+       "hydragnn_tpu/resilience/config.py",
+       "in-jit non-finite step guard (skip bad steps)"),
+    _k("HYDRAGNN_GUARD_MAX_BAD", "Training.guard_max_consecutive", "5",
+       "hydragnn_tpu/resilience/config.py",
+       "consecutive skipped steps before NonFiniteTrainingError"),
+    _k("HYDRAGNN_GUARD_POLL", "Training.guard_poll_every", "8",
+       "hydragnn_tpu/resilience/config.py",
+       "guard-monitor poll cadence (batches)"),
+    _k("HYDRAGNN_PREEMPT", "Training.preemption", "1",
+       "hydragnn_tpu/resilience/config.py",
+       "SIGTERM/SIGINT preemption-aware checkpointing"),
+    _k("HYDRAGNN_PREEMPT_SYNC", "Training.preempt_sync_every", "8",
+       "hydragnn_tpu/resilience/config.py",
+       "multi-host preemption-agreement cadence (polls)"),
+    _k("HYDRAGNN_CKPT_RETRIES", "Training.ckpt_retries", "3",
+       "hydragnn_tpu/resilience/config.py",
+       "checkpoint-write retry attempts"),
+    _k("HYDRAGNN_CKPT_BACKOFF", "Training.ckpt_backoff", "0.5",
+       "hydragnn_tpu/resilience/config.py",
+       "checkpoint retry backoff (seconds, doubling)"),
+    # -- chaos (test-only fault injection) -------------------------------
+    _k("HYDRAGNN_CHAOS_NAN_STEP", "Training.Chaos.nan_step", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "inject NaN loss at step spec k|k1,k2|k+"),
+    _k("HYDRAGNN_CHAOS_PREEMPT_STEP", "Training.Chaos.preempt_step", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "inject a preemption signal at step k"),
+    _k("HYDRAGNN_CHAOS_CKPT_FAILS", "Training.Chaos.ckpt_fails", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "fail the first N checkpoint writes"),
+    _k("HYDRAGNN_CHAOS_SERVE_PREDICT_MS", "Serving.Chaos.predict_ms",
+       "off", "hydragnn_tpu/resilience/chaos.py",
+       "inject predict latency (ms|ms@k+)"),
+    _k("HYDRAGNN_CHAOS_SERVE_FAIL_STEP", "Serving.Chaos.fail_step", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "fail predict flushes at flush spec k|k1,k2|k+"),
+    _k("HYDRAGNN_CHAOS_SERVE_RELOAD_CORRUPT",
+       "Serving.Chaos.reload_corrupt", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "NaN-corrupt the next N reload candidates"),
+    _k("HYDRAGNN_CHAOS_REPLICA_KILL", "Serving.FleetChaos.kill", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "kill replica at probe tick spec tick[:replica]|tick+"),
+    _k("HYDRAGNN_CHAOS_REPLICA_HANG", "Serving.FleetChaos.hang", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "wedge a replica's predict at probe tick spec"),
+    _k("HYDRAGNN_CHAOS_REPLICA_FLAP", "Serving.FleetChaos.flap", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "kill the target at EVERY armed tick (crash loop)"),
+    # -- serving ----------------------------------------------------------
+    _k("HYDRAGNN_SERVE_BUCKETS", "Serving.buckets", "1,4,16",
+       "hydragnn_tpu/serve/config.py",
+       "batch-capacity bucket ladder (comma list, ascending)"),
+    _k("HYDRAGNN_SERVE_MAX_NODES", "Serving.max_nodes_per_graph", "0",
+       "hydragnn_tpu/serve/config.py",
+       "per-graph worst-case nodes (sizes bucket PadSpecs)"),
+    _k("HYDRAGNN_SERVE_MAX_EDGES", "Serving.max_edges_per_graph", "0",
+       "hydragnn_tpu/serve/config.py",
+       "per-graph worst-case edges (sizes bucket PadSpecs)"),
+    _k("HYDRAGNN_SERVE_EDGE_NORM", "Serving.edge_length_norm", "0.0",
+       "hydragnn_tpu/serve/config.py",
+       "edge-length normalization constant (training provenance)"),
+    _k("HYDRAGNN_SERVE_MAX_WAIT_MS", "Serving.max_wait_ms", "20",
+       "hydragnn_tpu/serve/config.py",
+       "micro-batcher deadline-flush budget"),
+    _k("HYDRAGNN_SERVE_QUEUE", "Serving.max_queue", "1024",
+       "hydragnn_tpu/serve/config.py",
+       "bounded request-queue capacity"),
+    _k("HYDRAGNN_SERVE_HOST", "Serving.host", "127.0.0.1",
+       "hydragnn_tpu/serve/config.py", "HTTP bind host"),
+    _k("HYDRAGNN_SERVE_PORT", "Serving.port", "8808",
+       "hydragnn_tpu/serve/config.py", "HTTP bind port (0 = ephemeral)"),
+    _k("HYDRAGNN_SERVE_DRAIN_S", "Serving.drain_timeout_s", "10",
+       "hydragnn_tpu/serve/config.py",
+       "graceful-shutdown queue-drain budget"),
+    _k("HYDRAGNN_SERVE_DEADLINE_MS", "Serving.request_deadline_ms",
+       "10000", "hydragnn_tpu/serve/config.py",
+       "default per-request deadline (queue wait + service)"),
+    _k("HYDRAGNN_SERVE_PREDICT_TIMEOUT_S", "Serving.predict_timeout_s",
+       "30", "hydragnn_tpu/serve/config.py",
+       "predict watchdog (flush exceeding it fails, 504)"),
+    _k("HYDRAGNN_SERVE_BREAKER_THRESHOLD", "Serving.breaker_threshold",
+       "5", "hydragnn_tpu/serve/config.py",
+       "consecutive flush failures that trip the breaker (0 = off)"),
+    _k("HYDRAGNN_SERVE_BREAKER_COOLDOWN_S", "Serving.breaker_cooldown_s",
+       "5", "hydragnn_tpu/serve/config.py",
+       "breaker open -> half-open probe delay"),
+    _k("HYDRAGNN_SERVE_RELOAD_WATCH", "Serving.reload_watch_path", "",
+       "hydragnn_tpu/serve/config.py",
+       "checkpoint file to hot-reload on mtime change"),
+    _k("HYDRAGNN_SERVE_RELOAD_WATCH_S", "Serving.reload_watch_s", "0",
+       "hydragnn_tpu/serve/config.py",
+       "reload-watch poll interval (0 = off)"),
+    _k("HYDRAGNN_SERVE_RELOAD_ROOT", "Serving.reload_root", "",
+       "hydragnn_tpu/serve/config.py",
+       "allowlisted checkpoint dir for non-loopback POST /reload"),
+    _k("HYDRAGNN_SERVE_QUANT_POLICY", "Serving.quant_policy", "f32",
+       "hydragnn_tpu/serve/config.py",
+       "inference dtype policy: f32 | bf16 | int8"),
+    _k("HYDRAGNN_SERVE_QUANT_TOL", "Serving.quant_tolerance", "0.05",
+       "hydragnn_tpu/serve/config.py",
+       "max golden-batch drift a quant policy may introduce"),
+    _k("HYDRAGNN_SERVE_FLEET", "Serving.fleet_replicas", "0",
+       "hydragnn_tpu/serve/config.py",
+       "replica count behind the failover router (0 = single server)"),
+    _k("HYDRAGNN_SERVE_FLEET_INPROCESS", "Serving.fleet_inprocess", "0",
+       "hydragnn_tpu/serve/config.py",
+       "thread replicas in-process (shared compile cache)"),
+    _k("HYDRAGNN_SERVE_FLEET_PROBE_S", "Serving.fleet_probe_s", "1",
+       "hydragnn_tpu/serve/config.py",
+       "supervisor health-probe interval"),
+    _k("HYDRAGNN_SERVE_FLEET_BACKOFF_S",
+       "Serving.fleet_restart_backoff_s", "1",
+       "hydragnn_tpu/serve/config.py",
+       "replica restart backoff base (doubles per restart)"),
+    _k("HYDRAGNN_SERVE_FLEET_BACKOFF_MAX_S",
+       "Serving.fleet_restart_backoff_max_s", "30",
+       "hydragnn_tpu/serve/config.py", "replica restart backoff cap"),
+    _k("HYDRAGNN_SERVE_FLEET_MAX_RESTARTS", "Serving.fleet_max_restarts",
+       "5", "hydragnn_tpu/serve/config.py",
+       "restart-storm cap per window (exceeded -> FAILED)"),
+    _k("HYDRAGNN_SERVE_FLEET_RESTART_WINDOW_S",
+       "Serving.fleet_restart_window_s", "300",
+       "hydragnn_tpu/serve/config.py", "restart-storm window"),
+    _k("HYDRAGNN_SERVE_FLEET_DRAIN_S", "Serving.fleet_drain_timeout_s",
+       "10", "hydragnn_tpu/serve/config.py",
+       "drain-and-replace in-flight budget"),
+    _k("HYDRAGNN_SERVE_FLEET_STARTUP_S",
+       "Serving.fleet_startup_timeout_s", "300",
+       "hydragnn_tpu/serve/config.py",
+       "subprocess replica first-/healthz budget"),
+    _k("HYDRAGNN_SERVE_FLEET_QUORUM", "Serving.fleet_quorum",
+       "0 (majority)", "hydragnn_tpu/serve/config.py",
+       "live replicas below this -> fleet_degraded"),
+    # -- misc -------------------------------------------------------------
+    _k("HYDRAGNN_SYSTEM", "", "",
+       "hydragnn_tpu/hpo.py",
+       "HPC system name for HPO launch templates"),
+    _k("HYDRAGNN_TEST_SCRATCH", "", "/tmp/hydragnn_tpu_tests",
+       "tests/conftest.py", "test scratch directory"),
+]
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in _KNOB_LIST}
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthKind:
+    name: str
+    module: str  # emitting module (repo-relative)
+    desc: str
+
+
+def _h(name, module, desc):
+    return HealthKind(name=name, module=module, desc=desc)
+
+
+_HEALTH_LIST = [
+    # training resilience (docs/TELEMETRY.md "health — resilience events")
+    _h("step_skipped", "hydragnn_tpu/telemetry/logger.py",
+       "in-jit non-finite guard suppressed update(s)"),
+    _h("preempt_save", "hydragnn_tpu/train/trainer.py",
+       "preemption resume bundle written"),
+    _h("walltime_save", "hydragnn_tpu/train/trainer.py",
+       "SLURM-walltime resume bundle written"),
+    _h("resume_from", "hydragnn_tpu/train/trainer.py",
+       "run restored a resume bundle"),
+    _h("ckpt_retry", "hydragnn_tpu/resilience/ckpt_io.py",
+       "one failed checkpoint-write attempt"),
+    _h("ckpt_giveup", "hydragnn_tpu/resilience/ckpt_io.py",
+       "checkpoint retries exhausted, run degraded gracefully"),
+    _h("nonfinite_abort", "hydragnn_tpu/resilience/guards.py",
+       "guard monitor hit N consecutive bad steps and raised"),
+    # serving lifecycle (docs/TELEMETRY.md "Serving events")
+    _h("request_enqueued", "hydragnn_tpu/serve/batcher.py",
+       "request accepted into the bounded queue"),
+    _h("batch_flushed", "hydragnn_tpu/serve/batcher.py",
+       "micro-batcher ran one padded prediction"),
+    _h("deadline_flush", "hydragnn_tpu/serve/batcher.py",
+       "max_wait_ms fired before a bucket filled"),
+    _h("cache_miss", "hydragnn_tpu/serve/engine.py",
+       "a request batch compiled at serve time (warmup gap)"),
+    _h("batch_error", "hydragnn_tpu/serve/batcher.py",
+       "engine failure surfaced to a batch's requests"),
+    _h("serve_start", "hydragnn_tpu/serve/server.py",
+       "server (or fleet router) came up"),
+    _h("serve_drain", "hydragnn_tpu/serve/server.py",
+       "graceful drain completed"),
+    # overload / robustness (docs/TELEMETRY.md "Overload/robustness kinds")
+    _h("request_shed", "hydragnn_tpu/serve/batcher.py",
+       "admission control rejected a request before queueing (429)"),
+    _h("deadline_expired", "hydragnn_tpu/serve/batcher.py",
+       "queued entries whose budget ran out, skipped pre-batch (429)"),
+    _h("predict_timeout", "hydragnn_tpu/serve/batcher.py",
+       "flush exceeded the predict watchdog (504)"),
+    _h("breaker_open", "hydragnn_tpu/resilience/breaker.py",
+       "circuit breaker tripped open"),
+    _h("breaker_half_open", "hydragnn_tpu/resilience/breaker.py",
+       "breaker cooldown elapsed, probe flush armed"),
+    _h("breaker_close", "hydragnn_tpu/resilience/breaker.py",
+       "probe succeeded, breaker closed"),
+    _h("reload_ok", "hydragnn_tpu/serve/engine.py",
+       "hot checkpoint reload validated and swapped"),
+    _h("reload_rollback", "hydragnn_tpu/serve/engine.py",
+       "reload rejected / rolled back (validation, breaker, api)"),
+    # quantized inference (docs/TELEMETRY.md "Quantized-inference kinds")
+    _h("quant_policy", "hydragnn_tpu/serve/engine.py",
+       "non-f32 dtype policy passed the golden gate and serves"),
+    _h("quant_reject", "hydragnn_tpu/serve/engine.py",
+       "requested policy exceeded quant_tolerance, fell back to f32"),
+    # replica fleet (docs/TELEMETRY.md "Fleet events")
+    _h("fleet_start", "hydragnn_tpu/serve/fleet.py",
+       "supervisor brought the replica pool up"),
+    _h("replica_start", "hydragnn_tpu/serve/fleet.py",
+       "one replica entered routing"),
+    _h("replica_dead", "hydragnn_tpu/serve/fleet.py",
+       "replica left routing involuntarily"),
+    _h("replica_restart", "hydragnn_tpu/serve/fleet.py",
+       "supervisor restarted a replica"),
+    _h("replica_eject", "hydragnn_tpu/serve/fleet.py",
+       "replica taken out of routing (breaker / restart storm)"),
+    _h("replica_readmit", "hydragnn_tpu/serve/fleet.py",
+       "ejected replica re-entered routing after cooldown"),
+    _h("replica_drain", "hydragnn_tpu/serve/fleet.py",
+       "drain-and-replace began"),
+    _h("rolling_reload_start", "hydragnn_tpu/serve/fleet.py",
+       "one-replica-at-a-time fleet reload began"),
+    _h("rolling_reload_ok", "hydragnn_tpu/serve/fleet.py",
+       "fleet reload completed on every replica"),
+    _h("rolling_reload_rollback", "hydragnn_tpu/serve/fleet.py",
+       "fleet reload aborted; swapped replicas rolled back"),
+    _h("fleet_probe_error", "hydragnn_tpu/serve/fleet.py",
+       "supervisor probe loop hit an unexpected error (loop survives)"),
+    _h("fleet_retry", "hydragnn_tpu/serve/router.py",
+       "router failed a request over to another replica"),
+    _h("fleet_degraded", "hydragnn_tpu/serve/fleet.py",
+       "live replicas dropped below quorum"),
+    _h("fleet_empty", "hydragnn_tpu/serve/router.py",
+       "a request found no live replica (503)"),
+]
+
+HEALTH_KINDS: Dict[str, HealthKind] = {h.name: h for h in _HEALTH_LIST}
+
+
+KNOB_DOC_HEADER = """\
+# Env knobs — the generated registry
+
+GENERATED by `python tools/graftlint.py --emit-docs` from
+`hydragnn_tpu/analysis/registry.py` — do not edit by hand; the lint gate
+(`tests/test_lint.py`, rule REG002) fails when this file drifts from the
+registry.  Config spellings follow the env-wins overlay convention
+(`hydragnn_tpu/utils/env.py` truthiness rules: unset/empty/`0`/`false`
+disables a flag).
+
+| knob | config spelling | default | owning module | effect |
+|---|---|---|---|---|
+"""
+
+
+def emit_knob_docs() -> str:
+    """Render docs/KNOBS.md from the registry."""
+    rows = []
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        cfg = f"`{k.config}`" if k.config else "—"
+        default = k.default if k.default != "" else "—"
+        rows.append(f"| `{k.name}` | {cfg} | {default} "
+                    f"| `{k.module}` | {k.desc} |")
+    return KNOB_DOC_HEADER + "\n".join(rows) + "\n"

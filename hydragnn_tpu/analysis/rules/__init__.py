@@ -1,0 +1,1 @@
+"""Rule modules — importing each registers its rules (see core.register)."""

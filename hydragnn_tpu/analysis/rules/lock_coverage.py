@@ -1,0 +1,146 @@
+"""Lock-coverage rule: shared mutable state in lock-owning classes.
+
+The MetricsLogger/batcher/fleet bug class (PRs 4, 5, 6, 8 each paid a
+review-hardening pass for one): a class owns a ``threading.Lock`` because
+a second thread reaches it, but one write site to a shared attribute
+slips in outside ``with self._lock`` — a torn counter under load, or a
+lost update that only reproduces at fleet rates.
+
+Rule: in any class that owns a Lock/RLock/Condition attribute, an
+instance attribute WRITTEN from two or more methods (``__init__`` and
+friends exempt — single-threaded construction) must only be mutated
+under a ``with self.<lock>`` block.
+
+Escape hatches, in preference order: (1) actually take the lock; (2) a
+method named ``*_locked`` or whose docstring contains "caller holds" /
+"lock held" / "under the lock" is treated as externally guarded; (3) a
+``# graftlint: disable=LCK001 (reason)`` suppression for provably-benign
+cases (e.g. monotonic flag set before the thread starts).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..astutil import base_name, build_parents, call_name
+from ..core import Finding, Rule, Severity, register
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__repr__",
+                   "__del__"}
+_GUARD_DOC_MARKERS = ("caller holds", "lock held", "under the lock",
+                      "holding the lock")
+
+
+def _method_is_externally_guarded(m: ast.AST) -> bool:
+    if m.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(m) or ""
+    low = doc.lower()
+    return any(marker in low for marker in _GUARD_DOC_MARKERS)
+
+
+def _self_attr_writes(m: ast.AST) -> List[ast.Attribute]:
+    """Attribute targets ``self.X`` written anywhere in a method
+    (Assign/AugAssign/AnnAssign, tuple unpacking included)."""
+    out: List[ast.Attribute] = []
+
+    def targets_of(node):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        return []
+
+    for node in ast.walk(m):
+        for tgt in targets_of(node):
+            for t in ast.walk(tgt):
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and isinstance(t.ctx, (ast.Store,))):
+                    out.append(t)
+    return out
+
+
+def _is_under_lock(node: ast.AST, parents, lock_attrs: Set[str]) -> bool:
+    q = node
+    while q in parents:
+        q = parents[q]
+        if isinstance(q, (ast.With, ast.AsyncWith)):
+            for item in q.items:
+                e = item.context_expr
+                # `with self._lock:` — and `with self._cv:` etc.
+                if (isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self"
+                        and e.attr in lock_attrs):
+                    return True
+        if isinstance(q, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+@register
+class UnguardedSharedWrite(Rule):
+    id = "LCK001"
+    name = "unguarded-shared-write"
+    severity = Severity.ERROR
+    doc = ("in a lock-owning class, attributes written from >=2 methods "
+           "must be mutated under `with self.<lock>`")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        parents = build_parents(ctx.tree)
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            lock_attrs: Set[str] = set()
+            for m in methods:
+                for node in ast.walk(m):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)
+                            and base_name(call_name(node.value))
+                            in _LOCK_CTORS):
+                        for t in node.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                lock_attrs.add(t.attr)
+            if not lock_attrs:
+                continue
+
+            # attr -> {method name -> [write nodes]} over non-exempt,
+            # non-externally-guarded methods
+            writes: Dict[str, Dict[str, List[ast.Attribute]]] = {}
+            for m in methods:
+                if m.name in _EXEMPT_METHODS:
+                    continue
+                for t in _self_attr_writes(m):
+                    if t.attr in lock_attrs:
+                        continue
+                    writes.setdefault(t.attr, {}).setdefault(
+                        m.name, []).append(t)
+
+            for attr, by_method in sorted(writes.items()):
+                if len(by_method) < 2:
+                    continue
+                for mname, nodes in sorted(by_method.items()):
+                    method = next(m for m in methods if m.name == mname)
+                    if _method_is_externally_guarded(method):
+                        continue
+                    for node in nodes:
+                        if not _is_under_lock(node, parents, lock_attrs):
+                            out.append(self.finding(
+                                ctx, node,
+                                f"`self.{attr}` is written from "
+                                f"{len(by_method)} methods of lock-owning "
+                                f"class `{cls.name}` but this write in "
+                                f"`{mname}` is outside `with self."
+                                f"{'/'.join(sorted(lock_attrs))}`"))
+        return out

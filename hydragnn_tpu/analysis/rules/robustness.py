@@ -1,0 +1,152 @@
+"""Robustness-idiom rules: exception hygiene and atomic sidecar writes.
+
+- ROB001: a broad ``except Exception`` that neither re-raises, nor logs,
+  nor emits a health event, nor USES the caught exception value swallows
+  the failure silently — the class of bug that turns a checkpoint-write
+  error into a run that "succeeded" with no checkpoint (the PR-7
+  save_checkpoint silent-False bug).
+- ROB002: ``open(path, "w")`` + ``json.dump``/``pickle.dump`` without
+  the tmp+``os.replace`` idiom leaves a torn file when the process dies
+  mid-write — the PR-3 best-model-pickle bug.  resilience/ckpt_io.py
+  has the atomic writer; use it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..astutil import base_name, call_name, const_str
+from ..core import Finding, Rule, Severity, register
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGING_ATTRS = {"warning", "warn", "error", "exception", "info",
+                  "debug", "critical", "log", "health", "print_exc",
+                  "fail", "set_exception"}
+_LOGGING_NAMES = {"print"}
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:  # bare except
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Attribute) and t.attr in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _handler_handles(h: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, logs, emits health, or uses the
+    caught exception value (propagating the reason somewhere)."""
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _LOGGING_ATTRS:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in _LOGGING_NAMES:
+                return True
+    if h.name:
+        for node in ast.walk(h):
+            if (isinstance(node, ast.Name) and node.id == h.name
+                    and isinstance(node.ctx, ast.Load)):
+                return True
+    return False
+
+
+@register
+class SwallowedException(Rule):
+    id = "ROB001"
+    name = "swallowed-broad-except"
+    severity = Severity.WARN
+    doc = ("broad `except Exception` must re-raise, log, emit a health "
+           "event, or use the error — never swallow silently")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_handler(node):
+                continue
+            if _handler_handles(node):
+                continue
+            out.append(self.finding(
+                ctx, node,
+                "broad except swallows the error silently — narrow the "
+                "exception type, log/emit a health event, or annotate "
+                "with `# graftlint: disable=ROB001 (reason)`"))
+        return out
+
+
+def _open_write_target(call: ast.Call) -> Optional[ast.AST]:
+    """The path argument when ``call`` is ``open(path, "w"/"wb"/...)``."""
+    if base_name(call_name(call)) != "open" or not call.args:
+        return None
+    mode = ""
+    if len(call.args) >= 2:
+        mode = const_str(call.args[1]) or ""
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = const_str(kw.value) or ""
+    if "w" not in mode:
+        return None
+    return call.args[0]
+
+
+def _expr_is_tmpish(node: ast.AST, src_segment: str) -> bool:
+    low = src_segment.lower()
+    return "tmp" in low or "temp" in low or "partial" in low
+
+
+@register
+class NonAtomicSidecarWrite(Rule):
+    id = "ROB002"
+    name = "non-atomic-sidecar-write"
+    severity = Severity.WARN
+    doc = ("json/pickle sidecars must be written tmp+os.replace "
+           "(resilience/ckpt_io.py has the atomic writer)")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        from ..astutil import build_parents, enclosing_function
+
+        out: List[Finding] = []
+        parents = build_parents(ctx.tree)
+        for w in ast.walk(ctx.tree):
+            if not isinstance(w, (ast.With, ast.AsyncWith)):
+                continue
+            for item in w.items:
+                e = item.context_expr
+                if not isinstance(e, ast.Call):
+                    continue
+                target = _open_write_target(e)
+                if target is None:
+                    continue
+                seg = ast.get_source_segment(ctx.src, target) or ""
+                if _expr_is_tmpish(target, seg):
+                    continue
+                dumps = [c for c in ast.walk(w)
+                         if isinstance(c, ast.Call)
+                         and call_name(c) in ("json.dump", "pickle.dump")]
+                if not dumps:
+                    continue
+                # the atomic idiom: os.replace anywhere in the enclosing
+                # function (the dump goes to a tmp we failed to name-spot,
+                # or the function renames after the with-block)
+                scope = enclosing_function(w, parents) or ctx.tree
+                if any(isinstance(n, ast.Call)
+                       and call_name(n) in ("os.replace", "os.rename")
+                       for n in ast.walk(scope)):
+                    continue
+                out.append(self.finding(
+                    ctx, w,
+                    "non-atomic sidecar write: open(..., 'w') + dump "
+                    "without tmp+os.replace — a crash mid-write tears "
+                    "the file"))
+        return out

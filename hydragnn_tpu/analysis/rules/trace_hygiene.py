@@ -1,0 +1,348 @@
+"""Trace-hygiene rules: invariants of code that runs under jax tracing.
+
+The bug classes these encode (docs/ANALYSIS.md has the history):
+
+- TRC001: a host-sync call (``.item()``, ``np.asarray``, ``time.time``)
+  inside a traced function either fails at trace time or silently bakes
+  a trace-time constant into the compiled program.
+- TRC002: a Python ``if``/``while`` on a traced argument raises a
+  ConcretizationTypeError at trace time — or, with weak typing, forces
+  an early concretization sync.
+- TRC003: constructing ``jax.jit``/``shard_map`` wrappers inside a loop
+  re-traces per iteration — the PR-7 consolidate bug: a fresh jit
+  wrapper on the checkpoint path re-traced every leaf on every save,
+  inside the SIGTERM grace window.
+- TRC004: an argument donated via ``donate_argnums`` is DELETED by the
+  call; reading it afterwards fails (or silently reads garbage on some
+  backends).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..astutil import (base_name, build_parents, call_name, const_str,
+                       walk_skip_nested_functions)
+from ..core import Finding, Rule, Severity, register
+
+# callables whose function-valued arguments are traced
+JIT_WRAPPERS = {"jit", "pjit", "pmap"}
+TRACING_CALLERS = JIT_WRAPPERS | {
+    "shard_map", "_shard_map", "pallas_call", "scan", "fori_loop",
+    "while_loop", "cond", "switch", "vmap", "grad", "value_and_grad",
+    "remat", "checkpoint", "custom_vjp",
+}
+
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "jax.device_get", "device_get",
+}
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    """True when a decorator marks the function as traced
+    (``@jax.jit``, ``@partial(jax.jit, ...)``, ``@_shard_map(...)``)."""
+    if isinstance(dec, ast.Call):
+        name = base_name(call_name(dec))
+        if name in TRACING_CALLERS:
+            return True
+        if name == "partial" and dec.args:
+            return base_name(dotted_or_none(dec.args[0])) in TRACING_CALLERS
+        return False
+    return base_name(dotted_or_none(dec)) in TRACING_CALLERS
+
+
+def dotted_or_none(node: ast.AST) -> Optional[str]:
+    from ..astutil import dotted
+
+    return dotted(node)
+
+
+def _static_names(call_or_dec: Optional[ast.Call],
+                  fn: ast.AST) -> Set[str]:
+    """Parameter names excluded from tracing: static_argnums/argnames
+    (when constant) plus the conventional self/cls."""
+    out = {"self", "cls"}
+    if call_or_dec is None:
+        return out
+    posnames = [a.arg for a in getattr(fn.args, "posonlyargs", [])] + \
+        [a.arg for a in fn.args.args]
+    for kw in call_or_dec.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        vals = []
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = [getattr(e, "value", None) for e in kw.value.elts]
+        elif isinstance(kw.value, ast.Constant):
+            vals = [kw.value.value]
+        for v in vals:
+            if isinstance(v, int) and 0 <= v < len(posnames):
+                out.add(posnames[v])
+            elif isinstance(v, str):
+                out.add(v)
+    return out
+
+
+def _collect_traced_functions(tree: ast.AST) -> List[Tuple[ast.AST, Set[str]]]:
+    """Find (function node, traced param names) pairs: decorated defs,
+    defs/lambdas passed to tracing callers."""
+    defs_by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+
+    traced: Dict[ast.AST, Set[str]] = {}
+
+    def add(fn: ast.AST, statics: Set[str]) -> None:
+        params = [a.arg for a in getattr(fn.args, "posonlyargs", [])] + \
+            [a.arg for a in fn.args.args] + \
+            [a.arg for a in fn.args.kwonlyargs]
+        names = {p for p in params if p not in statics}
+        if fn in traced:
+            traced[fn] &= names  # keep the intersection when marked twice
+        else:
+            traced[fn] = names
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _decorator_traces(dec):
+                    call = dec if isinstance(dec, ast.Call) else None
+                    add(node, _static_names(call, node))
+        elif isinstance(node, ast.Call):
+            if base_name(call_name(node)) not in TRACING_CALLERS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    add(arg, _static_names(node, arg))
+                elif isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                    fn = defs_by_name[arg.id]
+                    add(fn, _static_names(node, fn))
+    return list(traced.items())
+
+
+@register
+class HostSyncInTracedFunction(Rule):
+    id = "TRC001"
+    name = "host-sync-in-traced-fn"
+    severity = Severity.ERROR
+    doc = ("no host-sync calls (.item()/np.asarray/time.time/device_get) "
+           "inside functions that run under jax tracing")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn, _params in _collect_traced_functions(ctx.tree):
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = call_name(node)
+                    if name in HOST_SYNC_CALLS or (
+                            isinstance(node.func, ast.Attribute)
+                            and node.func.attr in HOST_SYNC_ATTRS
+                            and not node.args):
+                        label = name or f".{node.func.attr}()"
+                        out.append(self.finding(
+                            ctx, node,
+                            f"host-sync call `{label}` inside traced "
+                            f"function `{getattr(fn, 'name', '<lambda>')}` "
+                            f"— hoist it out of the traced region"))
+        return out
+
+
+def _dynamic_param_refs(test: ast.AST, params: Set[str]) -> List[ast.Name]:
+    """Name nodes in a condition that reference traced params in a way
+    that concretizes them.  Static accesses (``x.shape``/``x.ndim``/
+    ``x.dtype``, ``len(x)``, ``isinstance(x, ...)``, ``x is None``,
+    membership tests) are excluded."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+            for op in test.ops):
+        return []
+    parents = build_parents(test)
+    refs = []
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in params):
+            continue
+        p = parents.get(node)
+        if isinstance(p, ast.Attribute) and p.attr in (
+                "shape", "ndim", "dtype", "size", "aval", "sharding"):
+            continue
+        skip = False
+        q = node
+        while q in parents:
+            q = parents[q]
+            if isinstance(q, ast.Call) and base_name(call_name(q)) in (
+                    "isinstance", "len", "hasattr", "getattr", "callable",
+                    "type"):
+                skip = True
+                break
+            if isinstance(q, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                    for op in q.ops):
+                skip = True
+                break
+        if not skip:
+            refs.append(node)
+    return refs
+
+
+@register
+class BranchOnTracedArgument(Rule):
+    id = "TRC002"
+    name = "python-branch-on-traced-arg"
+    severity = Severity.ERROR
+    doc = ("no Python if/while on traced arguments inside traced "
+           "functions — use lax.cond/jnp.where")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn, params in _collect_traced_functions(ctx.tree):
+            if not params:
+                continue
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.If, ast.While)):
+                        test = node.test
+                    elif isinstance(node, ast.IfExp):
+                        test = node.test
+                    else:
+                        continue
+                    refs = _dynamic_param_refs(test, params)
+                    if refs:
+                        names = ", ".join(sorted({r.id for r in refs}))
+                        out.append(self.finding(
+                            ctx, node,
+                            f"Python branch on traced argument(s) "
+                            f"`{names}` inside traced function "
+                            f"`{getattr(fn, 'name', '<lambda>')}` — "
+                            f"use lax.cond/jnp.where or mark the "
+                            f"argument static"))
+        return out
+
+
+@register
+class JitConstructionInLoop(Rule):
+    id = "TRC003"
+    name = "jit-construction-in-loop"
+    severity = Severity.ERROR
+    doc = ("no jax.jit/shard_map/pallas_call wrapper construction inside "
+           "a loop — each iteration re-traces (cache the wrapper)")
+
+    _CTORS = {"jit", "pjit", "pmap", "shard_map", "_shard_map",
+              "pallas_call"}
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in walk_skip_nested_functions(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                bn = base_name(name)
+                hit = bn in self._CTORS or (
+                    bn == "partial" and node.args
+                    and base_name(dotted_or_none(node.args[0]))
+                    in self._CTORS)
+                if hit:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"`{name}(...)` constructed inside a loop — the "
+                        f"wrapper's trace cache is thrown away every "
+                        f"iteration (hoist/cache it; the PR-7 "
+                        f"re-trace-every-save bug)"))
+        return out
+
+
+@register
+class DonatedArgumentReused(Rule):
+    id = "TRC004"
+    name = "donated-arg-reused"
+    severity = Severity.ERROR
+    doc = ("an argument donated to a jitted call must not be read after "
+           "the call — donation deletes its buffer")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for scope in ast.walk(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Module)):
+                continue
+            # ONLY this scope's own statements: a nested/sibling function
+            # body is its own scope (merging them would cross-match
+            # same-named variables between unrelated functions)
+            nodes = []
+            for s in scope.body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                nodes.append(s)
+                nodes.extend(walk_skip_nested_functions(s))
+            donating: Dict[str, List[int]] = {}
+            for stmt in nodes:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                v = stmt.value
+                if not isinstance(v, ast.Call):
+                    continue
+                donate = [kw for kw in v.keywords
+                          if kw.arg in ("donate_argnums",
+                                        "donate_argnames")]
+                if not donate or base_name(call_name(v)) not in (
+                        "jit", "pjit", "pmap"):
+                    continue
+                nums: List[int] = []
+                kv = donate[0].value
+                if isinstance(kv, ast.Constant) and isinstance(
+                        kv.value, int):
+                    nums = [kv.value]
+                elif isinstance(kv, (ast.Tuple, ast.List)):
+                    nums = [e.value for e in kv.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)]
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and nums:
+                        donating[tgt.id] = nums
+            if donating:
+                out.extend(self._check_scope(ctx, nodes, donating))
+        return out
+
+    def _check_scope(self, ctx, nodes, donating) -> List[Finding]:
+        out: List[Finding] = []
+        # (lineno, donated-name) for every donating call site
+        donated_at: List[Tuple[int, str]] = []
+        for node in nodes:
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id in donating:
+                for pos in donating[node.func.id]:
+                    if pos < len(node.args) and isinstance(
+                            node.args[pos], ast.Name):
+                        donated_at.append(
+                            (node.lineno, node.args[pos].id))
+        for call_line, var in donated_at:
+            restored = [n.lineno for n in nodes
+                        if isinstance(n, (ast.Assign, ast.AugAssign))
+                        and any(isinstance(t, ast.Name) and t.id == var
+                                for t in (n.targets if isinstance(
+                                    n, ast.Assign) else [n.target]))]
+            for node in nodes:
+                if (isinstance(node, ast.Name) and node.id == var
+                        and isinstance(node.ctx, ast.Load)
+                        and node.lineno > call_line
+                        and not any(call_line <= r <= node.lineno
+                                    for r in restored)):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"`{var}` was donated to a jitted call at line "
+                        f"{call_line} and read again here — its buffer "
+                        f"is deleted by donation"))
+                    break  # one finding per donated call is enough
+        return out

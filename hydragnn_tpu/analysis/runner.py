@@ -1,0 +1,163 @@
+"""Run rules over a project; apply suppressions, baseline, diff scoping.
+
+Exit-code contract (enforced by tools/graftlint.py): 0 = no unsuppressed
+findings, 1 = findings, 2 = usage/internal error.  The baseline file
+grandfathers provably-benign findings; every entry needs a one-line
+``justification`` (tests/test_lint.py asserts that) so "baseline it"
+never becomes "ignore it silently".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import Finding, Rule, all_rules, is_suppressed, normalize_code
+from .project import Project
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    code: str  # normalized source line
+    justification: str = ""
+
+    def matches(self, f: Finding) -> bool:
+        return (self.rule == f.rule and self.path == f.path
+                and self.code == normalize_code(f.code))
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]  # unsuppressed, unbaselined — these fail
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    stale_baseline: List[BaselineEntry]
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return []
+    return [BaselineEntry(rule=e["rule"], path=e["path"],
+                          code=normalize_code(e.get("code", "")),
+                          justification=e.get("justification", ""))
+            for e in data.get("entries", [])]
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   keep: Sequence[BaselineEntry] = ()) -> None:
+    """Write current findings as baseline entries.  Existing justified
+    entries are kept verbatim ONLY while they still match a finding —
+    stale entries are shed here, so ``--write-baseline`` is the
+    documented remedy for a stale-baseline gate failure."""
+    entries = []
+    seen = set()
+    for e in keep:
+        if not any(e.matches(f) for f in findings):
+            continue  # stale: the finding is gone
+        key = (e.rule, e.path, e.code)
+        if key not in seen:
+            seen.add(key)
+            entries.append(dataclasses.asdict(e))
+    for f in findings:
+        key = (f.rule, f.path, normalize_code(f.code))
+        if key in seen:
+            continue
+        seen.add(key)
+        entries.append({"rule": f.rule, "path": f.path,
+                        "code": normalize_code(f.code),
+                        "justification": "TODO: justify or fix"})
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["code"]))
+    # the atomic idiom ROB002 demands of everyone else (stdlib-only
+    # spelling: this package must not import hydragnn_tpu.resilience)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"entries": entries}, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def changed_lines_from_git(root: str, ref: str) -> Dict[str, Set[int]]:
+    """Map repo-relative path -> changed line numbers vs ``ref``
+    (``git diff -U0``); used by ``--diff`` to scope findings to the PR."""
+    out = subprocess.run(
+        ["git", "diff", "-U0", ref, "--", "*.py"],
+        cwd=root, capture_output=True, text=True, check=True).stdout
+    changed: Dict[str, Set[int]] = {}
+    cur: Optional[str] = None
+    for line in out.splitlines():
+        if line.startswith("+++ b/"):
+            cur = line[6:]
+            changed.setdefault(cur, set())
+        elif line.startswith("@@") and cur is not None:
+            # @@ -a,b +c,d @@
+            plus = line.split("+", 1)[1].split(" ", 1)[0]
+            start, _, count = plus.partition(",")
+            n = int(count) if count else 1
+            changed[cur].update(range(int(start), int(start) + max(n, 1)))
+    return changed
+
+
+def run_project(project: Project,
+                rules: Optional[Sequence[Rule]] = None,
+                baseline: Sequence[BaselineEntry] = (),
+                changed: Optional[Dict[str, Set[int]]] = None) -> LintResult:
+    rules = list(rules) if rules is not None else all_rules()
+    raw: List[Finding] = []
+    for ctx in project.files:
+        for rule in rules:
+            for f in rule.check_file(ctx):
+                raw.append(f)
+    for rule in rules:
+        for f in rule.check_project(project):
+            raw.append(f)
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    suppressed: List[Finding] = []
+    kept: List[Finding] = []
+    for f in raw:
+        ctx = project.by_rel.get(f.path)
+        if ctx is not None and is_suppressed(
+                f, ctx.suppressed_lines, ctx.suppressed_file):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+
+    baselined: List[Finding] = []
+    matched: Set[int] = set()
+    final: List[Finding] = []
+    for f in kept:
+        hit = None
+        for i, e in enumerate(baseline):
+            if e.matches(f):
+                hit = i
+                break
+        if hit is not None:
+            matched.add(hit)
+            baselined.append(f)
+        else:
+            final.append(f)
+    # an entry is stale only when its file was actually scanned — a
+    # subset run (one path, --diff) must not condemn out-of-scope entries
+    stale = [e for i, e in enumerate(baseline)
+             if i not in matched and e.path in project.by_rel]
+
+    if changed is not None:
+        final = [f for f in final
+                 if f.line in changed.get(f.path, set())]
+
+    return LintResult(findings=final, suppressed=suppressed,
+                      baselined=baselined, stale_baseline=stale,
+                      files_scanned=len(project.files))

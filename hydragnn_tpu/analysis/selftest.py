@@ -1,0 +1,107 @@
+"""Fixture-driven selftest: the analyzer itself is tested, not just its
+current verdict on the tree.
+
+Every rule ships at least one fixture that TRIGGERS it and one that
+PASSES it (``fixtures/<ruleid>_bad.py`` / ``<ruleid>_ok.py``).  The
+fixtures directory is excluded from normal scans (project.EXCLUDE_DIRS)
+precisely because its files violate invariants on purpose.
+
+``tools/graftlint.py --selftest`` and ``tests/test_lint.py`` both run
+:func:`run_selftest`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from .core import all_rules, is_suppressed
+from .project import Project, parse_file
+
+# rules whose fixtures are ordinary per-file checks
+PER_FILE_RULES = ("TRC001", "TRC002", "TRC003", "TRC004", "LCK001",
+                  "REG001", "REG003", "ROB001", "ROB002")
+# project-scope rules exercised by special-case harnesses below
+PROJECT_RULES = ("REG002", "REG004", "REG005")
+
+
+def fixtures_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def _rule(rule_id: str):
+    return next(r for r in all_rules() if r.id == rule_id)
+
+
+def _file_findings(rule_id: str, path: str) -> List:
+    ctx = parse_file(path, root=os.path.dirname(path))
+    return [f for f in _rule(rule_id).check_file(ctx)
+            if f.rule == rule_id]
+
+
+def run_selftest() -> Tuple[bool, List[str]]:
+    """Exercise every rule against its fixtures.  Returns (ok, report)."""
+    fdir = fixtures_dir()
+    report: List[str] = []
+    ok = True
+
+    def check(cond: bool, msg: str) -> None:
+        nonlocal ok
+        report.append(("PASS " if cond else "FAIL ") + msg)
+        ok = ok and cond
+
+    for rule_id in PER_FILE_RULES:
+        low = rule_id.lower()
+        bad = os.path.join(fdir, f"{low}_bad.py")
+        good = os.path.join(fdir, f"{low}_ok.py")
+        check(os.path.exists(bad) and os.path.exists(good),
+              f"{rule_id}: fixture pair exists")
+        if not (os.path.exists(bad) and os.path.exists(good)):
+            continue
+        check(len(_file_findings(rule_id, bad)) >= 1,
+              f"{rule_id}: _bad fixture triggers the rule")
+        check(len(_file_findings(rule_id, good)) == 0,
+              f"{rule_id}: _ok fixture passes the rule")
+
+    # REG005 (config-key drift) pairs a fixture file with itself via the
+    # *_defaults/from_* fallback
+    reg5 = _rule("REG005")
+    files = [parse_file(os.path.join(fdir, n), root=fdir)
+             for n in ("reg005_bad.py", "reg005_ok.py")
+             if os.path.exists(os.path.join(fdir, n))]
+    check(len(files) == 2, "REG005: fixture pair exists")
+    if len(files) == 2:
+        found = list(reg5.check_project(Project(root=fdir, files=files)))
+        check(any(f.path == "reg005_bad.py" for f in found),
+              "REG005: _bad fixture triggers the rule")
+        check(not any(f.path == "reg005_ok.py" for f in found),
+              "REG005: _ok fixture passes the rule")
+
+    # REG002/REG004 (registry drift): a project holding ONLY the registry
+    # mentions no knob and emits no kind — every declared entry must be
+    # reported stale/unemitted.  The full-tree gate is the ok-direction.
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(os.path.dirname(here))
+    reg_ctx = parse_file(os.path.join(here, "registry.py"), root=repo_root)
+    lonely = Project(root=fdir, files=[reg_ctx])  # no docs under fdir
+    for rule_id in ("REG002", "REG004"):
+        found = list(_rule(rule_id).check_project(lonely))
+        check(len(found) >= 1,
+              f"{rule_id}: registry-only project triggers the rule")
+
+    # suppression mechanics: a violating line with an inline
+    # `# graftlint: disable=...` must lint clean
+    sup = os.path.join(fdir, "suppress_ok.py")
+    check(os.path.exists(sup), "suppressions: fixture exists")
+    if os.path.exists(sup):
+        ctx = parse_file(sup, root=fdir)
+        raw = [f for r in all_rules() for f in r.check_file(ctx)]
+        check(len(raw) >= 1,
+              "suppressions: fixture raises raw findings")
+        unsup = [f for f in raw if not is_suppressed(
+            f, ctx.suppressed_lines, ctx.suppressed_file)]
+        check(len(unsup) == 0,
+              "suppressions: inline disables silence them all")
+
+    return ok, report

@@ -48,6 +48,10 @@ def _telemetry_defaults() -> Dict[str, Any]:
     return {
         "enable": int(d.enable),
         "sinks": ",".join(d.sinks),
+        # "" = the run-dir default (logs/<run>/telemetry); written back
+        # so every key TelemetryConfig.from_section reads has a
+        # documented default in the saved config.json (graftlint REG005)
+        "dir": d.dir or "",
         "heartbeat": d.heartbeat,
         "ring": d.ring,
         "sync_steps": int(d.sync_steps),
@@ -341,6 +345,9 @@ def get_log_name_config(config: Dict[str, Any]) -> str:
 
 
 def save_config(config: Dict[str, Any], log_name: str, path: str = "./logs/") -> None:
+    from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
+
     os.makedirs(os.path.join(path, log_name), exist_ok=True)
-    with open(os.path.join(path, log_name, "config.json"), "w") as f:
-        json.dump(config, f, indent=4)
+    # atomic: the saved config.json is what `python -m hydragnn_tpu.serve`
+    # later loads — a crash mid-write must not tear it
+    atomic_write_json(os.path.join(path, log_name, "config.json"), config)

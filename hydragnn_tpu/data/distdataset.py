@@ -163,7 +163,7 @@ class DistDataset(AbstractBaseDataset):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # graftlint: disable=ROB001 (__del__ must never raise; close is best-effort)
             pass
 
 
@@ -174,5 +174,5 @@ def _local_ip() -> str:
         ip = s.getsockname()[0]
         s.close()
         return ip
-    except Exception:
+    except OSError:
         return "127.0.0.1"

@@ -229,7 +229,7 @@ class GpackDataset(AbstractBaseDataset):
                 try:
                     self.parts.append(_NativePart(f))
                     continue
-                except Exception:
+                except Exception:  # graftlint: disable=ROB001 (deliberate fallback ladder; numpy part reads the same file)
                     pass
             self.parts.append(_NumpyPart(f))
         self.attrs = self.parts[0].attrs

@@ -17,6 +17,8 @@ import pickle
 from typing import Any, List, Optional, Sequence
 
 from hydragnn_tpu.data.abstract import AbstractBaseDataset
+from hydragnn_tpu.resilience.ckpt_io import (atomic_write_pickle,
+                                             atomic_write_pickles)
 
 
 class SimplePickleWriter:
@@ -50,8 +52,9 @@ class SimplePickleWriter:
                 "minmax_graph_feature": minmax_graph_feature,
                 "attrs": attrs or {},
             }
-            with open(os.path.join(dirname, "meta.pkl"), "wb") as f:
-                pickle.dump(meta, f)
+            # atomic: the header is the split's single point of failure —
+            # a torn meta.pkl makes every sample file unreadable
+            atomic_write_pickle(os.path.join(dirname, "meta.pkl"), meta)
         for i, s in enumerate(samples):
             gid = offset + i
             subdir = ""
@@ -59,7 +62,9 @@ class SimplePickleWriter:
                 subdir = str(gid // nmax_persubdir)
                 os.makedirs(os.path.join(dirname, subdir), exist_ok=True)
             fname = os.path.join(dirname, subdir, f"{label}-{gid}.pkl")
-            with open(fname, "wb") as f:
+            # bulk re-runnable dataset build: per-sample tmp+fsync would
+            # dominate write time, and a torn sample fails loudly at load
+            with open(fname, "wb") as f:  # graftlint: disable=ROB002 (bulk build; torn file fails loudly at load)
                 pickle.dump(s, f)
 
 
@@ -113,10 +118,9 @@ class SerializedWriter:
     ):
         dirname = os.path.join(basedir, name)
         os.makedirs(dirname, exist_ok=True)
-        with open(os.path.join(dirname, f"{label}-{rank}.pkl"), "wb") as f:
-            pickle.dump(minmax_node_feature, f)
-            pickle.dump(minmax_graph_feature, f)
-            pickle.dump(list(samples), f)
+        atomic_write_pickles(
+            os.path.join(dirname, f"{label}-{rank}.pkl"),
+            minmax_node_feature, minmax_graph_feature, list(samples))
 
 
 class SerializedDataset(AbstractBaseDataset):

@@ -21,6 +21,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_pickles
+
 
 @dataclasses.dataclass
 class RawSample:
@@ -207,10 +209,10 @@ class AbstractRawDataset:
         serialized pickle layout, raw_dataset_loader.py:146-160)."""
         os.makedirs(serialized_dir, exist_ok=True)
         for name, dataset in zip(self.serial_data_name_list, self.dataset_list):
-            with open(os.path.join(serialized_dir, name), "wb") as f:
-                pickle.dump(self.minmax_node_feature, f)
-                pickle.dump(self.minmax_graph_feature, f)
-                pickle.dump(dataset, f)
+            atomic_write_pickles(
+                os.path.join(serialized_dir, name),
+                self.minmax_node_feature, self.minmax_graph_feature,
+                dataset)
 
 
 def _feature_columns(dims: List[int], feat_indices: List[int]) -> List[int]:

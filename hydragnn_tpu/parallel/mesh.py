@@ -105,7 +105,7 @@ def _distributed_initialized() -> bool:
         from jax._src.distributed import global_state
 
         return global_state.client is not None
-    except Exception:  # noqa: BLE001 — private API moved; fall back safe
+    except Exception:  # graftlint: disable=ROB001 (private-API probe; uninitialized is the safe answer)
         return False
 
 

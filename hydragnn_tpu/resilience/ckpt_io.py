@@ -134,3 +134,14 @@ def atomic_write_json(path: str, obj: Any) -> None:
 def atomic_write_pickle(path: str, payload: Any) -> None:
     """Pickle via temp-file + ``os.replace`` (crash-safe finalize)."""
     _atomic_replace(path, lambda f: pickle.dump(payload, f), "wb")
+
+
+def atomic_write_pickles(path: str, *payloads: Any) -> None:
+    """Pickle several objects into ONE stream (the reference serialized-
+    dataset layout: minmax headers then samples), atomically."""
+
+    def write(f):
+        for p in payloads:
+            pickle.dump(p, f)
+
+    _atomic_replace(path, write, "wb")

@@ -150,7 +150,9 @@ def _run_training_dict(config: dict, logs_dir: str, seed: int):
             from torch.utils.tensorboard import SummaryWriter
 
             writer = SummaryWriter(os.path.join(logs_dir, log_name))
-        except Exception:
+        except Exception as e:  # torch optional; scalars just won't land
+            print(f"TensorBoard disabled ({e!r:.120}); epoch scalars "
+                  "will not be written")
             writer = None
 
     # unified telemetry: config's Telemetry section (finalize() wrote the

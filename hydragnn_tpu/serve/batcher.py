@@ -192,10 +192,13 @@ class MicroBatcher:
     # -- producer side -------------------------------------------------------
 
     def start(self) -> "MicroBatcher":
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._run, name="micro-batcher", daemon=True)
-            self._thread.start()
+        # under the lock: two racing start() calls must not spawn two
+        # workers draining one queue
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="micro-batcher", daemon=True)
+                self._thread.start()
         return self
 
     def worker_alive(self) -> bool:
@@ -420,12 +423,18 @@ class MicroBatcher:
         result is discarded (futures already failed)."""
         if self.predict_timeout_s <= 0:
             return self._predict(samples)
-        if self._watchdog is None:
-            self._watchdog = _WatchdogWorker(self._predict)
-        box = self._watchdog.run(samples)
+        # the helper handle is shared with close() (which retires it from
+        # another thread): swap it under the lock, run on a local ref
+        with self._lock:
+            if self._watchdog is None:
+                self._watchdog = _WatchdogWorker(self._predict)
+            wd = self._watchdog
+        box = wd.run(samples)
         if not box["done"].wait(self.predict_timeout_s):
-            self._watchdog.retire()
-            self._watchdog = None
+            with self._lock:
+                if self._watchdog is wd:
+                    self._watchdog = None
+            wd.retire()
             raise PredictTimeoutError(
                 f"predict exceeded the {self.predict_timeout_s:.3g} s "
                 f"watchdog for a {len(samples)}-graph flush")
@@ -643,10 +652,11 @@ class MicroBatcher:
                                     on_item=self._fail)
                 self._q.put(_SENTINEL)
                 self._q.put(_SENTINEL)
-        self._thread = None
-        if self._watchdog is not None:
-            self._watchdog.retire()
-            self._watchdog = None
+        with self._lock:
+            self._thread = None
+            wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.retire()
         # catch stragglers a racing submit slipped behind the sentinel
         # (also consumes stray sentinels left in the queue)
         self._sweep_leftovers()

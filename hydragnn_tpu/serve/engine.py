@@ -469,13 +469,25 @@ class InferenceEngine:
         ``Serving.quant_tolerance`` — otherwise the engine keeps the
         f32 weights (fallback, ``quant_reject`` health event)."""
         # f32 reference replay (smallest bucket): the baseline every
-        # quant policy is gated against
-        self._golden_f32 = self._golden_outputs(self.state, policy="f32")
+        # quant policy is gated against.  The reference capture and the
+        # gate itself must see ONE state snapshot, so _activate_policy
+        # captures the reference inside its own locked region; the
+        # f32-policy path takes the same lock for the same reason
         if self._policy_requested != "f32":
             self._activate_policy(self._policy_requested)
+        else:
+            with self._reload_lock:
+                self._golden_f32 = self._golden_outputs(self.state,
+                                                        policy="f32")
         for spec in self.pad_specs:
             self._executable(spec, warmup=True)
-        self._golden = self._golden_outputs(self.state)
+        # under the reload lock END TO END: the golden reference must be
+        # computed from the SAME state it is stored against — a
+        # watch-triggered reload racing a late warmup could otherwise
+        # swap state between the replay and the store, leaving a stale
+        # golden that 409-rejects the next good candidate
+        with self._reload_lock:
+            self._golden = self._golden_outputs(self.state)
         with self._lock:
             return sum(1 for k in self._compiled if k[0] == self._policy)
 
@@ -485,32 +497,42 @@ class InferenceEngine:
         tolerance.  On rejection the f32 state keeps serving (the
         fallback the HTTP layer reports via /healthz)."""
         tol = float(self.serving.quant_tolerance)
-        staged = self._canon_state(apply_policy(self.state, policy))
-        try:
-            outs = self._golden_outputs(staged, policy=policy)
-            finite = all(np.isfinite(o).all() for o in outs)
-        except Exception as e:  # noqa: BLE001 — any failure rejects
-            self._quant["fallback"] = True
-            self.telemetry.health("quant_reject", policy=policy,
-                                  error=repr(e)[:200])
-            return False
-        delta = max(
-            (float(np.max(np.abs(o.astype(np.float64)
-                                 - g.astype(np.float64))))
-             if o.size else 0.0)
-            for o, g in zip(outs, self._golden_f32))
-        self._quant["golden_max_delta"] = delta
-        if not finite or delta > tol:
-            self._quant["fallback"] = True
-            self.telemetry.health(
-                "quant_reject", policy=policy,
-                golden_max_delta=round(delta, 9), tolerance=tol,
-                finite=finite)
-            return False
-        # accepted: the quantized state replaces the f32 one (freeing
-        # the full-precision replica — the HBM saving IS the point)
-        self.state = staged
-        self._policy = policy
+        # the WHOLE stage-replay-swap sequence rides the reload lock:
+        # staging reads self.state, and a concurrent hot reload swapping
+        # state mid-gate would let the final swap clobber the reloaded
+        # weights with a quantized copy of the pre-reload ones
+        with self._reload_lock:
+            # reference and candidate derive from the SAME state under
+            # one lock hold — a hot reload cannot land between them
+            self._golden_f32 = self._golden_outputs(self.state,
+                                                    policy="f32")
+            staged = self._canon_state(apply_policy(self.state, policy))
+            try:
+                outs = self._golden_outputs(staged, policy=policy)
+                finite = all(np.isfinite(o).all() for o in outs)
+            except Exception as e:  # noqa: BLE001 — any failure rejects
+                self._quant["fallback"] = True
+                self.telemetry.health("quant_reject", policy=policy,
+                                      error=repr(e)[:200])
+                return False
+            delta = max(
+                (float(np.max(np.abs(o.astype(np.float64)
+                                     - g.astype(np.float64))))
+                 if o.size else 0.0)
+                for o, g in zip(outs, self._golden_f32))
+            self._quant["golden_max_delta"] = delta
+            if not finite or delta > tol:
+                self._quant["fallback"] = True
+                self.telemetry.health(
+                    "quant_reject", policy=policy,
+                    golden_max_delta=round(delta, 9), tolerance=tol,
+                    finite=finite)
+                return False
+            # accepted: the quantized state replaces the f32 one
+            # (freeing the full-precision replica — the HBM saving IS
+            # the point)
+            self.state = staged
+            self._policy = policy
         self._quant["active"] = policy
         self.telemetry.health(
             "quant_policy", policy=policy,

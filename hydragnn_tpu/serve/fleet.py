@@ -69,6 +69,7 @@ import subprocess
 import sys
 import threading
 import time
+import http.client
 import urllib.error
 import urllib.request
 # py3.10: concurrent.futures.TimeoutError is not yet the builtin one
@@ -196,8 +197,15 @@ class InProcessReplica:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _set_state(self, state: str) -> None:
+        # lifecycle transitions ride the outstanding-counter lock: the
+        # supervisor probe loop, the router's mark_dead and the rolling
+        # reload all write replica state from different threads
+        with self._out_lock:
+            self.state = state
+
     def start(self) -> None:
-        self.state = "starting"
+        self._set_state("starting")
         self.chaos = _ReplicaChaos(
             self._chaos_factory() if self._chaos_factory else None)
         self.engine = self._engine_factory()
@@ -216,7 +224,7 @@ class InProcessReplica:
             default_deadline_ms=s.request_deadline_ms,
             predict_timeout_s=s.predict_timeout_s, breaker=self.breaker,
             chaos=self.chaos).start()
-        self.state = "live"
+        self._set_state("live")
 
     def _on_breaker_open(self) -> None:
         # same probation rule as the single server: a breaker trip right
@@ -232,7 +240,7 @@ class InProcessReplica:
         if self.batcher is not None:
             self.batcher.close(drain=drain,
                                timeout=self.serving.drain_timeout_s)
-        self.state = "stopped"
+        self._set_state("stopped")
 
     def restart(self) -> None:
         """Recycle: tear the old incarnation down hard, start fresh."""
@@ -383,26 +391,34 @@ class SubprocessReplica:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _set_state(self, state: str) -> None:
+        # same contract as InProcessReplica._set_state: lifecycle
+        # transitions are written from supervisor, router and reload
+        # threads — they ride the outstanding-counter lock
+        with self._out_lock:
+            self.state = state
+
     def start(self) -> None:
-        self.state = "starting"
+        self._set_state("starting")
         self.port = free_port()
         self._proc = subprocess.Popen(self._argv_builder(self.port),
                                       env=self._env)
         deadline = time.monotonic() + self.serving.fleet_startup_timeout_s
         while time.monotonic() < deadline:
             if self._proc.poll() is not None:
-                self.state = "dead"
+                self._set_state("dead")
                 raise ReplicaDeadError(
                     f"replica {self.idx} exited with rc "
                     f"{self._proc.returncode} during startup")
             try:
                 if self._get("/healthz", timeout=2.0) is not None:
-                    self.state = "live"
+                    self._set_state("live")
                     return
-            except Exception:  # noqa: BLE001 — not listening yet
+            except (OSError, ValueError,
+                    http.client.HTTPException):  # not up / partial body
                 pass
             time.sleep(0.2)
-        self.state = "dead"
+        self._set_state("dead")
         raise ReplicaDeadError(
             f"replica {self.idx} did not become healthy within "
             f"{self.serving.fleet_startup_timeout_s:.0f} s")
@@ -420,7 +436,7 @@ class SubprocessReplica:
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait(timeout=5.0)
-        self.state = "stopped"
+        self._set_state("stopped")
 
     def restart(self) -> None:
         self.stop(drain=False)
@@ -446,7 +462,8 @@ class SubprocessReplica:
             return "dead"  # process exit: definitive, no tolerance
         try:
             h = self._get("/healthz", timeout=2.0)
-        except Exception:  # noqa: BLE001 — slow or wedged (e.g. SIGSTOP)
+        except (OSError, ValueError,
+                http.client.HTTPException):  # slow or wedged (SIGSTOP)
             # NOT "dead": one missed 2 s probe on a busy-but-healthy
             # child must not SIGKILL its whole queue — the supervisor
             # requires consecutive misses before declaring death
@@ -458,7 +475,8 @@ class SubprocessReplica:
     def ready_to_readmit(self) -> bool:
         try:
             h = self._get("/healthz", timeout=2.0)
-        except Exception:  # noqa: BLE001
+        except (OSError, ValueError,
+                http.client.HTTPException):  # child gone / partial body
             return False
         br = (h or {}).get("breaker") or {}
         return br.get("state") != "open" \
@@ -524,7 +542,8 @@ class SubprocessReplica:
             payload = {}
             try:
                 payload = json.loads(e.read())
-            except Exception:  # noqa: BLE001
+            except (OSError, ValueError,
+                    http.client.HTTPException):  # unreadable / not JSON
                 pass
             if e.code == 409:
                 raise ReloadValidationError(
@@ -546,7 +565,8 @@ class SubprocessReplica:
         try:
             with urllib.request.urlopen(request, timeout=30.0) as r:
                 return json.loads(r.read()).get("status") == "rolled_back"
-        except Exception:  # noqa: BLE001 — nothing retained / child gone
+        except (OSError, ValueError,
+                http.client.HTTPException):  # nothing retained / gone
             return False
 
     def snapshot(self) -> Dict[str, Any]:
@@ -571,7 +591,7 @@ class SubprocessReplica:
             eng = m.get("engine") or {}
             out["cache"] = {k: int(eng.get(k, 0)) for k in
                             ("hits", "misses", "warmup_compiles")}
-        except Exception:  # noqa: BLE001 — dead/hung child: states only
+        except Exception:  # graftlint: disable=ROB001 (dead/hung child: snapshot degrades to states only)
             pass
         return out
 
@@ -582,7 +602,8 @@ def _error_from_status(e: "urllib.error.HTTPError") -> Exception:
     vocabulary."""
     try:
         payload = json.loads(e.read())
-    except Exception:  # noqa: BLE001
+    except (OSError, ValueError,
+            http.client.HTTPException):  # body unreadable / not JSON
         payload = {}
     msg = str(payload.get("error", f"replica returned {e.code}"))
     retry = float(e.headers.get("Retry-After", 1.0) or 1.0)
@@ -669,26 +690,31 @@ class FleetSupervisor:
             for r in started:
                 try:
                     r.stop(drain=False)
-                except Exception:  # noqa: BLE001 — best-effort teardown
+                except Exception:  # graftlint: disable=ROB001 (best-effort teardown of a failed partial startup)
                     pass
             raise
         self.telemetry.health("fleet_start", replicas=len(self.replicas),
                               mode=self.replicas[0].kind,
                               quorum=self.quorum)
-        self._thread = threading.Thread(
+        t = threading.Thread(
             target=self._probe_loop, name="fleet-supervisor", daemon=True)
-        self._thread.start()
+        with self._lock:
+            self._thread = t
+        t.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        # swap the handle out under the lock, join OUTSIDE it (the probe
+        # loop takes self._lock; joining while holding it would deadlock)
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
         for r in self.replicas:
             try:
                 r.stop(drain=drain)
-            except Exception:  # noqa: BLE001 — best-effort teardown
+            except Exception:  # graftlint: disable=ROB001 (best-effort teardown at fleet shutdown)
                 pass
 
     # -- routing view --------------------------------------------------------

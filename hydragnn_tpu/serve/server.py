@@ -539,7 +539,7 @@ class InferenceServer:
             try:
                 self.engine.reload_from_checkpoint(
                     path, chaos=self.chaos, source="watch")
-            except Exception:  # noqa: BLE001 — reload_rollback emitted
+            except Exception:  # graftlint: disable=ROB001 (reload path already emitted reload_rollback with the error)
                 pass
 
     # -- lifecycle -----------------------------------------------------------

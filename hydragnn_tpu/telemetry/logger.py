@@ -167,7 +167,7 @@ def _loader_padding_efficiency(loader) -> Optional[float]:
         if callable(fn):
             try:
                 return float(fn())
-            except Exception:  # noqa: BLE001
+            except Exception:  # graftlint: disable=ROB001 (duck-typed loader probe; absent metric reports None)
                 return None
         obj = getattr(obj, "loader", None)
     return None
@@ -286,9 +286,11 @@ class MetricsLogger:
         self._step_fn = step_fn
         try:
             self._state_avals = shape_struct_tree(state)
-        except Exception:  # noqa: BLE001 — MFU is best-effort
+        except Exception:  # graftlint: disable=ROB001 (MFU is best-effort; _mfu_broken records the degradation)
             self._state_avals = None
-            self._mfu_broken = True
+            # trainer main thread only — serving threads never touch the
+            # MFU machinery, so the health lock is not required here
+            self._mfu_broken = True  # graftlint: disable=LCK001 (trainer main thread only)
 
     # -- resilience health events --------------------------------------------
 
@@ -412,8 +414,10 @@ class MetricsLogger:
     def resume_counts(self, global_step: int) -> None:
         """Continue the step/dispatch numbering of a preempted run so the
         resumed JSONL stream's ``step`` axis doesn't restart at zero."""
-        self._global_step = max(0, int(global_step))
-        self._dispatch = self._global_step // max(1, self._steps_per_item)
+        # trainer main thread only (resume happens before any serving
+        # thread exists); the step counters are never shared cross-thread
+        self._global_step = max(0, int(global_step))  # graftlint: disable=LCK001 (trainer main thread only)
+        self._dispatch = self._global_step // max(1, self._steps_per_item)  # graftlint: disable=LCK001 (trainer main thread only)
 
     # -- per-step path (zero-sync) -------------------------------------------
 
@@ -455,9 +459,9 @@ class MetricsLogger:
             fl = step_cost_flops(self._step_fn, self._state_avals, avals)
             self._flops_cache[sig] = fl
             return fl
-        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        except Exception:  # graftlint: disable=ROB001 (cost analysis is best-effort; _mfu_broken records it)
             # (e.g. a backend without cost_analysis); disable for the run
-            self._mfu_broken = True
+            self._mfu_broken = True  # graftlint: disable=LCK001 (trainer main thread only)
             return None
 
     def flush_steps(self) -> None:
@@ -478,8 +482,8 @@ class MetricsLogger:
             ng = float(m.get("num_graphs", 0.0))
             nodes_real = float(m.get("nodes_real", 0.0))
             edges_real = float(m.get("edges_real", 0.0))
-            self._dispatch += 1
-            self._global_step += self._steps_per_item
+            self._dispatch += 1  # graftlint: disable=LCK001 (trainer main thread only)
+            self._global_step += self._steps_per_item  # graftlint: disable=LCK001 (trainer main thread only)
             rec: Dict[str, Any] = {
                 "event": "step",
                 "run_id": self.run_id,
@@ -631,7 +635,7 @@ class MetricsLogger:
         for s in self.sinks:
             try:
                 s.close()
-            except Exception:  # noqa: BLE001 — close is best-effort
+            except Exception:  # graftlint: disable=ROB001 (sink close is best-effort at shutdown)
                 pass
         self.sinks = []
 

@@ -140,7 +140,7 @@ class TensorBoardSink(Sink):
 def _json_default(o):
     try:
         return float(o)
-    except Exception:  # noqa: BLE001 — last resort, keep the line valid
+    except (TypeError, ValueError):  # last resort, keep the line valid
         return repr(o)
 
 

@@ -122,9 +122,11 @@ class atomicdescriptors:
 
         if embeddingfilename and (
                 overwritten or not os.path.exists(embeddingfilename)):
-            with open(embeddingfilename, "w") as f:
-                json.dump({str(z): self.normalized[i].tolist()
-                           for i, z in enumerate(zs)}, f)
+            from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
+
+            atomic_write_json(embeddingfilename,
+                              {str(z): self.normalized[i].tolist()
+                               for i, z in enumerate(zs)})
 
     def get_atom_features(self, z: int) -> np.ndarray:
         return self.normalized[self.zs.index(int(z))]

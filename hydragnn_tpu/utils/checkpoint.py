@@ -55,7 +55,7 @@ def _reload(mgr) -> None:
     if callable(reload_fn):
         try:
             reload_fn()
-        except Exception:  # noqa: BLE001 — stale listing beats a crash
+        except Exception:  # graftlint: disable=ROB001 (orbax reload is advisory; stale listing beats a crash)
             pass
 
 
@@ -66,7 +66,7 @@ def close_manager(directory: str) -> None:
     if mgr is not None:
         try:
             mgr.close()
-        except Exception:  # noqa: BLE001 — close is best-effort
+        except Exception:  # graftlint: disable=ROB001 (manager close is best-effort at teardown)
             pass
 
 

@@ -20,7 +20,7 @@ def _rank() -> int:
         import jax
 
         return jax.process_index()
-    except Exception:
+    except Exception:  # graftlint: disable=ROB001 (bootstrap probe; rank 0 is the safe answer pre-init)
         return 0
 
 

@@ -45,7 +45,7 @@ def _remaining_seconds() -> Optional[float]:
             ["squeue", "-h", "-j", job, "-o", "%L"],
             capture_output=True, text=True, timeout=30,
         ).stdout.strip()
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         return None
     if not txt:
         return None

@@ -47,7 +47,8 @@ def test_bf16_step_matches_f32_within_tolerance():
     losses = {}
     for dt in ("float32", "bfloat16"):
         cfg_dt = dataclasses.replace(cfg, compute_dtype=dt)
-        step = jax.jit(make_train_step(create_model(cfg_dt), cfg_dt, opt))
+        step = jax.jit(  # graftlint: disable=TRC003 (one jit per dtype config by design; 2 iterations)
+            make_train_step(create_model(cfg_dt), cfg_dt, opt))
         new_state, metrics = step(state, batch)
         losses[dt] = float(metrics["loss"])
         # params, grads-updated params, and batch stats remain f32
@@ -102,7 +103,8 @@ def test_bf16_dimenet_triplet_chain():
     losses = {}
     for dt in ("float32", "bfloat16"):
         cfg_dt = dataclasses.replace(cfg, compute_dtype=dt)
-        step = jax.jit(make_train_step(create_model(cfg_dt), cfg_dt, opt))
+        step = jax.jit(  # graftlint: disable=TRC003 (one jit per dtype config by design; 2 iterations)
+            make_train_step(create_model(cfg_dt), cfg_dt, opt))
         s = state
         for _ in range(10):
             s, metrics = step(s, batch)

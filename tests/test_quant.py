@@ -214,7 +214,7 @@ def test_hot_reload_and_rollback_with_quantized_policy(setup, tmp_path):
         {"params": jax.random.PRNGKey(9), "dropout": jax.random.PRNGKey(1)},
         example, train=False)
     ckpt = os.path.join(str(tmp_path), "cand.pk")
-    with open(ckpt, "wb") as f:
+    with open(ckpt, "wb") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
         pickle.dump({"step": 5, "params": jax.device_get(v2["params"]),
                      "batch_stats": jax.device_get(
                          v2.get("batch_stats", {}))}, f)

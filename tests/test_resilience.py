@@ -574,7 +574,7 @@ def test_torn_resume_bundle_is_ignored(tmp_path):
     died between the two writes) must fall back, not half-restore."""
     d = str(tmp_path / "resume")
     os.makedirs(d)
-    with open(os.path.join(d, "resume_meta.json"), "w") as f:
+    with open(os.path.join(d, "resume_meta.json"), "w") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
         json.dump({"epoch": 1, "items_consumed": 2, "saved_step": 42}, f)
     with pytest.warns(UserWarning, match="inconsistent"):
         assert load_resume_bundle(_tiny_state(), d) is None

@@ -509,7 +509,7 @@ def test_rolling_reload_and_first_replica_rollback(engine, tmp_path):
         copy_stats = jax.tree_util.tree_map(np.asarray,
                                             r0.engine.state.batch_stats)
         ck = tmp_path / "cand.pk"
-        with open(ck, "wb") as f:
+        with open(ck, "wb") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
             pickle.dump({"step": 21, "params": copy_params,
                          "batch_stats": copy_stats}, f)
         code, out = _post(router.port, "/reload", {"checkpoint": str(ck)})
@@ -531,7 +531,7 @@ def test_rolling_reload_and_first_replica_rollback(engine, tmp_path):
             InferenceState(step=22, params=copy_params,
                            batch_stats=copy_stats))
         bad_ck = tmp_path / "bad.pk"
-        with open(bad_ck, "wb") as f:
+        with open(bad_ck, "wb") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
             pickle.dump({"step": 22, "params": bad.params,
                          "batch_stats": bad.batch_stats}, f)
         with pytest.raises(urllib.error.HTTPError) as ei:

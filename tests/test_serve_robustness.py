@@ -389,7 +389,7 @@ def test_http_reload_healthz_and_breaker_rollback(server, engine, tmp_path):
     # write a real checkpoint pickle (the run_training payload format)
     copy = _state_copy(engine, step=9)
     ck = tmp_path / "cand.pk"
-    with open(ck, "wb") as f:
+    with open(ck, "wb") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
         pickle.dump({"step": 9, "params": copy.params,
                      "batch_stats": copy.batch_stats}, f)
     code, out = _post(server.port, "/reload", {"checkpoint": str(ck)})
@@ -401,7 +401,7 @@ def test_http_reload_healthz_and_breaker_rollback(server, engine, tmp_path):
     # corrupt candidate -> 409, old state keeps serving
     bad = ServeChaos(reload_corrupt=1).on_reload_state(copy)
     bad_ck = tmp_path / "bad.pk"
-    with open(bad_ck, "wb") as f:
+    with open(bad_ck, "wb") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
         pickle.dump({"step": 10, "params": bad.params,
                      "batch_stats": bad.batch_stats}, f)
     with pytest.raises(urllib.error.HTTPError) as ei:
@@ -464,7 +464,7 @@ def test_reload_under_load_zero_drops(server, engine, tmp_path):
     ref = _post(server.port, "/predict", _sample_json(s0))[1]["heads"]
     copy = _state_copy(engine, step=11)
     ck = tmp_path / "swap.pk"
-    with open(ck, "wb") as f:
+    with open(ck, "wb") as f:  # graftlint: disable=ROB002 (test fixture in tmp dir; crash durability irrelevant)
         pickle.dump({"step": 11, "params": copy.params,
                      "batch_stats": copy.batch_stats}, f)
 

@@ -156,13 +156,14 @@ def test_sliced_adamw_update_exactly_matches_full():
     p_full = params
     st_sl = st_full
     p_sl = params
+    jit_update = jax.jit(tx.update)  # hoisted: one trace cache (TRC003)
     for _ in range(2):
-        u, st_full = jax.jit(tx.update)(grads, st_full, p_full)
+        u, st_full = jit_update(grads, st_full, p_full)
         p_full = optax.apply_updates(p_full, u)
 
         outs = []
         for i in range(n):
-            u_i, st_i = jax.jit(tx.update)(
+            u_i, st_i = jit_update(
                 slice_i(grads, i), slice_i(st_sl, i), slice_i(p_sl, i))
             outs.append((optax.apply_updates(slice_i(p_sl, i), u_i), st_i))
         # reassemble: concat rank>=1 leaves and unpad; scalars from shard 0

@@ -37,6 +37,8 @@ _REPO = os.path.dirname(_HERE)
 sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.join(_REPO, "tests"))
 
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json  # noqa: E402
+
 
 def _load_example(name):
     spec = importlib.util.spec_from_file_location(
@@ -341,8 +343,7 @@ def main():
         res = torch_lj(args.num, args.epochs)
     print(json.dumps(res, indent=1))
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=1)
+        atomic_write_json(args.out, res)
 
 
 if __name__ == "__main__":

@@ -48,6 +48,7 @@ from hydragnn_tpu.serve.autotune import (  # noqa: E402
     simulate_bursts,
     tune_ladder,
 )
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json  # noqa: E402
 
 
 def _load_jsonl(path: str) -> List[Dict[str, Any]]:
@@ -261,8 +262,7 @@ def main(argv=None) -> int:
     rep = _report(demands, baseline, tuned, mn, me, flushes)
     _print_report(rep)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(rep, f, indent=2)
+        atomic_write_json(args.out, rep)
         print(f"wrote {args.out}")
     return 0
 

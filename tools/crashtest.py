@@ -136,11 +136,12 @@ def run_child(args) -> int:
         log_name=log_name, verbosity=1, logs_dir=logs_dir,
         use_mesh_dp=args.mesh, resume_meta=resume_meta)
 
+    from hydragnn_tpu.resilience.ckpt_io import atomic_write_pickle
+
     final = os.path.join(args.workdir, f"{args.mode}_final.pk")
-    with open(final, "wb") as f:
-        pickle.dump(jax.device_get(
-            {"params": state.params, "opt_state": state.opt_state,
-             "step": state.step}), f)
+    atomic_write_pickle(final, jax.device_get(
+        {"params": state.params, "opt_state": state.opt_state,
+         "step": state.step}))
     print(f"crashtest child: {args.mode} done "
           f"(preempted={bool(history.get('preempted'))}, "
           f"epochs={len(history['train'])})", flush=True)

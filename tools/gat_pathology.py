@@ -27,6 +27,7 @@ sys.path.insert(0, ".")
 sys.path.insert(0, "examples/qm9")
 
 import numpy as np
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
 
 
 VARIANTS = ("base", "mom03", "nodrop")
@@ -148,8 +149,7 @@ def main():
         print(json.dumps(r), flush=True)
         results.append(r)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=1)
+        atomic_write_json(args.out, results)
 
 
 if __name__ == "__main__":

@@ -212,8 +212,9 @@ def main():
         }
     print(json.dumps(doc))
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(doc, f, indent=1)
+        from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
+
+        atomic_write_json(args.out, doc)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json  # noqa: E402
+
 MXU_PEAK = 197e12
 MEASURED_GBPS = 585.0  # docs/PERF.md round-3 marginal bandwidth
 
@@ -214,8 +216,7 @@ def main():
             res["oc20_dimenet"] = {"error": repr(e)[:200]}
             print(f"oc20 FAILED: {e!r}", flush=True)
 
-    with open(args.out, "w") as f:
-        json.dump(res, f, indent=1)
+    atomic_write_json(args.out, res)
     print(json.dumps({k: (v if not isinstance(v, dict) else "...")
                       for k, v in res.items()}))
 

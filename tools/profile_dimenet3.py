@@ -58,6 +58,7 @@ def main():
                 return sum(jnp.sum(o) for o in jax.tree_util.tree_leaves(out))
             return jax.grad(loss)(p)
 
+        # graftlint: disable=TRC003 (one wrapper per profiled basis variant by design)
         pgrad = jax.jit(pgrad_fn)
         print(f"{name}: params-grad {timeit(pgrad, params):.2f} ms", flush=True)
     dn.spherical_basis = orig_sbf

@@ -21,6 +21,7 @@ sys.path.insert(0, ".")
 sys.path.insert(0, "examples/qm9")
 
 import numpy as np
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
 
 
 def run(resident, mols, epochs):
@@ -99,8 +100,7 @@ def main():
         / max(b["val_mse_best"], 1e-12), 2)
     print(json.dumps(res, indent=1))
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=1)
+        atomic_write_json(args.out, res)
 
 
 if __name__ == "__main__":

@@ -60,6 +60,8 @@ import numpy as np
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json  # noqa: E402
+
 
 def random_graph(rng: np.random.RandomState, max_nodes: int,
                  input_dim: int = 1) -> Dict[str, Any]:
@@ -849,8 +851,7 @@ def main(argv=None) -> int:
         result = run_fleet_bench(args.fleet, args.duration, args.nodes,
                                  input_dim=args.input_dim,
                                  chaos_predict_ms=args.chaos_predict_ms)
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
+        atomic_write_json(out_path, result)
         print(json.dumps(result, indent=2))
         print(f"\nwrote {out_path}")
         slo = result["slo"]
@@ -866,8 +867,7 @@ def main(argv=None) -> int:
     if args.quant_ab:
         result = run_quant_ab(args.requests, args.nodes,
                               concurrency=args.concurrency)
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
+        atomic_write_json(out_path, result)
         print(json.dumps(result, indent=2))
         print(f"\nwrote {out_path}")
         slo = result["slo"]
@@ -916,8 +916,7 @@ def main(argv=None) -> int:
     finally:
         if server is not None:
             server.shutdown()
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=2)
+    atomic_write_json(out_path, result)
     print(json.dumps(result, indent=2))
     print(f"\nwrote {out_path}")
     slo = result["slo"]
