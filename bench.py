@@ -1064,6 +1064,218 @@ def _zero_main(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --giant: halo graph-sharding ladder (one giant graph across the mesh)
+# ---------------------------------------------------------------------------
+
+
+def _giant_main(argv) -> int:
+    """``python bench.py --giant``: train ONE synthetic giant graph (3D
+    lattice, 6-neighbor edges — the mesh-scale / charge-density input
+    class) across the device mesh at 4-32x a nominal per-device node
+    budget, and measure the halo backend's memory curve against the
+    analytic ``N/D + halo`` model AND the gspmd fallback's full-[N, F]
+    replication (docs/SCALING.md §6).  Bytes rows are exact (measured
+    per-device shard bytes + compiled-HLO buffer dims); step times are
+    best-effort on CPU.  Writes BENCH_graph_shard.json."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --giant")
+    ap.add_argument("--grid", default="16,20,26,32",
+                    help="comma ladder of lattice sides k (N = k^3)")
+    ap.add_argument("--budget-nodes", type=int, default=1024,
+                    help="nominal per-device node budget the ladder is "
+                         "expressed against")
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--features", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=3,
+                    help="timed steps per backend (0 = bytes only)")
+    ap.add_argument("--gspmd-max-nodes", type=int, default=10000,
+                    help="skip the gspmd baseline above this N (its CPU "
+                         "compile of the full graph is the slow part)")
+    ap.add_argument("--method", default="sfc")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_graph_shard.json"))
+    args = ap.parse_args(argv)
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+    import re
+
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.graph.partition import (
+        shard_batch_halo,
+        synthetic_lattice_batch,
+    )
+    from hydragnn_tpu.models.base import ModelConfig, NodeHeadCfg
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.parallel.graph_shard import (
+        make_gspmd_train_step,
+        shard_batch,
+    )
+    from hydragnn_tpu.parallel.mesh import (
+        make_halo_train_step,
+        make_mesh,
+        replicate_state,
+    )
+    from hydragnn_tpu.parallel.zero import measured_device_bytes
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n_dev = len(devs)
+    mesh = make_mesh()
+    F = args.features
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=F, hidden_dim=args.hidden,
+        output_dim=(1,), output_type=("node",), graph_head=None,
+        node_head=NodeHeadCfg(1, (args.hidden,), "mlp"),
+        task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    opt = select_optimizer(BENCH_OPTIMIZER)
+
+    def node_dims(text):
+        return {int(m.group(1))
+                for m in re.finditer(r"f32\[(\d+),(\d+)\]", text)}
+
+    rows = {}
+    compact = {}
+    for k in [int(v) for v in args.grid.split(",") if v.strip()]:
+        batch = synthetic_lattice_batch(k, features=F)
+        n_real = k ** 3
+        n_full = batch.x.shape[0]
+        hb, plan = shard_batch_halo(batch, n_dev, method=args.method,
+                                    hops=cfg.num_conv_layers,
+                                    head_types=["node"])
+        state = create_train_state(model, batch, opt, seed=0)
+
+        sharded_x = jax.device_put(
+            np.asarray(hb.x), NamedSharding(mesh, P(mesh.axis_names[0])))
+        halo_node_bytes = measured_device_bytes(
+            sharded_x, mesh.devices.flat[0])
+        repl_node_bytes = n_full * F * 4
+        analytic_rows = plan.n_local + n_dev * plan.halo_pair
+        row = {
+            "n_nodes": n_real,
+            "n_edges": int(plan.stats["n_edges_real"]),
+            "budget_multiple": round(n_real / (args.budget_nodes * 1.0), 1),
+            "partition": plan.stats,
+            "node_feature_bytes_per_device_halo": int(halo_node_bytes),
+            "node_feature_bytes_replicated": int(repl_node_bytes),
+            "residency_rows_local": int(plan.n_local),
+            "residency_rows_with_halo": int(analytic_rows),
+            "residency_model_rows": int(-(-n_real // n_dev)
+                                        + plan.stats["halo_rows_max"]),
+        }
+
+        steph = make_halo_train_step(model, cfg, opt, mesh)
+        s_h = replicate_state(state, mesh)
+        t0 = time.perf_counter()
+        lowered = steph.lower(s_h, hb).compile()
+        hlo_halo = lowered.as_text()
+        # the no-full-buffer claim: the compiled halo step must contain NO
+        # tensor with the full padded node count as a dimension (the same
+        # assertion tests/test_graph_shard.py pins); node-array residency
+        # in its HLO is ext_n rows
+        row["halo_full_array_buffers"] = sorted(
+            d for d in node_dims(hlo_halo) if d == n_full)
+        row["halo_hlo_node_rows"] = int(plan.ext_n)
+        # node-row headroom: full-[N, F] replication (what gspmd
+        # materializes per device) over the halo step's extended rows
+        row["memory_headroom_node_rows"] = round(
+            n_full / plan.ext_n, 2)
+        if args.steps > 0:
+            s_h, m = lowered(s_h, hb)
+            _sync(m["loss"])
+            row["halo_compile_plus_first_step_s"] = round(
+                time.perf_counter() - t0, 3)
+            row["halo_loss_first_step"] = float(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                s_h, m = lowered(s_h, hb)
+            _sync(m["loss"])
+            row["halo_step_ms"] = round(
+                (time.perf_counter() - t0) / args.steps * 1e3, 2)
+
+        if n_real <= args.gspmd_max_nodes:
+            stepg = make_gspmd_train_step(model, cfg, opt, mesh)
+            sb = shard_batch(batch, mesh)
+            s_g = replicate_state(state, mesh)
+            t0 = time.perf_counter()
+            lg = stepg.lower(s_g, sb).compile()
+            hlo_g = lg.as_text()
+            # the baseline's failure mode, as compiled evidence: the full
+            # [N, F] node buffer IS materialized (the GSPMD all-gather)
+            row["gspmd_has_full_array"] = bool(
+                n_full in node_dims(hlo_g))
+            row["memory_headroom_vs_gspmd"] = round(
+                n_full / plan.ext_n, 2) if row["gspmd_has_full_array"] \
+                else None
+            if args.steps > 0:
+                s_g, mg = lg(s_g, sb)
+                _sync(mg["loss"])
+                row["gspmd_compile_plus_first_step_s"] = round(
+                    time.perf_counter() - t0, 3)
+                row["gspmd_loss_first_step"] = float(mg["loss"])
+                row["loss_match"] = bool(np.isclose(
+                    row.get("halo_loss_first_step", np.nan),
+                    float(mg["loss"]), rtol=1e-5))
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    s_g, mg = lg(s_g, sb)
+                _sync(mg["loss"])
+                row["gspmd_step_ms"] = round(
+                    (time.perf_counter() - t0) / args.steps * 1e3, 2)
+        _release_device()
+        rows[f"n{n_real}"] = row
+        compact[f"n{n_real}"] = {
+            "rows_dev": int(analytic_rows),
+            "rows_repl": n_full,
+            "ratio": round(analytic_rows / n_full, 4),
+            **({"headroom": row["memory_headroom_vs_gspmd"]}
+               if "memory_headroom_vs_gspmd" in row else {}),
+        }
+        print(f"bench --giant: N={n_real} ({row['budget_multiple']}x "
+              f"budget): {analytic_rows} rows/dev vs {n_full} replicated "
+              f"({analytic_rows / n_full:.3f}x), cut "
+              f"{plan.stats['cut_edge_pct']}%, halo max "
+              f"{plan.stats['halo_rows_max']}"
+              + (f", headroom {row['memory_headroom_vs_gspmd']}x vs gspmd"
+                 if "memory_headroom_vs_gspmd" in row else "")
+              + (f", loss match {row.get('loss_match')}"
+                 if "loss_match" in row else ""), file=sys.stderr)
+
+    result = {
+        "metric": "graph_shard_residency",
+        "unit": "node rows/device",
+        "platform": devs[0].platform,
+        "devices": n_dev,
+        "method": args.method,
+        "hops": cfg.num_conv_layers,
+        "hidden": args.hidden,
+        "features": F,
+        "budget_nodes_per_device": args.budget_nodes,
+        "ladder": rows,
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, args.out)
+    print(json.dumps({"metric": "graph_shard_residency",
+                      "devices": n_dev, "ladder": compact,
+                      "evidence": os.path.basename(args.out)}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # parent
 # ---------------------------------------------------------------------------
 
@@ -1162,5 +1374,7 @@ if __name__ == "__main__":
         _child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
     elif len(sys.argv) > 1 and sys.argv[1] == "--zero":
         sys.exit(_zero_main(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--giant":
+        sys.exit(_giant_main(sys.argv[2:]))
     else:
         main()

@@ -104,6 +104,18 @@ _KNOB_LIST = [
     _k("HYDRAGNN_ZERO", "Training.zero_stage", "0",
        "hydragnn_tpu/parallel/zero.py",
        "ZeRO stage (0|1|2); env wins over the config stage"),
+    _k("HYDRAGNN_GRAPH_SHARD", "Training.graph_shard", "off",
+       "hydragnn_tpu/graph/partition.py",
+       "graph-sharding backend: off | halo (production) | gspmd (baseline)"),
+    _k("HYDRAGNN_GRAPH_SHARD_METHOD", "Training.graph_shard_method", "sfc",
+       "hydragnn_tpu/graph/partition.py",
+       "partition node order: sfc (Morton) | bfs | block"),
+    _k("HYDRAGNN_GRAPH_SHARD_HOPS", "Training.graph_shard_hops",
+       "0 (num_conv_layers)", "hydragnn_tpu/graph/partition.py",
+       "halo depth in hops (0 = the model's conv depth)"),
+    _k("HYDRAGNN_GRAPH_SHARD_HALO_MAX", "Training.graph_shard_halo_max",
+       "0 (auto bucket)", "hydragnn_tpu/graph/partition.py",
+       "per-peer halo row cap; exceeding it raises (never truncates)"),
     # -- kernels / fused-path gates --------------------------------------
     _k("HYDRAGNN_AGGR_BACKEND", "", "scatter",
        "hydragnn_tpu/ops/aggregate.py",
@@ -322,6 +334,8 @@ _HEALTH_LIST = [
        "checkpoint retries exhausted, run degraded gracefully"),
     _h("nonfinite_abort", "hydragnn_tpu/resilience/guards.py",
        "guard monitor hit N consecutive bad steps and raised"),
+    _h("graph_shard_fallback", "hydragnn_tpu/train/trainer.py",
+       "graph sharding requested but the run fell back to plain DP"),
     # serving lifecycle (docs/TELEMETRY.md "Serving events")
     _h("request_enqueued", "hydragnn_tpu/serve/batcher.py",
        "request accepted into the bounded queue"),

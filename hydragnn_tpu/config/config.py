@@ -211,6 +211,23 @@ def finalize(
     from hydragnn_tpu.parallel.zero import check_zero_stage
 
     training["zero_stage"] = check_zero_stage(training.get("zero_stage", 0))
+    # graph sharding backend/knobs (docs/SCALING.md §6): defaults written
+    # back like the other Training defaults, and VALIDATED on every
+    # construction path — a typo'd backend must fail here, not silently
+    # train unsharded while the operator believes a giant graph fits.  The
+    # HYDRAGNN_GRAPH_SHARD* env knobs overlay at trainer build time.
+    from hydragnn_tpu.graph.partition import (
+        check_graph_shard_backend,
+        check_partition_method,
+        graph_shard_training_defaults,
+    )
+
+    for k, v in graph_shard_training_defaults().items():
+        training.setdefault(k, v)
+    training["graph_shard"] = check_graph_shard_backend(
+        training["graph_shard"])
+    training["graph_shard_method"] = check_partition_method(
+        training["graph_shard_method"])
     return config
 
 

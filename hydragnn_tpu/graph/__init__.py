@@ -7,6 +7,15 @@ from hydragnn_tpu.graph.batch import (
     default_label_slices,
 )
 from hydragnn_tpu.graph import segment
+from hydragnn_tpu.graph.partition import (
+    GraphShardConfig,
+    HaloBatch,
+    ShardPlan,
+    ShardedGraphLoader,
+    apply_plan,
+    build_shard_plan,
+    shard_batch_halo,
+)
 from hydragnn_tpu.graph.neighborlist import (
     radius_graph,
     radius_graph_pbc,

@@ -1,0 +1,756 @@
+"""Spatial graph partitioning + halo specs: ONE giant graph across the mesh.
+
+The memory problem ZeRO (parallel/zero.py) does not touch: a single graph's
+node/edge arrays must fit one device.  This module partitions a collated
+:class:`GraphBatch`'s nodes into D contiguous shards with a locality-aware
+reorder (BFS / space-filling curve on positions — few cut edges), reindexes
+edges so each shard owns its receiver-local edges, and precomputes per-shard
+**halo specs**: which remote node rows each peer shard must contribute so
+the shard can run the UNCHANGED message-passing stack on ``local + halo``
+rows.
+
+The halo is **L-hop** (L = the model's conv depth by default): shard *d*'s
+extended subgraph contains every node within L hops upstream of its local
+nodes and every edge whose receiver is within L-1 hops, so after L
+message-passing layers the LOCAL rows are exactly the values the
+single-device run computes — one halo exchange per step, no per-layer
+communication, no model rewrites.  Boundary work is duplicated (each shard
+recomputes its halo rows' intermediate layers), which is the classic
+halo-replication trade: per-device residency drops from N to
+``N/D + halo``, at the price of recomputing an L-deep boundary layer.
+
+At run time (parallel/mesh.py:make_halo_train_step) the halo rows are
+gathered with one ``all_to_all`` into a bounded ``[D * halo_pair]`` buffer
+(static, bucketed like PadSpec so topology jitter does not recompile), and
+the collective's transpose reduce-scatters halo cotangents back to their
+owner shards in the VJP — jax AD derives it from the forward exchange.
+
+Graph-level reductions (mean pooling, masked BatchNorm statistics, the
+masked-mean losses) are made shard-aware through the trace-time
+:func:`halo_context` / :func:`halo_psum` hooks in graph/segment.py and
+models/layers.py: partial per-shard sums and counts are ``psum``-ed across
+the mesh axis, so SyncBatchNorm semantics and exact global losses hold with
+graphs that span shards.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from hydragnn_tpu.graph.batch import GraphBatch
+from hydragnn_tpu.utils.env import env_int, env_str
+
+GRAPH_SHARD_BACKENDS = ("off", "halo", "gspmd")
+PARTITION_METHODS = ("sfc", "bfs", "block")
+# conv stacks whose message passing is strictly 1-hop per layer: the L-hop
+# halo argument holds.  DimeNet's triplet (edge-to-edge) interactions need
+# edge-adjacency halos this module does not build — it falls back loudly.
+HALO_SUPPORTED_MODELS = (
+    "SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN", "SchNet", "EGNN")
+
+
+# ---------------------------------------------------------------------------
+# trace-time halo context: makes the global reductions shard-aware
+# ---------------------------------------------------------------------------
+
+_HALO_AXES: Any = None
+
+
+@contextlib.contextmanager
+def halo_context(axes):
+    """Trace-time marker: while active, the framework's graph-global
+    reductions (masked_mean_pool, MaskedBatchNorm statistics, the masked
+    mean losses) psum their partial sums/counts over ``axes``.  Entered by
+    the halo step builders around the model trace — Python-level state read
+    at trace time, never inside compiled code."""
+    global _HALO_AXES
+    prev = _HALO_AXES
+    _HALO_AXES = axes
+    try:
+        yield
+    finally:
+        _HALO_AXES = prev
+
+
+def halo_axes():
+    """The active halo mesh axis (or None outside a halo trace)."""
+    return _HALO_AXES
+
+
+def halo_psum(x):
+    """psum over the halo axis when a halo trace is active, else identity.
+    The one hook point the shard-aware reductions call."""
+    if _HALO_AXES is None:
+        return x
+    return jax.lax.psum(x, _HALO_AXES)
+
+
+# ---------------------------------------------------------------------------
+# config knobs (Training section + HYDRAGNN_GRAPH_SHARD* env, env wins)
+# ---------------------------------------------------------------------------
+
+
+def check_graph_shard_backend(value: Any) -> str:
+    """Normalize/validate a ``graph_shard`` knob value to a backend name.
+    Accepts the repo's flag spellings: unset/empty/"0"/"off"/False -> off,
+    "1"/True/"halo" -> halo, "gspmd" -> gspmd."""
+    if value in (None, False, 0, "", "0", "off", "false", "False"):
+        return "off"
+    if value in (True, 1, "1", "halo", "true", "True"):
+        return "halo"
+    if value == "gspmd":
+        return "gspmd"
+    raise ValueError(
+        f"graph_shard must be one of {GRAPH_SHARD_BACKENDS} (or 0/1), "
+        f"got {value!r}")
+
+
+def check_partition_method(value: Any) -> str:
+    v = str(value or "sfc")
+    if v not in PARTITION_METHODS:
+        raise ValueError(
+            f"graph_shard_method must be one of {PARTITION_METHODS}, "
+            f"got {value!r}")
+    return v
+
+
+@dataclasses.dataclass
+class GraphShardConfig:
+    """Parsed graph-sharding knobs (``Training`` section + env, env wins).
+
+    Env knobs: HYDRAGNN_GRAPH_SHARD, HYDRAGNN_GRAPH_SHARD_METHOD,
+    HYDRAGNN_GRAPH_SHARD_HOPS, HYDRAGNN_GRAPH_SHARD_HALO_MAX.
+    """
+
+    backend: str = "off"    # off | halo | gspmd
+    method: str = "sfc"     # sfc | bfs | block
+    hops: int = 0           # halo depth; 0 = the model's num_conv_layers
+    halo_max: int = 0       # per-peer halo row cap; 0 = auto (bucketed)
+
+    @classmethod
+    def from_training(cls, training: Optional[Dict[str, Any]]
+                      ) -> "GraphShardConfig":
+        s = dict(training or {})
+        d = cls()
+        cfg = cls(
+            backend=check_graph_shard_backend(
+                s.get("graph_shard", d.backend)),
+            method=check_partition_method(
+                s.get("graph_shard_method", d.method)),
+            hops=int(s.get("graph_shard_hops", d.hops)),
+            halo_max=int(s.get("graph_shard_halo_max", d.halo_max)),
+        )
+        # set-but-EMPTY env falls through to the config value (the repo's
+        # env-knob convention, utils/env.py)
+        if os.environ.get("HYDRAGNN_GRAPH_SHARD"):
+            cfg.backend = check_graph_shard_backend(
+                os.environ["HYDRAGNN_GRAPH_SHARD"])
+        if os.environ.get("HYDRAGNN_GRAPH_SHARD_METHOD"):
+            cfg.method = check_partition_method(
+                env_str("HYDRAGNN_GRAPH_SHARD_METHOD", d.method))
+        if os.environ.get("HYDRAGNN_GRAPH_SHARD_HOPS"):
+            cfg.hops = env_int("HYDRAGNN_GRAPH_SHARD_HOPS", d.hops)
+        if os.environ.get("HYDRAGNN_GRAPH_SHARD_HALO_MAX"):
+            cfg.halo_max = env_int("HYDRAGNN_GRAPH_SHARD_HALO_MAX",
+                                   d.halo_max)
+        if cfg.hops < 0:
+            raise ValueError(f"graph_shard_hops must be >= 0, got {cfg.hops}")
+        if cfg.halo_max < 0:
+            raise ValueError(
+                f"graph_shard_halo_max must be >= 0, got {cfg.halo_max}")
+        return cfg
+
+
+def graph_shard_training_defaults() -> Dict[str, Any]:
+    """``Training``-section defaults written back by config.finalize, so a
+    saved config.json documents the run's graph-sharding settings."""
+    d = GraphShardConfig()
+    return {
+        "graph_shard": d.backend,
+        "graph_shard_method": d.method,
+        "graph_shard_hops": d.hops,
+        "graph_shard_halo_max": d.halo_max,
+    }
+
+
+# ---------------------------------------------------------------------------
+# locality-aware node orders
+# ---------------------------------------------------------------------------
+
+
+def _order_block(n_real: int, *_args) -> np.ndarray:
+    return np.arange(n_real, dtype=np.int64)
+
+
+def _order_bfs(n_real: int, senders: np.ndarray,
+               receivers: np.ndarray, _pos) -> np.ndarray:
+    """BFS visit order over the undirected adjacency — contiguous chunks of
+    the order are connected neighborhoods, so chunk boundaries cut few
+    edges on mesh-like graphs.  Vectorized frontier expansion (no per-node
+    Python loop over edges)."""
+    order = np.empty(n_real, np.int64)
+    visited = np.zeros(n_real, bool)
+    # undirected adjacency in CSR form via sorted edge endpoints
+    u = np.concatenate([senders, receivers])
+    v = np.concatenate([receivers, senders])
+    sort = np.argsort(u, kind="stable")
+    u, v = u[sort], v[sort]
+    starts = np.searchsorted(u, np.arange(n_real + 1))
+    pos_out = 0
+    for seed in range(n_real):
+        if visited[seed]:
+            continue
+        frontier = np.asarray([seed], np.int64)
+        visited[seed] = True
+        while frontier.size:
+            order[pos_out:pos_out + frontier.size] = frontier
+            pos_out += frontier.size
+            # all neighbors of the frontier, deduped, unvisited —
+            # CSR range gather via repeat/cumsum, no per-node Python loop
+            cnt = starts[frontier + 1] - starts[frontier]
+            tot = int(cnt.sum())
+            if not tot:
+                break
+            base = np.repeat(starts[frontier], cnt)
+            within = np.arange(tot) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+            nxt = np.unique(v[base + within])
+            nxt = nxt[~visited[nxt]]
+            visited[nxt] = True
+            frontier = nxt
+    assert pos_out == n_real
+    return order
+
+
+def _order_sfc(n_real: int, _senders, _receivers,
+               pos: np.ndarray) -> np.ndarray:
+    """Morton (Z-order) curve on quantized positions: spatially adjacent
+    nodes land adjacent in the order, so contiguous chunks are compact
+    spatial cells — the natural order for radius-graph inputs."""
+    p = np.asarray(pos[:n_real], np.float64)
+    lo = p.min(axis=0)
+    span = np.maximum(p.max(axis=0) - lo, 1e-12)
+    q = np.clip(((p - lo) / span * ((1 << 16) - 1)), 0,
+                (1 << 16) - 1).astype(np.uint64)
+    key = np.zeros(n_real, np.uint64)
+    for bit in range(16):
+        for axis in range(min(3, q.shape[1])):
+            key |= ((q[:, axis] >> np.uint64(bit)) & np.uint64(1)) \
+                << np.uint64(bit * 3 + axis)
+    return np.argsort(key, kind="stable").astype(np.int64)
+
+
+_ORDERS = {"block": _order_block, "bfs": _order_bfs, "sfc": _order_sfc}
+
+
+# ---------------------------------------------------------------------------
+# the shard plan (host-side, numpy): pure indexing, reusable across batches
+# with the same topology
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardPlan:
+    """Index plan for one (topology, n_shards, method, hops) combination.
+    All arrays are stacked per-shard along a leading [D] axis; applying the
+    plan to a batch (:func:`apply_plan`) is plain numpy gathering."""
+
+    n_shards: int
+    n_local: int          # padded local node rows per shard
+    e_local: int          # padded edge rows per shard
+    halo_pair: int        # padded rows each ordered (owner, dest) pair ships
+    ext_n: int            # n_local + n_shards * halo_pair + 1 (pad row last)
+    hops: int
+    method: str
+    local_ids: np.ndarray     # [D, n_local] original node id (pad: -1)
+    halo_ids: np.ndarray      # [D, D*halo_pair] original node id (pad: -1)
+    send_idx: np.ndarray      # [D, D, halo_pair] LOCAL row idx to ship (pad 0)
+    senders: np.ndarray       # [D, e_local] ext index (pad: ext_n-1)
+    receivers: np.ndarray     # [D, e_local] ext index (pad: ext_n-1)
+    edge_ids: np.ndarray      # [D, e_local] original edge id (pad: -1)
+    edge_mask: np.ndarray     # [D, e_local] 1.0 = real (incl. halo-internal)
+    edge_owned: np.ndarray    # [D, e_local] 1.0 = receiver is LOCAL real
+    node_gid: np.ndarray      # [D, ext_n] graph id (pad rows -> G-1)
+    node_mask: np.ndarray     # [D, ext_n] 1.0 = local real row
+    stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _round_up(x: int, m: int) -> int:
+    return int(-(-x // m) * m) if m > 1 else int(x)
+
+
+def build_shard_plan(
+    batch: GraphBatch,
+    n_shards: int,
+    method: str = "sfc",
+    hops: int = 2,
+    round_to: int = 8,
+    halo_max: int = 0,
+) -> ShardPlan:
+    """Partition ``batch``'s real nodes into ``n_shards`` contiguous chunks
+    of a locality-aware order and precompute the L-hop halo plan.
+
+    ``halo_max`` caps the per-pair halo rows; 0 sizes the buffer from the
+    measured need rounded up to a multiple of 32 (the PadSpec-style
+    bucket, so small topology changes reuse the compiled step).  Raises
+    when the measured need exceeds an explicit cap — a silently truncated
+    halo would be a wrong answer, not a slow one.
+    """
+    if method not in _ORDERS:
+        raise ValueError(f"unknown partition method {method!r}")
+    if hops < 1:
+        raise ValueError(f"halo hops must be >= 1, got {hops}")
+    senders = np.asarray(batch.senders)
+    receivers = np.asarray(batch.receivers)
+    node_mask = np.asarray(batch.node_mask)
+    edge_mask = np.asarray(batch.edge_mask)
+    node_gid = np.asarray(batch.node_gid)
+    n_real = int(node_mask.sum())
+    e_real = int(edge_mask.sum())
+    # collate packs real rows first — the plan indexes by that invariant
+    assert node_mask[:n_real].all() and not node_mask[n_real:].any(), \
+        "graph partitioning requires collate's real-rows-first layout"
+    assert edge_mask[:e_real].all() and not edge_mask[e_real:].any()
+    s_r = senders[:e_real].astype(np.int64)
+    r_r = receivers[:e_real].astype(np.int64)
+
+    order = _ORDERS[method](n_real, s_r, r_r, np.asarray(batch.pos))
+    inv = np.empty(n_real, np.int64)
+    inv[order] = np.arange(n_real)
+    # n_real < n_shards (a degenerate tail val/test batch) leaves the
+    # trailing shards empty — every reduction handles zero-node shards, so
+    # a tiny batch must not kill a long run mid-validation
+    chunk = max(-(-n_real // n_shards), 1)
+    shard_of = inv // chunk           # [n_real] owner shard per node
+    local_of = inv - shard_of * chunk  # [n_real] local row per node
+    n_local = _round_up(chunk, round_to)
+
+    D = n_shards
+    # -- L-hop need sets + edge ownership per shard -------------------------
+    need = np.zeros((D, n_real), bool)
+    need[shard_of, np.arange(n_real)] = True
+    need_lm1 = None
+    for k in range(hops):
+        if k == hops - 1:
+            need_lm1 = need.copy()  # receivers at <= L-1 hops keep edges
+        # expand: senders of edges whose receiver is in the need set
+        hit = need[:, r_r]                      # [D, e_real]
+        for d in range(D):
+            need[d, s_r[hit[d]]] = True
+    local = np.zeros((D, n_real), bool)
+    local[shard_of, np.arange(n_real)] = True
+    halo = need & ~local
+
+    # -- halo slot assignment: per (dest, owner) pair, owner-local order ----
+    halo_counts = np.zeros((D, D), np.int64)  # [dest, owner]
+    halo_lists: List[List[np.ndarray]] = []
+    for d in range(D):
+        row = []
+        ids = np.nonzero(halo[d])[0]
+        owners = shard_of[ids]
+        for p in range(D):
+            sel = ids[owners == p]
+            # deterministic order: the owner's local row order
+            sel = sel[np.argsort(local_of[sel], kind="stable")]
+            halo_counts[d, p] = sel.size
+            row.append(sel)
+        halo_lists.append(row)
+    need_pair = int(halo_counts.max()) if D > 1 else 0
+    if halo_max > 0:
+        if need_pair > halo_max:
+            raise ValueError(
+                f"halo needs {need_pair} rows/pair but graph_shard_halo_max="
+                f"{halo_max}; raise the cap or cut hops/improve the "
+                "partition (a truncated halo is a wrong answer)")
+        halo_pair = halo_max
+    elif need_pair == 0:
+        halo_pair = 1  # zero-cut partition: minimal (never zero-sized)
+    else:
+        # bucketed like PadSpec (multiple of 32): small topology drift
+        # between batches reuses the compiled step instead of recompiling
+        # per exact count, without power-of-two's up-to-2x buffer waste
+        halo_pair = _round_up(need_pair, 32)
+    ext_n = n_local + D * halo_pair + 1  # +1: dedicated pad row (last)
+    pad_row = ext_n - 1
+
+    # ext index per (shard, original node): local row, halo slot, or -1
+    ext_index = np.full((D, n_real), -1, np.int64)
+    for d in range(D):
+        ids = np.nonzero(local[d])[0]
+        ext_index[d, ids] = local_of[ids]
+        for p in range(D):
+            sel = halo_lists[d][p]
+            ext_index[d, sel] = n_local + p * halo_pair + np.arange(sel.size)
+
+    # -- per-shard edge lists (original order preserved per receiver) -------
+    e_counts = []
+    edge_sel: List[np.ndarray] = []
+    for d in range(D):
+        keep = need_lm1[d, r_r]  # receiver within L-1 hops of local
+        eids = np.nonzero(keep)[0]
+        edge_sel.append(eids)
+        e_counts.append(eids.size)
+    # power-of-two bucket like halo_pair: shuffled epochs yield slightly
+    # different per-shard edge counts, and an exact-fit e_local would
+    # recompile the step for every one of them
+    e_need = max(e_counts) if e_counts else 0
+    e_local = max(round_to, 8)
+    while e_local < e_need:
+        e_local *= 2
+
+    G = int(np.asarray(batch.graph_mask).shape[0])
+    plan_senders = np.full((D, e_local), pad_row, np.int32)
+    plan_receivers = np.full((D, e_local), pad_row, np.int32)
+    plan_edge_ids = np.full((D, e_local), -1, np.int64)
+    plan_edge_mask = np.zeros((D, e_local), np.float32)
+    plan_edge_owned = np.zeros((D, e_local), np.float32)
+    plan_local_ids = np.full((D, n_local), -1, np.int64)
+    plan_halo_ids = np.full((D, D * halo_pair), -1, np.int64)
+    plan_send_idx = np.zeros((D, D, halo_pair), np.int32)
+    plan_gid = np.full((D, ext_n), G - 1, np.int32)
+    plan_nmask = np.zeros((D, ext_n), np.float32)
+    for d in range(D):
+        ids = order[d * chunk: min((d + 1) * chunk, n_real)]
+        plan_local_ids[d, :ids.size] = ids
+        plan_gid[d, :ids.size] = node_gid[ids]
+        plan_nmask[d, :ids.size] = 1.0
+        for p in range(D):
+            sel = halo_lists[d][p]
+            base = p * halo_pair
+            plan_halo_ids[d, base:base + sel.size] = sel
+            plan_gid[d, n_local + base:n_local + base + sel.size] = \
+                node_gid[sel]
+            # what shard p must SEND to d: p-local rows of those nodes
+            plan_send_idx[p, d, :sel.size] = local_of[sel].astype(np.int32)
+        eids = edge_sel[d]
+        plan_edge_ids[d, :eids.size] = eids
+        plan_senders[d, :eids.size] = ext_index[d, s_r[eids]].astype(np.int32)
+        plan_receivers[d, :eids.size] = \
+            ext_index[d, r_r[eids]].astype(np.int32)
+        plan_edge_mask[d, :eids.size] = 1.0
+        plan_edge_owned[d, :eids.size] = local[d, r_r[eids]].astype(
+            np.float32)
+        assert (plan_senders[d, :eids.size] >= 0).all()
+        assert (plan_receivers[d, :eids.size] >= 0).all()
+
+    cut = int((shard_of[s_r] != shard_of[r_r]).sum())
+    real_per_shard = np.minimum(
+        np.full(D, chunk, np.int64),
+        np.maximum(n_real - np.arange(D) * chunk, 0))
+    halo_rows = halo.sum(axis=1)
+    owned_edges = np.asarray(
+        [int(local[d, r_r].sum()) for d in range(D)], np.int64)
+    halo_cap = D * (D * halo_pair)
+    stats = {
+        "n_shards": D,
+        "method": method,
+        "hops": int(hops),
+        "n_nodes_real": n_real,
+        "n_edges_real": e_real,
+        "n_local": int(n_local),
+        "e_local": int(e_local),
+        "halo_pair": int(halo_pair),
+        "ext_n": int(ext_n),
+        "cut_edge_pct": round(100.0 * cut / max(e_real, 1), 2),
+        "halo_rows_max": int(halo_rows.max()) if D > 1 else 0,
+        "halo_rows_mean": round(float(halo_rows.mean()), 1),
+        "node_imbalance": round(
+            float(real_per_shard.max() / max(real_per_shard.mean(), 1e-9)),
+            3),
+        "edge_imbalance": round(
+            float(owned_edges.max() / max(owned_edges.mean(), 1e-9)), 3),
+        "halo_waste_pct": round(
+            100.0 * (1.0 - float(halo_rows.sum()) / halo_cap), 1)
+        if halo_cap else 0.0,
+    }
+    return ShardPlan(
+        n_shards=D, n_local=n_local, e_local=e_local, halo_pair=halo_pair,
+        ext_n=ext_n, hops=hops, method=method,
+        local_ids=plan_local_ids, halo_ids=plan_halo_ids,
+        send_idx=plan_send_idx, senders=plan_senders,
+        receivers=plan_receivers, edge_ids=plan_edge_ids,
+        edge_mask=plan_edge_mask, edge_owned=plan_edge_owned,
+        node_gid=plan_gid, node_mask=plan_nmask, stats=stats)
+
+
+# ---------------------------------------------------------------------------
+# HaloBatch: the per-shard carrier the halo step consumes
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class HaloBatch:
+    """Per-shard graph-shard input, stacked [D, ...] across the mesh axis.
+
+    ``x``/``pos`` hold ONLY this shard's local rows ([n_local, .] — the
+    N/D residency); ``senders``/``receivers`` index the EXTENDED row space
+    [0, ext_n) = local rows ++ D*halo_pair halo slots ++ one pad row, which
+    the step materializes by gathering ``x[send_idx]`` through one
+    ``all_to_all``.  Graph-level arrays (graph_mask, graph labels, cell,
+    per-graph extras) are replicated on every shard."""
+
+    x: jax.Array                    # [n_local, F]
+    pos: jax.Array                  # [n_local, 3]
+    senders: jax.Array              # [e_local] ext index
+    receivers: jax.Array            # [e_local] ext index
+    edge_attr: Optional[jax.Array]  # [e_local, Fe] or None
+    node_gid: jax.Array             # [ext_n]
+    node_mask: jax.Array            # [ext_n] 1.0 = local real
+    edge_mask: jax.Array            # [e_local]
+    graph_mask: jax.Array           # [G] replicated
+    labels: Tuple[jax.Array, ...]   # node heads [ext_n, d]; graph [G, d]
+    send_idx: jax.Array             # [D, halo_pair] local rows per dest
+    cell: Optional[jax.Array] = None
+    extras: Dict[str, jax.Array] = struct.field(default_factory=dict)
+
+    @property
+    def n_real_graphs(self) -> jax.Array:
+        return jnp.sum(self.graph_mask)
+
+
+def _gather_rows(arr: np.ndarray, ids: np.ndarray,
+                 fill: float = 0.0) -> np.ndarray:
+    """arr[ids] with ids == -1 mapped to ``fill`` rows."""
+    out = np.full((ids.shape[0],) + arr.shape[1:], fill, arr.dtype)
+    ok = ids >= 0
+    out[ok] = arr[ids[ok]]
+    return out
+
+
+def apply_plan(batch: GraphBatch, plan: ShardPlan,
+               head_types: Optional[List[str]] = None) -> HaloBatch:
+    """Gather ``batch``'s arrays through ``plan`` into a stacked [D, ...]
+    :class:`HaloBatch` (pure numpy; cheap next to plan construction).
+
+    ``head_types`` ("graph"|"node" per head) tells label routing; when
+    omitted it is inferred from each label's leading dim (ambiguous only
+    if padded node count equals padded graph count)."""
+    x = np.asarray(batch.x)
+    pos = np.asarray(batch.pos)
+    D = plan.n_shards
+    ext_label_n = plan.ext_n
+    if head_types is None:
+        head_types = ["node" if lab.shape[0] == x.shape[0] else "graph"
+                      for lab in batch.labels]
+
+    xs, ps, eattrs, labels_per_head, extras_out = [], [], [], [], []
+    has_ea = batch.edge_attr is not None
+    ea = np.asarray(batch.edge_attr) if has_ea else None
+    for d in range(D):
+        xs.append(_gather_rows(x, plan.local_ids[d]))
+        ps.append(_gather_rows(pos, plan.local_ids[d]))
+        if has_ea:
+            eattrs.append(_gather_rows(ea, plan.edge_ids[d]))
+    labels = []
+    for ih, lab in enumerate(batch.labels):
+        lab = np.asarray(lab)
+        if head_types[ih] == "node":
+            rows = []
+            for d in range(D):
+                full_ids = np.concatenate(
+                    [plan.local_ids[d], plan.halo_ids[d],
+                     np.asarray([-1], np.int64)])
+                r = _gather_rows(lab, full_ids)
+                # halo rows carry NO loss (mask excludes them) — zero them
+                # so a stray unmasked reduction is loud, not subtly wrong
+                r[plan.n_local:] = 0.0
+                rows.append(r[:ext_label_n])
+            labels.append(np.stack(rows))
+        else:
+            labels.append(np.broadcast_to(
+                lab, (D,) + lab.shape).copy())
+    extras: Dict[str, np.ndarray] = {}
+    for k, v in (batch.extras or {}).items():
+        if k == "edge_perm_sender":
+            continue  # fused-kernel marker: invariants don't survive resharding
+        v = np.asarray(v)
+        if v.ndim >= 1 and v.shape[0] == x.shape[0]:
+            rows = []
+            for d in range(D):
+                full_ids = np.concatenate(
+                    [plan.local_ids[d], plan.halo_ids[d],
+                     np.asarray([-1], np.int64)])
+                rows.append(_gather_rows(v, full_ids))
+            extras[k] = np.stack(rows)
+        else:
+            extras[k] = np.broadcast_to(v, (D,) + v.shape).copy()
+    extras["edge_owned_mask"] = plan.edge_owned.astype(np.float32)
+
+    cell = None
+    if batch.cell is not None:
+        c = np.asarray(batch.cell)
+        cell = np.broadcast_to(c, (D,) + c.shape).copy()
+    gm = np.asarray(batch.graph_mask)
+    return HaloBatch(
+        x=np.stack(xs),
+        pos=np.stack(ps),
+        senders=plan.senders,
+        receivers=plan.receivers,
+        edge_attr=np.stack(eattrs) if has_ea else None,
+        node_gid=plan.node_gid,
+        node_mask=plan.node_mask,
+        edge_mask=plan.edge_mask,
+        graph_mask=np.broadcast_to(gm, (D,) + gm.shape).copy(),
+        labels=tuple(labels),
+        send_idx=plan.send_idx,
+        cell=cell,
+        extras=extras,
+    )
+
+
+def halo_exchange(x_local: jax.Array, send_idx: jax.Array, axes):
+    """Gather the rows each peer needs and swap them with ONE all_to_all;
+    returns the [D*halo_pair, F] halo buffer.  Runs inside shard_map; its
+    VJP (jax-derived) reduce-scatters halo cotangents back through the
+    inverse all_to_all + a scatter-add onto the owner rows."""
+    send = jnp.take(x_local, send_idx, axis=0)  # [D, halo_pair, F]
+    recv = jax.lax.all_to_all(
+        send, axes, split_axis=0, concat_axis=0, tiled=True)
+    return recv.reshape((-1,) + recv.shape[2:])
+
+
+def assemble_extended(hb: HaloBatch, axes) -> GraphBatch:
+    """Materialize the extended per-shard :class:`GraphBatch` the unchanged
+    model consumes: local rows ++ exchanged halo rows ++ one zero pad row.
+    Runs inside shard_map (differentiable through the exchange)."""
+    halo_x = halo_exchange(hb.x, hb.send_idx, axes)
+    halo_p = halo_exchange(hb.pos, hb.send_idx, axes)
+    pad_x = jnp.zeros((1,) + hb.x.shape[1:], hb.x.dtype)
+    pad_p = jnp.zeros((1,) + hb.pos.shape[1:], hb.pos.dtype)
+    x_ext = jnp.concatenate([hb.x, halo_x, pad_x], axis=0)
+    pos_ext = jnp.concatenate([hb.pos, halo_p, pad_p], axis=0)
+    return GraphBatch(
+        x=x_ext,
+        pos=pos_ext,
+        senders=hb.senders,
+        receivers=hb.receivers,
+        edge_attr=hb.edge_attr,
+        node_gid=hb.node_gid,
+        node_mask=hb.node_mask,
+        edge_mask=hb.edge_mask,
+        graph_mask=hb.graph_mask,
+        labels=hb.labels,
+        cell=hb.cell,
+        extras=hb.extras,
+    )
+
+
+# ---------------------------------------------------------------------------
+# loader wrapper: partition each yielded batch, cache plans per topology
+# ---------------------------------------------------------------------------
+
+
+class ShardedGraphLoader:
+    """Wrap a GraphDataLoader: every yielded batch is partitioned into a
+    stacked :class:`HaloBatch` for the halo train/eval steps.
+
+    Plans are cached per topology digest (edges + masks + graph-boundary
+    assignment — the expensive BFS/SFC + hop expansion); repeated epochs
+    over the same giant graph(s) pay numpy gathers only.  ``halo_pair``
+    is bucketed to multiples of 32, so minor topology drift between
+    cached plans reuses the compiled step."""
+
+    def __init__(self, loader, n_shards: int, cfg: GraphShardConfig,
+                 hops: int, head_types: Optional[List[str]] = None):
+        self.loader = loader
+        self.n_shards = n_shards
+        self.cfg = cfg
+        self.hops = hops if cfg.hops == 0 else cfg.hops
+        self.head_types = head_types
+        self._plans: Dict[bytes, ShardPlan] = {}
+        self.stats: Dict[str, Any] = {}
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def _plan_for(self, batch: GraphBatch) -> ShardPlan:
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.asarray(batch.senders).tobytes())
+        h.update(np.asarray(batch.receivers).tobytes())
+        h.update(np.asarray(batch.node_mask).tobytes())
+        # the plan bakes in graph-boundary assignment too: identical edge
+        # topology collated as ONE graph vs two must not share a plan
+        h.update(np.asarray(batch.node_gid).tobytes())
+        h.update(np.asarray(batch.graph_mask).tobytes())
+        key = h.digest()
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = build_shard_plan(
+                batch, self.n_shards, method=self.cfg.method,
+                hops=self.hops, halo_max=self.cfg.halo_max)
+            if len(self._plans) >= 64:  # bound host memory on huge epochs
+                self._plans.clear()
+            self._plans[key] = plan
+            self.stats = dict(plan.stats)
+        return plan
+
+    def peek_stats(self) -> Dict[str, Any]:
+        """Partition stats of the first batch (builds + caches its plan) —
+        what the trainer logs to telemetry before the epoch loop."""
+        if not self.stats:
+            try:
+                first = next(iter(self.loader))
+            except StopIteration:
+                return {}
+            self._plan_for(first)
+        return self.stats
+
+    def __iter__(self):
+        for batch in self.loader:
+            yield apply_plan(batch, self._plan_for(batch), self.head_types)
+
+
+def shard_batch_halo(batch: GraphBatch, n_shards: int, method: str = "sfc",
+                     hops: int = 2, halo_max: int = 0,
+                     head_types: Optional[List[str]] = None,
+                     ) -> Tuple[HaloBatch, ShardPlan]:
+    """One-shot convenience: plan + apply for a single batch (tests,
+    bench, tools)."""
+    plan = build_shard_plan(batch, n_shards, method=method, hops=hops,
+                            halo_max=halo_max)
+    return apply_plan(batch, plan, head_types), plan
+
+
+def synthetic_lattice_batch(k: int, features: int = 4, seed: int = 0
+                            ) -> GraphBatch:
+    """k^3 nodes on a 3D grid with edges to the 6 axis neighbors, collated
+    as ONE giant graph — the shared synthetic input ``bench.py --giant``
+    and ``tools/partview.py`` measure partitions on (one definition, so
+    the partition-quality report describes the graphs the bench ladder
+    actually times)."""
+    from hydragnn_tpu.graph.batch import (
+        GraphSample,
+        HeadSpec,
+        PadSpec,
+        collate,
+    )
+
+    rng = np.random.RandomState(seed)
+    n = k ** 3
+    iz, iy, ix = np.meshgrid(*[np.arange(k)] * 3, indexing="ij")
+    pos = np.stack([ix, iy, iz], axis=-1).reshape(n, 3).astype(np.float32)
+    idx = np.arange(n).reshape(k, k, k)
+    send, recv = [], []
+    for axis in range(3):
+        a = np.take(idx, np.arange(k - 1), axis=axis).ravel()
+        b = np.take(idx, np.arange(1, k), axis=axis).ravel()
+        send += [a, b]
+        recv += [b, a]
+    ei = np.stack([np.concatenate(send), np.concatenate(recv)]).astype(
+        np.int32)
+    x = rng.rand(n, features).astype(np.float32)
+    s = GraphSample(x=x, pos=pos, edge_index=ei, node_y=x[:, :1] * 2.0)
+    pad = PadSpec(num_nodes=n + 8, num_edges=ei.shape[1] + 8, num_graphs=2)
+    return collate([s], pad, [HeadSpec("charge", "node", 1)])

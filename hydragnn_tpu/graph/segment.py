@@ -211,20 +211,31 @@ def masked_mean_pool(x, node_gid, num_graphs, node_mask, sorted_hint=False):
     (set by Base.forward when the batch carries collate's
     verified-invariants marker) routes the sum through the dense-schedule
     sorted scatter kernel — collate's node_gid is nondecreasing by
-    construction."""
+    construction.
+
+    Shard-aware: under an active halo-sharding trace (graph/partition.py:
+    halo_context) a graph's nodes span shards, so the per-shard partial
+    sums and counts are psum-ed across the mesh axis before the divide —
+    every shard sees the exact global per-graph means."""
+    from hydragnn_tpu.graph.partition import halo_axes, halo_psum
+
     _count("mean_pool", bool(sorted_hint))
-    if sorted_hint:
+    if sorted_hint and halo_axes() is None:
         from hydragnn_tpu.ops.fused_mp import segment_sum_dense
 
         total = segment_sum_dense(
             x * _bcast(node_mask, x), node_gid, num_graphs)
         count = segment_count(node_gid, num_graphs, node_mask)
         return _mean_divide(total, count)
-    return segment_mean(x, node_gid, num_graphs, node_mask)
+    total = halo_psum(segment_sum(x, node_gid, num_graphs, node_mask))
+    count = halo_psum(segment_count(node_gid, num_graphs, node_mask))
+    return _mean_divide(total, count)
 
 
 def masked_sum_pool(x, node_gid, num_graphs, node_mask):
-    return segment_sum(x, node_gid, num_graphs, node_mask)
+    from hydragnn_tpu.graph.partition import halo_psum
+
+    return halo_psum(segment_sum(x, node_gid, num_graphs, node_mask))
 
 
 # ---------------------------------------------------------------------------

@@ -408,7 +408,11 @@ def multihead_loss_nll(
             2.0 * var)
         m = mask.reshape(mask.shape + (1,) * (nll.ndim - mask.ndim))
         nll = jnp.where(m > 0, nll, 0.0)
-        head_loss = jnp.sum(nll) / jnp.maximum(jnp.sum(m) * dim, 1.0)
+        # shard-aware like loss_function's masked mean (graph/partition.py)
+        from hydragnn_tpu.graph.partition import halo_psum
+
+        head_loss = halo_psum(jnp.sum(nll)) / jnp.maximum(
+            halo_psum(jnp.sum(m)) * dim, 1.0)
         per_head.append(head_loss)
         total = total + weights[ihead] * head_loss
     return total, per_head

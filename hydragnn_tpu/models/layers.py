@@ -55,9 +55,16 @@ def loss_function(name: str) -> Callable[[jax.Array, jax.Array, jax.Array], jax.
     """
 
     def _masked_mean(err, mask):
+        # shard-aware (graph/partition.py): under a halo-sharding trace the
+        # valid rows are split across shards — psum numerator and count so
+        # every shard computes the exact GLOBAL masked mean (identity
+        # outside a halo trace)
+        from hydragnn_tpu.graph.partition import halo_psum
+
         m = mask.reshape(mask.shape + (1,) * (err.ndim - mask.ndim))
-        denom = jnp.maximum(jnp.sum(m) * err.shape[-1], 1.0)
-        return jnp.sum(err * m) / denom
+        denom = jnp.maximum(
+            halo_psum(jnp.sum(m)) * err.shape[-1], 1.0)
+        return halo_psum(jnp.sum(err * m)) / denom
 
     if name == "mse":
         return lambda p, t, m: _masked_mean((p - t) ** 2, m)
@@ -158,10 +165,19 @@ class MaskedBatchNorm(nn.Module):
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
+            # shard-aware statistics: under a halo-sharding trace
+            # (graph/partition.py:halo_context) the masked rows are split
+            # across shards — psum the partial sums/counts so every shard
+            # normalizes with the exact GLOBAL batch statistics (the same
+            # SyncBatchNorm semantics the GSPMD path gets implicitly, and
+            # the property that keeps a halo copy bit-consistent with its
+            # owner row).  Identity outside a halo trace.
+            from hydragnn_tpu.graph.partition import halo_psum
+
             m = mask.astype(x.dtype)[:, None]
-            count = jnp.maximum(jnp.sum(m), 1.0)
-            mean = jnp.sum(x * m, axis=0) / count
-            var = jnp.sum(((x - mean) ** 2) * m, axis=0) / count
+            count = jnp.maximum(halo_psum(jnp.sum(m)), 1.0)
+            mean = halo_psum(jnp.sum(x * m, axis=0)) / count
+            var = halo_psum(jnp.sum(((x - mean) ** 2) * m, axis=0)) / count
             if not self.is_initializing():
                 # torch tracks the *unbiased* variance in running stats
                 unbiased = var * count / jnp.maximum(count - 1.0, 1.0)

@@ -235,6 +235,110 @@ def global_batch(stacked: GraphBatch, mesh: Mesh,
     return jax.tree.map(conv, stacked)
 
 
+def _resolve_zero_request(zero_specs, zero_axis, axes, mesh):
+    """Normalize the ``zero_specs`` argument the sharded step builders
+    accept (a ZeroSharding, a raw PartitionSpec tree, or None) into
+    ``(zero_sh, zero_specs, zero_axis, n_zero, zero_stage2)`` — one
+    definition shared by the DP and halo train steps."""
+    from hydragnn_tpu.parallel.zero import ZeroSharding
+
+    zero_sh: Optional[ZeroSharding] = None
+    if isinstance(zero_specs, ZeroSharding):
+        zero_sh = zero_specs
+        zero_specs = zero_sh.opt_specs
+        if zero_axis is not None and zero_axis != zero_sh.axis:
+            raise ValueError(
+                f"zero_axis={zero_axis!r} but the ZeroSharding was built "
+                f"for axis {zero_sh.axis!r}")
+        zero_axis = zero_sh.axis
+    zero_stage2 = zero_sh is not None and zero_sh.stage >= 2
+    if zero_specs is not None:
+        # derive the shard axis from the specs the opt state was ACTUALLY
+        # placed with — a separately-guessed axis would slice gradients
+        # along one axis into moments sharded along another, silently
+        # corrupting every update
+        spec_names = {
+            s[0]
+            for s in jax.tree_util.tree_leaves(
+                zero_specs, is_leaf=lambda x: isinstance(x, P))
+            if isinstance(s, P) and len(s) > 0 and s[0] is not None
+        }
+        if len(spec_names) > 1:
+            raise ValueError(
+                f"zero_specs shard along multiple axes: {spec_names}")
+        if spec_names:
+            derived = spec_names.pop()
+            if zero_axis is not None and zero_axis != derived:
+                raise ValueError(
+                    f"zero_axis={zero_axis!r} but zero_specs were built "
+                    f"for axis {derived!r}")
+            zero_axis = derived
+    zero_axis = zero_axis or axes[-1]
+    n_zero = int(mesh.shape[zero_axis])
+    return zero_sh, zero_specs, zero_axis, n_zero, zero_stage2
+
+
+def _apply_sharded_update(state: TrainState, grads, params_full, opt_spec,
+                          cfg, zero_specs, zero_stage2: bool,
+                          zero_axis: str, n_zero: int):
+    """The optimizer-update tail every sharded train step runs after its
+    (replicated) gradients exist: plain full-tree update, or the ZeRO
+    slice/update/gather dance.  Returns (new_params, new_opt_state,
+    updates).  Runs inside shard_map."""
+    import optax
+
+    from hydragnn_tpu.models.base import encoder_freeze_mask
+
+    if zero_specs is not None:
+        from hydragnn_tpu.parallel import zero
+
+        idx = jax.lax.axis_index(zero_axis)
+        g_sh = zero.shard_tree(grads, idx, n_zero)
+        # stage 2: the at-rest params ARE this device's (padded) slice
+        p_sh = (state.params if zero_stage2
+                else zero.shard_tree(state.params, idx, n_zero))
+        updates, new_opt_state = opt_spec.tx.update(
+            g_sh, state.opt_state, p_sh)
+        updates = encoder_freeze_mask(updates, cfg.freeze_conv)
+        new_p_sh = optax.apply_updates(p_sh, updates)
+        # stage 2 keeps the updated slices sharded at rest; stage 1
+        # gathers them back to the replicated layout
+        new_params = (new_p_sh if zero_stage2 else
+                      zero.unshard_tree(new_p_sh, params_full, zero_axis))
+    else:
+        updates, new_opt_state = opt_spec.tx.update(
+            grads, state.opt_state, state.params)
+        updates = encoder_freeze_mask(updates, cfg.freeze_conv)
+        new_params = optax.apply_updates(state.params, updates)
+    return new_params, new_opt_state, updates
+
+
+def _zero_slice_norm(tree, zero_axis: str):
+    """Global L2 norm of a ZeRO-sharded tree: psum of squared SLICE norms
+    for rank>=1 leaves, replicated scalars (PReLU's alpha) added once
+    OUTSIDE the psum (a psum would count them N times and make the metric
+    stage-dependent); padded rows are zero and don't perturb anything."""
+    zero = jnp.asarray(0.0, jnp.float32)
+    sq_sl = sq_sc = zero
+    for x in jax.tree_util.tree_leaves(tree):
+        s = jnp.sum(jnp.square(x.astype(jnp.float32)))
+        if jnp.ndim(x) >= 1:
+            sq_sl = sq_sl + s
+        else:
+            sq_sc = sq_sc + s
+    return jnp.sqrt(jax.lax.psum(sq_sl, zero_axis) + sq_sc)
+
+
+def _zero_state_specs(zero_sh, zero_specs, zero_stage2: bool) -> TrainState:
+    """shard_map in/out specs for a TrainState under the resolved ZeRO
+    layout (replicated everywhere below stage 1)."""
+    opt_spec_tree = P() if zero_specs is None else zero_specs
+    param_spec_tree = zero_sh.param_specs if zero_stage2 else P()
+    return TrainState(
+        step=P(), params=param_spec_tree, batch_stats=P(),
+        opt_state=opt_spec_tree)
+
+
 def make_dp_train_step(
     model: Base,
     cfg: ModelConfig,
@@ -280,45 +384,10 @@ def make_dp_train_step(
     every replica skips the same update — replicas can never diverge on a
     bad batch.  Default OFF: traces the exact pre-guard program.
     """
-    import optax
-
-    from hydragnn_tpu.parallel.zero import ZeroSharding
-
     energy_head, forces_head = _force_head_indices(output_names)
     axes = _dp_axes(axis)
-    zero_sh: Optional[ZeroSharding] = None
-    if isinstance(zero_specs, ZeroSharding):
-        zero_sh = zero_specs
-        zero_specs = zero_sh.opt_specs
-        if zero_axis is not None and zero_axis != zero_sh.axis:
-            raise ValueError(
-                f"zero_axis={zero_axis!r} but the ZeroSharding was built "
-                f"for axis {zero_sh.axis!r}")
-        zero_axis = zero_sh.axis
-    zero_stage2 = zero_sh is not None and zero_sh.stage >= 2
-    if zero_specs is not None:
-        # derive the shard axis from the specs the opt state was ACTUALLY
-        # placed with — a separately-guessed axis would slice gradients
-        # along one axis into moments sharded along another, silently
-        # corrupting every update
-        spec_names = {
-            s[0]
-            for s in jax.tree_util.tree_leaves(
-                zero_specs, is_leaf=lambda x: isinstance(x, P))
-            if isinstance(s, P) and len(s) > 0 and s[0] is not None
-        }
-        if len(spec_names) > 1:
-            raise ValueError(
-                f"zero_specs shard along multiple axes: {spec_names}")
-        if spec_names:
-            derived = spec_names.pop()
-            if zero_axis is not None and zero_axis != derived:
-                raise ValueError(
-                    f"zero_axis={zero_axis!r} but zero_specs were built "
-                    f"for axis {derived!r}")
-            zero_axis = derived
-    zero_axis = zero_axis or axes[-1]
-    n_zero = int(mesh.shape[zero_axis])
+    zero_sh, zero_specs, zero_axis, n_zero, zero_stage2 = \
+        _resolve_zero_request(zero_specs, zero_axis, axes, mesh)
 
     def per_device(state: TrainState, g: GraphBatch):
         # leading device axis has size 1 inside the shard; drop it
@@ -359,29 +428,9 @@ def make_dp_train_step(
         per_head = [jax.lax.psum(p * ng_local, axes) / denom
                     for p in per_head]
 
-        from hydragnn_tpu.models.base import encoder_freeze_mask
-
-        if zero_specs is not None:
-            from hydragnn_tpu.parallel import zero
-
-            idx = jax.lax.axis_index(zero_axis)
-            g_sh = zero.shard_tree(grads, idx, n_zero)
-            # stage 2: the at-rest params ARE this device's (padded) slice
-            p_sh = (state.params if zero_stage2
-                    else zero.shard_tree(state.params, idx, n_zero))
-            updates, new_opt_state = opt_spec.tx.update(
-                g_sh, state.opt_state, p_sh)
-            updates = encoder_freeze_mask(updates, cfg.freeze_conv)
-            new_p_sh = optax.apply_updates(p_sh, updates)
-            # stage 2 keeps the updated slices sharded at rest; stage 1
-            # gathers them back to the replicated layout
-            new_params = (new_p_sh if zero_stage2 else
-                          zero.unshard_tree(new_p_sh, params_full, zero_axis))
-        else:
-            updates, new_opt_state = opt_spec.tx.update(
-                grads, state.opt_state, state.params)
-            updates = encoder_freeze_mask(updates, cfg.freeze_conv)
-            new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt_state, updates = _apply_sharded_update(
+            state, grads, params_full, opt_spec, cfg, zero_specs,
+            zero_stage2, zero_axis, n_zero)
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
@@ -401,30 +450,15 @@ def make_dp_train_step(
             tele["nodes_real"] = jax.lax.psum(tele["nodes_real"], axes)
             tele["edges_real"] = jax.lax.psum(tele["edges_real"], axes)
             if zero_specs is not None:
-                # ZeRO: updates live sharded along zero_axis — psum the
-                # squared SLICE norms for the global norm.  Scalar leaves
-                # (PReLU's alpha) pass through shard_tree replicated, so
-                # they are summed OUTSIDE the psum (a psum would count
-                # them N times and make the metric stage-dependent);
-                # padded rows are zero and don't perturb anything.
-                # (grad/param norms at stage 1 are already replicated:
+                # ZeRO: updates live sharded along zero_axis — the global
+                # norm is the psum-of-slice-norms (_zero_slice_norm;
+                # grad/param norms at stage 1 are already replicated:
                 # pmean'd grads, all-gathered params)
-                def _zero_norm(tree):
-                    zero = jnp.asarray(0.0, jnp.float32)
-                    sq_sl = sq_sc = zero
-                    for x in jax.tree_util.tree_leaves(tree):
-                        s = jnp.sum(jnp.square(x.astype(jnp.float32)))
-                        if jnp.ndim(x) >= 1:
-                            sq_sl = sq_sl + s
-                        else:
-                            sq_sc = sq_sc + s
-                    return jnp.sqrt(
-                        jax.lax.psum(sq_sl, zero_axis) + sq_sc)
-
-                tele["update_norm"] = _zero_norm(updates)
+                tele["update_norm"] = _zero_slice_norm(updates, zero_axis)
                 if zero_stage2:
                     # stage 2: new_params are slices too
-                    tele["param_norm"] = _zero_norm(new_params)
+                    tele["param_norm"] = _zero_slice_norm(
+                        new_params, zero_axis)
             metrics.update(tele)
         if nonfinite_guard:
             from hydragnn_tpu.resilience.guards import (
@@ -440,11 +474,7 @@ def make_dp_train_step(
                 bad, state, new_state, metrics)
         return new_state, metrics
 
-    opt_spec_tree = P() if zero_specs is None else zero_specs
-    param_spec_tree = zero_sh.param_specs if zero_stage2 else P()
-    state_specs = TrainState(
-        step=P(), params=param_spec_tree, batch_stats=P(),
-        opt_state=opt_spec_tree)
+    state_specs = _zero_state_specs(zero_sh, zero_specs, zero_stage2)
     sharded = _shard_map(
         per_device,
         mesh=mesh,
@@ -504,6 +534,218 @@ def make_dp_eval_step(
         return {
             "loss": loss,
             "num_graphs": num_graphs,
+            "per_head": per_head,
+            "outputs": outputs,
+        }
+
+    state_specs = P()
+    if zero is not None:
+        state_specs = TrainState(
+            step=P(),
+            params=zero.param_specs if zero_stage2 else P(),
+            batch_stats=P(),
+            opt_state=zero.opt_specs,
+        )
+    sharded = _shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(state_specs, P(axes)),
+        out_specs={
+            "loss": P(),
+            "num_graphs": P(),
+            "per_head": P(),
+            "outputs": P(axes),
+        },
+    )
+    return jax.jit(sharded)
+
+
+def make_halo_train_step(
+    model: Base,
+    cfg: ModelConfig,
+    opt_spec: OptimizerSpec,
+    mesh: Mesh,
+    output_names: Optional[Sequence[str]] = None,
+    axis=DATA_AXIS,
+    zero_specs=None,
+    zero_axis: Optional[str] = None,
+    telemetry_metrics: bool = False,
+    nonfinite_guard: bool = False,
+):
+    """jit'd train step over a halo-sharded GIANT graph: the input is a
+    stacked :class:`~hydragnn_tpu.graph.partition.HaloBatch` [D, ...] —
+    each device holds ONLY its N/D local node rows plus the static halo
+    plan (graph/partition.py).
+
+    Inside the shard_map each device gathers its halo rows with one
+    ``all_to_all`` into the bounded ``[D*halo_pair]`` buffer, runs the
+    UNCHANGED model on local+halo rows (graph pooling / BatchNorm
+    statistics / the masked-mean losses psum their partial sums through
+    the :func:`~hydragnn_tpu.graph.partition.halo_context` hooks, so loss
+    and batch statistics are exactly the single-device values), and
+    ``psum``s the per-shard PARTIAL parameter gradients — shard
+    contributions are disjoint node/edge subsets, so the psum is the DDP
+    all-reduce's sum, not its mean.  Halo cotangents reduce-scatter back
+    to their owner shards through the transpose of the exchange (jax AD).
+
+    Composes with ZeRO exactly like :func:`make_dp_train_step`
+    (``zero_specs`` may be a ZeroSharding of stage 1 or 2): parameters
+    stay replicated-or-ZeRO-sharded while the DATA is graph-sharded.
+
+    Unsupported (raises): energy-gradient force self-consistency
+    (``total_energy`` + ``atomic_forces`` heads) — dE/dpos of a boundary
+    node would miss the contributions of edges owned by neighbor shards;
+    multi-axis (dcn, ici) meshes — the exchange is a single-axis
+    all_to_all.
+    """
+    energy_head, forces_head = _force_head_indices(output_names)
+    if energy_head >= 0 and forces_head >= 0:
+        raise ValueError(
+            "halo graph sharding does not support the energy-gradient "
+            "force self-consistency term: dE/dpos of boundary nodes "
+            "would miss cross-shard edge contributions")
+    axes = _dp_axes(axis)
+    if len(axes) != 1:
+        raise ValueError(
+            "halo graph sharding needs a 1-axis mesh (the halo exchange "
+            "is a single-axis all_to_all); got axes " + repr(axes))
+    zero_sh, zero_specs, zero_axis, n_zero, zero_stage2 = \
+        _resolve_zero_request(zero_specs, zero_axis, axes, mesh)
+
+    from hydragnn_tpu.graph.partition import assemble_extended, halo_context
+
+    def per_device(state: TrainState, hb):
+        hb = jax.tree.map(lambda x: x[0], hb)
+        # SAME dropout stream on every shard (no dev_idx fold-in): a halo
+        # row and its owner row still sit at different positions, so
+        # dropout>0 training is approximate under sharding — documented in
+        # docs/SCALING.md; the repo's models are dropout-free except GAT.
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0xD0), state.step)
+        if zero_stage2:
+            from hydragnn_tpu.parallel import zero
+
+            params_full = zero.unshard_tree_dims(
+                state.params, zero_sh.param_dims, zero_axis)
+        else:
+            params_full = state.params
+
+        def loss_fn(params):
+            with halo_context(axes[0]):
+                g_ext = assemble_extended(hb, axes[0])
+                return _loss_and_metrics(
+                    model, cfg, params, state.batch_stats, g_ext, True,
+                    energy_head, forces_head, dropout_rng)
+
+        (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params_full)
+        # per-shard grads are PARTIAL sums over disjoint owned subgraphs;
+        # psum (not pmean) assembles the global gradient.  loss, per-head
+        # losses and BN statistics came back GLOBAL already (the
+        # halo-context psums ran inside the trace).  One wrinkle: taking
+        # jax.grad INSIDE shard_map (replication checking off) scales the
+        # per-shard cotangent of every in-trace psum by a semantics-
+        # dependent factor T — D on jax 0.4.x (transpose(psum) == psum of
+        # the replicated seed), 1 under replication-tracked transposes —
+        # uniformly across leaves.  Measure T with a one-op probe and
+        # divide it out (T is a power of two: the division is exact), so
+        # the psum below is the exact global gradient under either
+        # convention; the parity tests pin this leaf-for-leaf.
+        cal = jax.grad(lambda s: jax.lax.psum(s, axes[0]))(
+            jnp.asarray(1.0, jnp.float32))
+        grads = jax.lax.psum(
+            jax.tree.map(lambda g: g / cal, grads), axes)
+        num_graphs = hb.n_real_graphs  # graph arrays replicated per shard
+        new_params, new_opt_state, updates = _apply_sharded_update(
+            state, grads, params_full, opt_spec, cfg, zero_specs,
+            zero_stage2, zero_axis, n_zero)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        metrics = {
+            "loss": loss,
+            "num_graphs": num_graphs,
+            **{f"task_{i}": t for i, t in enumerate(per_head)},
+        }
+        if telemetry_metrics:
+            from hydragnn_tpu.train.trainer import tree_l2_norm
+
+            owned = hb.extras.get("edge_owned_mask", hb.edge_mask)
+            metrics.update({
+                "grad_norm": tree_l2_norm(grads),
+                "param_norm": tree_l2_norm(new_params),
+                "update_norm": tree_l2_norm(updates),
+                # counts over OWNED rows/edges — halo duplicates excluded,
+                # so padding-waste accounting stays meaningful
+                "nodes_real": jax.lax.psum(jnp.sum(hb.node_mask), axes),
+                "edges_real": jax.lax.psum(jnp.sum(owned), axes),
+            })
+            if zero_specs is not None:
+                metrics["update_norm"] = _zero_slice_norm(updates, zero_axis)
+                if zero_stage2:
+                    metrics["param_norm"] = _zero_slice_norm(
+                        new_params, zero_axis)
+        if nonfinite_guard:
+            from hydragnn_tpu.resilience.guards import (
+                apply_step_guard,
+                nonfinite_flag,
+            )
+
+            # grads are psum'd (replicated) and the loss is global, so the
+            # flag is identical on every shard
+            bad = nonfinite_flag(loss, grads)
+            new_state, metrics = apply_step_guard(
+                bad, state, new_state, metrics)
+        return new_state, metrics
+
+    state_specs = _zero_state_specs(zero_sh, zero_specs, zero_stage2)
+    sharded = _shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(state_specs, P(axes)),
+        out_specs=(state_specs, P()),
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+def make_halo_eval_step(
+    model: Base,
+    cfg: ModelConfig,
+    mesh: Mesh,
+    axis=DATA_AXIS,
+    zero=None,
+):
+    """jit'd eval step over a halo-sharded giant graph (stacked HaloBatch
+    input).  Loss/per-head metrics come back global and replicated (the
+    halo-context psums); per-shard node outputs come back stacked along
+    the mesh axis [D, ext_n, .] with halo/pad rows masked by the stacked
+    ``node_mask``.  ``zero`` matches ZeRO-sharded state like
+    :func:`make_dp_eval_step`."""
+    axes = _dp_axes(axis)
+    if len(axes) != 1:
+        raise ValueError("halo graph sharding needs a 1-axis mesh")
+    zero_stage2 = zero is not None and zero.stage >= 2
+
+    from hydragnn_tpu.graph.partition import assemble_extended, halo_context
+
+    def per_device(state: TrainState, hb):
+        hb = jax.tree.map(lambda x: x[0], hb)
+        params = state.params
+        if zero_stage2:
+            from hydragnn_tpu.parallel import zero as zero_mod
+
+            params = zero_mod.unshard_tree_dims(
+                state.params, zero.param_dims, zero.axis)
+        with halo_context(axes[0]):
+            g_ext = assemble_extended(hb, axes[0])
+            loss, (per_head, _, outputs) = _loss_and_metrics(
+                model, cfg, params, state.batch_stats, g_ext, False)
+        outputs = jax.tree.map(lambda x: x[None], outputs)
+        return {
+            "loss": loss,
+            "num_graphs": hb.n_real_graphs,
             "per_head": per_head,
             "outputs": outputs,
         }

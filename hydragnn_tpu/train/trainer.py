@@ -702,6 +702,17 @@ def train_validate_test(
     zero_requested = zero_stage_from_training(training, opt_spec)
     zero_stage = zero_requested
     zero_fallback = None
+    # graph sharding request (Training.graph_shard + HYDRAGNN_GRAPH_SHARD*
+    # env): one giant graph split across the mesh (docs/SCALING.md §6) —
+    # resolved before the step builders because the partition and the halo
+    # exchange are trace-time choices
+    from hydragnn_tpu.graph.partition import (
+        HALO_SUPPORTED_MODELS,
+        GraphShardConfig,
+    )
+
+    gs_cfg = GraphShardConfig.from_training(training)
+    graph_shard = gs_cfg.backend
     if zero_requested and getattr(opt_spec, "name", "") \
             in NON_ELEMENTWISE_OPTIMIZERS:
         # env-forced ZeRO on a LAMB run: warn-and-disable rather than
@@ -791,6 +802,47 @@ def train_validate_test(
         from hydragnn_tpu.parallel.mesh import mesh_dp_axes
 
         dp_axes = mesh_dp_axes(mesh)
+        single_proc = mesh_process_count(mesh) == 1
+        # -- graph-sharding gating (docs/SCALING.md §6): resolved BEFORE the
+        # ZeRO placement because the gspmd baseline cannot compose with a
+        # sharded state (its step is the local jit, no shard_map to slice
+        # in), and every fallback must be LOUD — an operator who requested
+        # graph sharding believes a giant graph fits
+        gs_requested = graph_shard
+        gs_fallback = None
+        n_shards = int(mesh.devices.size)
+        if graph_shard != "off":
+            if not single_proc:
+                gs_fallback = "multi_process"
+            elif graph_shard == "halo" and len(mesh.axis_names) != 1:
+                gs_fallback = "multi_axis_mesh"
+            elif (graph_shard == "halo"
+                    and cfg.model_type not in HALO_SUPPORTED_MODELS):
+                gs_fallback = "unsupported_model"
+            else:
+                e_h, f_h = _force_head_indices(output_names)
+                if graph_shard == "halo" and e_h >= 0 and f_h >= 0:
+                    gs_fallback = "force_consistency"
+            if gs_fallback is not None:
+                import warnings
+
+                warnings.warn(
+                    f"graph sharding ({graph_shard}) requested but this run "
+                    f"cannot use it ({gs_fallback}) — training with the "
+                    "plain DP mesh path (the graph must fit one device)",
+                    stacklevel=2)
+                telemetry.health("graph_shard_fallback",
+                                 requested=graph_shard, reason=gs_fallback)
+                graph_shard = "off"
+        if graph_shard == "gspmd" and zero_stage > 0:
+            import warnings
+
+            warnings.warn(
+                "ZeRO cannot compose with the gspmd graph-shard baseline "
+                "(its step is the local jit — no shard_map to slice the "
+                "state in); training with REPLICATED state.  Use the halo "
+                "backend for ZeRO + graph sharding.", stacklevel=2)
+            zero_stage, zero_fallback = 0, "gspmd_graph_shard"
         zero_sh = None
         if zero_stage > 0:
             # ZeRO: optimizer state (stage 1) — and params (stage 2) — live
@@ -801,48 +853,127 @@ def train_validate_test(
             state, zero_sh = zero_shard_state(state, mesh, stage=zero_stage)
         else:
             state = replicate_state(state, mesh)
+        gs_stats = {}
+        if graph_shard == "halo":
+            # halo graph sharding: ONE graph (batch) split across the mesh —
+            # loaders partition each batch into stacked HaloBatches, the
+            # steps exchange halo rows (graph/partition.py, docs/SCALING.md
+            # §6).  Scan chunking is not composed (the carrier is a
+            # different pytree per topology bucket); K stays 1.
+            from hydragnn_tpu.graph.partition import ShardedGraphLoader
+            from hydragnn_tpu.parallel.mesh import (
+                make_halo_eval_step,
+                make_halo_train_step,
+            )
+
+            if env_int("HYDRAGNN_STEPS_PER_DISPATCH", 1) > 1:
+                import warnings
+
+                warnings.warn(
+                    "HYDRAGNN_STEPS_PER_DISPATCH > 1 is not composed with "
+                    "graph sharding; forcing K=1", stacklevel=2)
+            steps_per_dispatch = 1
+            hops = gs_cfg.hops or cfg.num_conv_layers
+            if hops < cfg.num_conv_layers:
+                # a halo shallower than the conv stack silently corrupts
+                # boundary rows at the deeper layers — the exact
+                # truncated-halo wrong answer graph_shard_halo_max refuses;
+                # deeper than the stack is merely wasteful and allowed
+                raise ValueError(
+                    f"graph_shard_hops={hops} is shallower than the "
+                    f"model's {cfg.num_conv_layers} conv layers — boundary "
+                    "rows would train on silently wrong neighborhoods; "
+                    "set it >= num_conv_layers or leave it 0 (auto)")
+            head_types = list(cfg.output_type)
+            train_loader = ShardedGraphLoader(
+                train_loader, n_shards, gs_cfg, hops, head_types)
+            val_loader = ShardedGraphLoader(
+                val_loader, n_shards, gs_cfg, hops, head_types)
+            test_loader = ShardedGraphLoader(
+                test_loader, n_shards, gs_cfg, hops, head_types)
+            gs_stats = train_loader.peek_stats()
+            train_step = make_halo_train_step(
+                model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
+                zero_specs=zero_sh, telemetry_metrics=telemetry.enabled,
+                nonfinite_guard=res_cfg.nonfinite_guard)
+            eval_step = make_halo_eval_step(model, cfg, mesh, axis=dp_axes,
+                                            zero=zero_sh)
+        elif graph_shard == "gspmd":
+            # correctness baseline: committed-sharded batches, GSPMD inserts
+            # (full-array) collectives — no memory win, exact numerics
+            # (parallel/graph_shard.py docstring)
+            from hydragnn_tpu.parallel.graph_shard import (
+                GspmdBatchLoader,
+                make_gspmd_eval_step,
+                make_gspmd_train_step,
+            )
+
+            steps_per_dispatch = 1
+            train_loader = GspmdBatchLoader(train_loader, mesh)
+            val_loader = GspmdBatchLoader(val_loader, mesh)
+            test_loader = GspmdBatchLoader(test_loader, mesh)
+            gs_stats = {"n_shards": n_shards}
+            train_step = make_gspmd_train_step(
+                model, cfg, opt_spec, mesh, output_names,
+                telemetry_metrics=telemetry.enabled,
+                nonfinite_guard=res_cfg.nonfinite_guard)
+            eval_step = make_gspmd_eval_step(model, cfg, mesh)
+        else:
+            # scan chunking works on the multi-host path too: every process
+            # assembles [K, d_local, ...] superbatches that GlobalBatchLoader
+            # turns into [K, d_global, ...] (spec P(None, dp)) for the
+            # scanned step — K steps of cross-host psum per dispatch,
+            # amortizing the per-dispatch host latency that multi-host runs
+            # otherwise pay per step (docs/SCALING.md "Dispatch overhead")
+            steps_per_dispatch = max(
+                1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
+            train_step = make_dp_train_step(
+                model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
+                zero_specs=zero_sh, steps=steps_per_dispatch,
+                telemetry_metrics=telemetry.enabled,
+                nonfinite_guard=res_cfg.nonfinite_guard)
+            eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes,
+                                          zero=zero_sh)
+            _align_bucket_group(
+                train_loader, n_local_devices * steps_per_dispatch)
+            train_loader = DeviceStackLoader(
+                train_loader, n_local_devices, drop_last=True)
+            val_loader = DeviceStackLoader(
+                val_loader, n_local_devices, drop_last=False)
+            test_loader = DeviceStackLoader(
+                test_loader, n_local_devices, drop_last=False)
+            if steps_per_dispatch > 1:
+                # second stack: [K, D, ...] superbatches for the scanned step
+                train_loader = DeviceStackLoader(
+                    train_loader, steps_per_dispatch, drop_last=True)
         # per-device resident bytes under the chosen layout — the manifest
-        # `sharding` block, so the ~1/N saving is a measured number
+        # `sharding` block, so the ~1/N saving is a measured number; with
+        # graph sharding active it also carries the partition stats
+        # (cut-edge %, halo rows, imbalance, halo-buffer waste) teleview
+        # renders
         from hydragnn_tpu.parallel.zero import sharding_report
 
         telemetry.log_sharding({
             "zero_stage_requested": zero_requested,
             **({"fallback": zero_fallback} if zero_fallback else {}),
             **sharding_report(state, zero_sh),
+            **({"graph_shard": {
+                "backend": graph_shard,
+                "requested": gs_requested,
+                **({"fallback": gs_fallback} if gs_fallback else {}),
+                **gs_stats,
+            }} if gs_requested != "off" else {}),
         })
-        single_proc = mesh_process_count(mesh) == 1
-        # scan chunking works on the multi-host path too: every process
-        # assembles [K, d_local, ...] superbatches that GlobalBatchLoader
-        # turns into [K, d_global, ...] (spec P(None, dp)) for the scanned
-        # step — K steps of cross-host psum per dispatch, amortizing the
-        # per-dispatch host latency that multi-host runs otherwise pay
-        # per step (docs/SCALING.md "Dispatch overhead")
-        steps_per_dispatch = max(1, env_int("HYDRAGNN_STEPS_PER_DISPATCH", auto_k))
-        train_step = make_dp_train_step(
-            model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
-            zero_specs=zero_sh, steps=steps_per_dispatch,
-            telemetry_metrics=telemetry.enabled,
-            nonfinite_guard=res_cfg.nonfinite_guard)
-        eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes,
-                                      zero=zero_sh)
-        _align_bucket_group(
-            train_loader, n_local_devices * steps_per_dispatch)
-        train_loader = DeviceStackLoader(
-            train_loader, n_local_devices, drop_last=True)
-        val_loader = DeviceStackLoader(
-            val_loader, n_local_devices, drop_last=False)
-        test_loader = DeviceStackLoader(
-            test_loader, n_local_devices, drop_last=False)
-        if steps_per_dispatch > 1:
-            # second stack: [K, D, ...] superbatches for the scanned step
-            train_loader = DeviceStackLoader(
-                train_loader, steps_per_dispatch, drop_last=True)
-        if not single_proc:
+        if graph_shard == "off" and not single_proc:
             train_loader = GlobalBatchLoader(
                 train_loader, mesh, scan=steps_per_dispatch > 1)
             val_loader = GlobalBatchLoader(val_loader, mesh)
             test_loader = GlobalBatchLoader(test_loader, mesh)
-        else:
+        elif graph_shard != "gspmd":
+            # single-process DP and halo-sharded batches alike are stacked
+            # [D, ...] pytrees split along the mesh axis, so the prefetch /
+            # device-resident wrappers apply to both; gspmd batches are
+            # already committed-placed by GspmdBatchLoader
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             # batch sharding: leading scan axis (if any) replicated, device
@@ -874,6 +1005,19 @@ def train_validate_test(
                     test_loader, sharding=eval_shard)
     else:
         zero_sh = None
+        if graph_shard != "off":
+            # graph sharding needs the mesh path (there is no axis to split
+            # a graph across on the local-jit path) — warn-and-fall-back
+            import warnings
+
+            warnings.warn(
+                f"graph sharding ({graph_shard}) requested but this run "
+                "takes the single-device local-jit path — the graph must "
+                "fit one device (sharding needs the mesh path: >1 local "
+                "device, multi-process, or use_mesh_dp=True)", stacklevel=2)
+            telemetry.health("graph_shard_fallback", requested=graph_shard,
+                             reason="local_path")
+            graph_shard = "off"
         if zero_stage > 0:
             # ZeRO needs the mesh path (there is no axis to shard along on
             # the local-jit path) — warn-and-fall-back, and record the
@@ -1003,6 +1147,7 @@ def train_validate_test(
         "pipeline": {"steps_per_dispatch": steps_per_dispatch,
                      "resident": bool(resident_on),
                      "zero_stage": zero_stage,
+                     "graph_shard": graph_shard,
                      "auto_selected":
                          "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
@@ -1018,7 +1163,9 @@ def train_validate_test(
         if rp and (int(rp.get("steps_per_dispatch", steps_per_dispatch))
                    != steps_per_dispatch
                    or bool(rp.get("use_mesh_dp", use_mesh_dp))
-                   != bool(use_mesh_dp)):
+                   != bool(use_mesh_dp)
+                   or str(rp.get("graph_shard", graph_shard))
+                   != str(graph_shard)):
             raise ValueError(
                 f"resume bundle was saved with pipeline {rp} but this run "
                 f"built steps_per_dispatch={steps_per_dispatch}, "
@@ -1064,9 +1211,13 @@ def train_validate_test(
                          "resident": bool(resident_on),
                          "use_mesh_dp": bool(use_mesh_dp),
                          # the bundle's state is CONSOLIDATED (stage-
-                         # agnostic); recorded for provenance only — a
-                         # resume may re-shard under any stage exactly
+                         # agnostic) and the graph partition is DATA
+                         # sharding only — a resume may re-shard the state
+                         # under any stage exactly, but the batch stream
+                         # position counts dispatch units of THIS loader
+                         # stack, so graph_shard must match
                          "zero_stage": zero_stage,
+                         "graph_shard": graph_shard,
                          "n_local_devices": n_local_devices},
             "world_size": world_size,
         }
@@ -1302,6 +1453,13 @@ def test(
         outputs = m["outputs"]
         gm = np.asarray(g.graph_mask) > 0
         nm = np.asarray(g.node_mask) > 0
+        if hasattr(g, "send_idx") and gm.ndim == 2:
+            # halo-sharded batch (graph/partition.py:HaloBatch): graph
+            # arrays are REPLICATED per shard and stacked [D, G] — without
+            # this, every real graph's label/prediction is collected D
+            # times.  Node rows need no dedup: node_mask marks each real
+            # node on exactly its owner shard.
+            gm[1:] = False
         for ih in range(num_heads):
             out = np.asarray(outputs[ih])
             lab = np.asarray(g.labels[ih])

@@ -360,6 +360,38 @@ def sharding_section(shardings: List[Dict[str, Any]],
             f"back to replicated"
             + (f" ({s['fallback']})" if s.get("fallback") else "")
             + " — optimizer state is NOT sharded")
+    gs = s.get("graph_shard") or {}
+    if gs:
+        lines.append(
+            f"  graph_shard={gs.get('backend')} "
+            f"(requested {gs.get('requested', gs.get('backend'))})  "
+            f"shards={gs.get('n_shards', '-')} "
+            f"method={gs.get('method', '-')} hops={gs.get('hops', '-')}")
+        if gs.get("n_local") is not None:
+            lines.append(
+                f"  partition: {gs.get('n_nodes_real', '-')} nodes -> "
+                f"{gs.get('n_local')} local rows/shard + "
+                f"{gs.get('halo_rows_max', 0)} halo rows max "
+                f"(buffer {gs.get('n_shards', 0)}x{gs.get('halo_pair', 0)}"
+                f"/peer, {gs.get('halo_waste_pct', 0)}% padding waste)  "
+                f"cut edges {gs.get('cut_edge_pct', '-')}%")
+        imb = max(float(gs.get("node_imbalance", 1.0) or 1.0),
+                  float(gs.get("edge_imbalance", 1.0) or 1.0))
+        if imb > 1.5:
+            lines.append(
+                f"  WARNING partition imbalance {imb:.2f}x (max/mean "
+                "owned rows or edges) — the slowest shard paces every "
+                "step; try graph_shard_method=bfs|sfc or fewer shards")
+        if gs.get("fallback"):
+            lines.append(
+                f"  WARNING graph sharding ({gs.get('requested')}) was "
+                f"requested but the run fell back ({gs['fallback']}) — "
+                "the graph must fit ONE device")
+        if gs.get("backend") == "gspmd":
+            lines.append(
+                "  NOTE gspmd is the correctness baseline: GSPMD "
+                "all-gathers the full node array per step — no memory "
+                "headroom over single-device (docs/SCALING.md §6)")
     return "\n".join(lines)
 
 
