@@ -72,6 +72,28 @@ _KNOB_LIST = [
     _k("HYDRAGNN_DEVICE_PREFETCH", "", "0",
        "hydragnn_tpu/train/trainer.py",
        "overlap H2D transfer one batch ahead"),
+    # -- streaming data plane (data/stream/) ------------------------------
+    _k("HYDRAGNN_STREAM", "Dataset.stream", "0",
+       "hydragnn_tpu/data/stream/config.py",
+       "stream gpack samples with bounded residency instead of loading "
+       "the dataset in memory"),
+    _k("HYDRAGNN_STREAM_PATH", "Dataset.stream_path", "",
+       "hydragnn_tpu/data/stream/config.py",
+       "gpack store path the streaming loader reads from"),
+    _k("HYDRAGNN_STREAM_WINDOW", "Dataset.stream_window", "1024",
+       "hydragnn_tpu/data/stream/config.py",
+       "decoded-sample residency window W (peak ~ W + batch_size samples)"),
+    _k("HYDRAGNN_STREAM_ORDER", "Dataset.stream_order", "global",
+       "hydragnn_tpu/data/stream/config.py",
+       "epoch order: global (bit-parity with in-memory) | sequential | "
+       "block (locality shuffle)"),
+    _k("HYDRAGNN_STREAM_BLOCK", "Dataset.stream_block", "2048",
+       "hydragnn_tpu/data/stream/config.py",
+       "block size for stream_order=block"),
+    _k("HYDRAGNN_STREAM_TAIL", "Dataset.stream_tail", "",
+       "hydragnn_tpu/data/stream/config.py",
+       "ingest dir to tail: re-reads the manifest each epoch and trains "
+       "on newly sealed segments (implies stream)"),
     # -- trainer / pipeline ----------------------------------------------
     _k("HYDRAGNN_AUTO_PIPELINE", "", "1",
        "hydragnn_tpu/train/trainer.py",
@@ -402,6 +424,15 @@ _HEALTH_LIST = [
        "live replicas dropped below quorum"),
     _h("fleet_empty", "hydragnn_tpu/serve/router.py",
        "a request found no live replica (503)"),
+    # streaming data plane (docs/TELEMETRY.md "Streaming events")
+    _h("stream_open", "hydragnn_tpu/train/trainer.py",
+       "streaming data plane active (store, plan and window metadata)"),
+    _h("stream_fallback", "hydragnn_tpu/train/trainer.py",
+       "streaming requested but the run fell back to the in-memory path"),
+    _h("stream_tail_grow", "hydragnn_tpu/train/trainer.py",
+       "tail-mode store picked up newly sealed segments between epochs"),
+    _h("stream_torn_segment", "hydragnn_tpu/data/stream/ingest.py",
+       "ingest segment failed its manifest size check and was skipped"),
 ]
 
 HEALTH_KINDS: Dict[str, HealthKind] = {h.name: h for h in _HEALTH_LIST}

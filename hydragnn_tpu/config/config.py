@@ -228,6 +228,23 @@ def finalize(
         training["graph_shard"])
     training["graph_shard_method"] = check_partition_method(
         training["graph_shard_method"])
+    # streaming data-plane knobs (docs/DATA.md): Dataset-section defaults
+    # written back like the other sections, and VALIDATED on every
+    # construction path — a typo'd order mode must fail here, not silently
+    # fall back to the in-memory loader.  The HYDRAGNN_STREAM* env knobs
+    # overlay at data-loading time (env wins).
+    from hydragnn_tpu.data.stream.config import (
+        check_stream_flag,
+        check_stream_order,
+        stream_dataset_defaults,
+    )
+
+    config.setdefault("Dataset", {})
+    dataset = config["Dataset"]
+    for k, v in stream_dataset_defaults().items():
+        dataset.setdefault(k, v)
+    dataset["stream"] = check_stream_flag(dataset["stream"])
+    dataset["stream_order"] = check_stream_order(dataset["stream_order"])
     return config
 
 

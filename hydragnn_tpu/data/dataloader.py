@@ -182,44 +182,52 @@ class GraphDataLoader:
             yield self._collate_plan_item(item)
 
 
+def pad_spec_from_sizes(
+    nodes: np.ndarray, edges: np.ndarray, batch_size: int, round_to: int = 8
+) -> PadSpec:
+    """Pad spec covering the worst-case batch, from per-sample size arrays
+    alone — the streaming path feeds sizes read from gpack part headers, so
+    no sample body is ever decoded for spec sizing."""
+    max_nodes = int(np.max(nodes))
+    max_edges = max(int(np.max(edges)), 1)
+    return PadSpec.for_batch(batch_size, max_nodes, max_edges, round_to)
+
+
 def pad_spec_for(
     samples: Sequence[GraphSample], batch_size: int, round_to: int = 8
 ) -> PadSpec:
     """Pad spec covering the worst-case batch of this dataset."""
-    max_nodes = max(s.num_nodes for s in samples)
-    max_edges = max(max(s.num_edges for s in samples), 1)
-    return PadSpec.for_batch(batch_size, max_nodes, max_edges, round_to)
+    nodes = np.fromiter((s.num_nodes for s in samples), np.int64,
+                        count=len(samples))
+    edges = np.fromiter((s.num_edges for s in samples), np.int64,
+                        count=len(samples))
+    return pad_spec_from_sizes(nodes, edges, batch_size, round_to)
 
 
-def bucket_pad_specs(
-    samples: Sequence[GraphSample],
+def bucket_pad_specs_from_sizes(
+    nodes: np.ndarray,
+    edges: np.ndarray,
     batch_size: int,
     n_buckets: int = 3,
     round_to: int = 8,
     n_sim: int = 256,
     seed: int = 0,
 ) -> List[PadSpec]:
-    """2-4 bucket PadSpecs sized from the dataset's *batch-sum* distribution.
-
-    XLA needs static shapes, so a batch of variable-size graphs is padded to a
-    bucket; one worst-case bucket wastes most of the MXU work on skewed
-    datasets.  We simulate shuffled batches to estimate the distribution of
-    per-batch total nodes/edges (sums concentrate near batch_size*mean, far
-    below batch_size*max), then place bucket capacities at evenly spaced
-    quantiles with the top bucket = exact worst case, so every batch fits
-    somewhere.  Compile count is bounded by ``n_buckets``.
-    """
+    """Size-array core of :func:`bucket_pad_specs` (same RNG stream, same
+    numbers) — shared with the streaming loader, which has sizes but not
+    decoded samples."""
     n_buckets = max(1, int(n_buckets))
-    worst = pad_spec_for(samples, batch_size, round_to)
-    if n_buckets == 1 or len(samples) <= batch_size:
+    nodes = np.asarray(nodes, np.int64)
+    edges = np.maximum(np.asarray(edges, np.int64), 0)
+    n_samples = len(nodes)
+    worst = pad_spec_from_sizes(nodes, edges, batch_size, round_to)
+    if n_buckets == 1 or n_samples <= batch_size:
         return [worst]
-    nodes = np.asarray([s.num_nodes for s in samples], np.int64)
-    edges = np.asarray([max(s.num_edges, 0) for s in samples], np.int64)
     rng = np.random.RandomState(seed)
     sums_n = np.empty(n_sim, np.int64)
     sums_e = np.empty(n_sim, np.int64)
     for i in range(n_sim):
-        idx = rng.choice(len(samples), size=batch_size, replace=False)
+        idx = rng.choice(n_samples, size=batch_size, replace=False)
         sums_n[i] = nodes[idx].sum()
         sums_e[i] = edges[idx].sum()
     specs: List[PadSpec] = []
@@ -249,6 +257,32 @@ def bucket_pad_specs(
             seen.add(key)
             uniq.append(s)
     return uniq
+
+
+def bucket_pad_specs(
+    samples: Sequence[GraphSample],
+    batch_size: int,
+    n_buckets: int = 3,
+    round_to: int = 8,
+    n_sim: int = 256,
+    seed: int = 0,
+) -> List[PadSpec]:
+    """2-4 bucket PadSpecs sized from the dataset's *batch-sum* distribution.
+
+    XLA needs static shapes, so a batch of variable-size graphs is padded to a
+    bucket; one worst-case bucket wastes most of the MXU work on skewed
+    datasets.  We simulate shuffled batches to estimate the distribution of
+    per-batch total nodes/edges (sums concentrate near batch_size*mean, far
+    below batch_size*max), then place bucket capacities at evenly spaced
+    quantiles with the top bucket = exact worst case, so every batch fits
+    somewhere.  Compile count is bounded by ``n_buckets``.
+    """
+    nodes = np.fromiter((s.num_nodes for s in samples), np.int64,
+                        count=len(samples))
+    edges = np.fromiter((s.num_edges for s in samples), np.int64,
+                        count=len(samples))
+    return bucket_pad_specs_from_sizes(
+        nodes, edges, batch_size, n_buckets, round_to, n_sim, seed)
 
 
 def create_dataloaders(

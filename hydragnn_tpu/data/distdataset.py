@@ -41,13 +41,16 @@ class DistDataset(AbstractBaseDataset):
         self.rank = process_index()
         self.world_size = num_processes()
 
-        local = list(dataset)
-        blobs = [pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
-                 for s in local]
+        # pickle while iterating: a lazy/mmap-backed dataset (GpackDataset)
+        # is decoded one sample at a time and never retained whole
+        blobs: List[bytes] = []
+        for s in dataset:
+            blobs.append(pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL))
+        n_local = len(blobs)
         sizes = np.asarray([len(b) for b in blobs], np.int64)
 
         # global index layout: rank shards are contiguous in rank order
-        counts = host_allgather(np.asarray([len(local)], np.int64)).reshape(-1)
+        counts = host_allgather(np.asarray([n_local], np.int64)).reshape(-1)
         self.counts = [int(c) for c in counts]
         self.total = int(sum(self.counts))
         self.global_start = int(sum(self.counts[: self.rank]))
@@ -60,7 +63,7 @@ class DistDataset(AbstractBaseDataset):
         self.lib.dstore_add(
             self.store, _KEY, packed,
             sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            len(local), self.global_start)
+            n_local, self.global_start)
 
         # exchange (ip, port) of every host's server
         ip = _local_ip()

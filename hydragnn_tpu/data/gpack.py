@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import glob
 import json
+import mmap
 import os
 import struct
 from typing import Any, Dict, List, Optional, Sequence
@@ -156,6 +157,19 @@ class _NativePart:
         arr.flags.writeable = False
         return arr
 
+    def dims_table(self, name: str) -> Optional[np.ndarray]:
+        """Per-sample shape table ``[n, ndim]`` int64 — header metadata
+        only, no sample bodies are touched."""
+        if name not in self.keys:
+            return None
+        k, _dtype, ndim = self.keys[name]
+        out = np.zeros((self.n, ndim), np.int64)
+        dims = (ctypes.c_int64 * ndim)()
+        for i in range(self.n):
+            self.lib.gpack_sample_dims(self.h, k, i, dims)
+            out[i] = [dims[d] for d in range(ndim)]
+        return out
+
     def close(self):
         if self.h:
             self.lib.gpack_close(self.h)
@@ -163,34 +177,55 @@ class _NativePart:
 
 
 class _NumpyPart:
-    """Pure-python fallback reader (same format)."""
+    """Pure-python fallback reader (same format), mmap-backed.
+
+    The body is never slurped: the part file is mapped read-only, so the
+    views :meth:`get` returns are zero-copy pages straight out of the page
+    cache — same residency model as the native reader.  The tiny per-key
+    dims/offset tables are copied out of the map (they must not pin it),
+    and :meth:`close` actually drops the mapping (tolerating live sample
+    views, which keep their pages alive until they die).
+    """
 
     def __init__(self, path: str):
-        with open(path, "rb") as f:
-            raw = f.read()
-        assert raw[:8] == _MAGIC, f"bad magic in {path}"
-        off = 8
-        n_keys, n, attr_len = struct.unpack_from("<QQQ", raw, off)
-        off += 24
-        self.attrs = json.loads(raw[off : off + attr_len].decode())
-        off += attr_len
-        self.n = n
+        self._f = open(path, "rb")
+        try:
+            self._mm = mmap.mmap(self._f.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        except Exception:
+            self._f.close()
+            raise
+        raw = self._raw = self._mm
         self.keys = {}
-        self._raw = raw
-        for _ in range(n_keys):
-            (name_len,) = struct.unpack_from("<I", raw, off)
-            off += 4
-            name = raw[off : off + name_len].decode()
-            off += name_len
-            code, ndim = struct.unpack_from("<II", raw, off)
-            off += 8
-            data_off, data_nbytes = struct.unpack_from("<QQ", raw, off)
-            off += 16
-            dims = np.frombuffer(raw, np.int64, n * ndim, off).reshape(n, ndim)
-            off += dims.nbytes
-            offsets = np.frombuffer(raw, np.int64, n, off)
-            off += offsets.nbytes
-            self.keys[name] = (_DTYPES[code], ndim, data_off, dims, offsets)
+        try:
+            assert raw[:8] == _MAGIC, f"bad magic in {path}"
+            off = 8
+            n_keys, n, attr_len = struct.unpack_from("<QQQ", raw, off)
+            off += 24
+            self.attrs = json.loads(raw[off : off + attr_len].decode())
+            off += attr_len
+            self.n = n
+            for _ in range(n_keys):
+                (name_len,) = struct.unpack_from("<I", raw, off)
+                off += 4
+                name = raw[off : off + name_len].decode()
+                off += name_len
+                code, ndim = struct.unpack_from("<II", raw, off)
+                off += 8
+                data_off, data_nbytes = struct.unpack_from("<QQ", raw, off)
+                off += 16
+                # .copy(): index tables are tiny and must not hold a
+                # buffer export that would make close() impossible
+                dims = np.frombuffer(
+                    raw, np.int64, n * ndim, off).reshape(n, ndim).copy()
+                off += dims.nbytes
+                offsets = np.frombuffer(raw, np.int64, n, off).copy()
+                off += offsets.nbytes
+                self.keys[name] = (_DTYPES[code], ndim, data_off, dims,
+                                   offsets)
+        except Exception:
+            self.close()
+            raise
 
     def get(self, name: str, i: int) -> Optional[np.ndarray]:
         if name not in self.keys:
@@ -201,28 +236,50 @@ class _NumpyPart:
         start = data_off + int(offsets[i]) * np.dtype(dtype).itemsize
         return np.frombuffer(self._raw, dtype, count, start).reshape(shape)
 
+    def dims_table(self, name: str) -> Optional[np.ndarray]:
+        """Per-sample shape table ``[n, ndim]`` int64 — header-only."""
+        if name not in self.keys:
+            return None
+        return self.keys[name][3]
+
     def close(self):
-        pass
+        mm, self._mm = self._mm, None
+        self._raw = None
+        if mm is not None:
+            try:
+                mm.close()
+            except BufferError:
+                # live sample views still export the buffer; the mapping
+                # is released when the last of them is collected
+                pass
+        f, self._f = self._f, None
+        if f is not None:
+            f.close()
 
 
 class GpackDataset(AbstractBaseDataset):
     """Read one or many gpack part-files as a single dataset of GraphSamples.
 
     ``path`` may be a single file, a ``<base>`` whose parts are
-    ``<base>.p<rank>``, or a glob.  ``subset`` restricts to global indices
-    (parity with AdiosDataset.setsubset, adiosdataset.py:558-584).
+    ``<base>.p<rank>``, a glob, or an explicit list of part files (the
+    ingestion manifest hands the validated segment list in directly).
+    ``subset`` restricts to global indices (parity with
+    AdiosDataset.setsubset, adiosdataset.py:558-584).
     """
 
-    def __init__(self, path: str, preload: bool = False,
+    def __init__(self, path, preload: bool = False,
                  subset: Optional[Sequence[int]] = None,
                  use_native: bool = True):
         super().__init__()
-        if os.path.exists(path):
+        if isinstance(path, (list, tuple)):
+            files = [str(p) for p in path]
+        elif os.path.exists(path):
             files = [path]
         else:
             files = sorted(glob.glob(path + ".p*")) or sorted(glob.glob(path))
         if not files:
             raise FileNotFoundError(f"no gpack parts for {path}")
+        self.files = list(files)
         self.parts = []
         for f in files:
             if use_native:
@@ -273,6 +330,41 @@ class GpackDataset(AbstractBaseDataset):
     def setsubset(self, start: int, end: int, preload: bool = False) -> None:
         self.indices = list(range(start, end))
         self._cache = [self._read(i) for i in self.indices] if preload else None
+
+    def sizes(self) -> "tuple[np.ndarray, np.ndarray]":
+        """``(num_nodes, num_edges)`` int64 arrays per dataset position,
+        read from the part headers only — no sample body is decoded.
+        This is what lets the streaming plan/bucketing run over datasets
+        that do not fit in RAM."""
+        nodes_parts, edges_parts = [], []
+        for p in self.parts:
+            xd = p.dims_table("x")
+            if xd is None:
+                raise ValueError("gpack store has no 'x' key")
+            nodes_parts.append(xd[:, 0])
+            ed = p.dims_table("edge_index")
+            edges_parts.append(ed[:, 1] if ed is not None
+                               else np.zeros(p.n, np.int64))
+        nodes = np.concatenate(nodes_parts)
+        edges = np.concatenate(edges_parts)
+        idx = np.asarray(self.indices, np.int64)
+        return nodes[idx], edges[idx]
+
+    def sample_view(self, idx: int, key: str) -> Optional[np.ndarray]:
+        """Zero-copy mmap-backed view of one key of one sample (``None``
+        when the store lacks the key).  Read-only; do not hold views past
+        :meth:`close`."""
+        gidx = self.indices[idx]
+        part_id = int(np.searchsorted(self._bounds, gidx, side="right")) - 1
+        return self.parts[part_id].get(key, gidx - int(self._bounds[part_id]))
+
+    def extra_keys(self) -> List[str]:
+        names = set()
+        for p in self.parts:
+            for name in getattr(p, "keys", {}):
+                if name.startswith("extra:"):
+                    names.add(name.split(":", 1)[1])
+        return sorted(names)
 
     def close(self):
         for p in self.parts:

@@ -77,6 +77,16 @@ def dataset_loading_and_splitting(
     finalized config (reference load_data.py:207-223 + update_config; config
     completion is explicit here instead of mutating after loader creation)."""
     from hydragnn_tpu.config.config import DatasetStats, finalize
+    from hydragnn_tpu.data.stream.config import StreamConfig
+
+    stream_cfg = StreamConfig.from_dataset(config.get("Dataset", {}))
+    if stream_cfg.enabled:
+        result = _stream_loading_and_splitting(
+            config, stream_cfg, rank=rank, world_size=world_size, seed=seed)
+        if result is not None:
+            return result
+        # fallback reason recorded via note_fallback; the trainer emits the
+        # stream_fallback health event once telemetry exists
 
     if rank == 0:
         transform_raw_data_to_serialized(config)
@@ -168,6 +178,117 @@ def dataset_loading_and_splitting(
         testset,
         batch_size,
         head_specs,
+        graph_feature_slices=gslices,
+        node_feature_slices=nslices,
+        rank=rank,
+        world_size=world_size,
+        seed=seed,
+        post_collate=post_collate,
+    )
+    return train_l, val_l, test_l, config
+
+
+def _stream_loading_and_splitting(
+    config: Dict[str, Any],
+    stream_cfg,
+    rank: int = 0,
+    world_size: int = 1,
+    seed: int = 0,
+):
+    """Streamed variant of the in-memory flow above: stats from gpack
+    headers, splits as index ranges, loaders that decode a bounded window.
+    Returns None (after ``note_fallback``) when streaming cannot serve this
+    configuration — the caller falls through to the in-memory path."""
+    import warnings
+
+    from hydragnn_tpu.config.config import finalize, normalize_output_config
+    from hydragnn_tpu.data.gpack import GpackDataset
+    from hydragnn_tpu.data.stream.config import note_fallback
+    from hydragnn_tpu.data.stream.ingest import open_tail_store
+    from hydragnn_tpu.data.stream.loader import (
+        create_stream_dataloaders,
+        max_triplets_from_store,
+        split_stream_indices,
+        stats_from_store,
+    )
+    import numpy as np
+
+    ds = config.get("Dataset", {})
+    if ds.get("compositional_stratified_splitting", False):
+        warnings.warn(
+            "compositional stratified splitting needs every sample's "
+            "features in memory; streaming disabled for this run",
+            stacklevel=2)
+        note_fallback("stratified splitting unsupported under streaming")
+        return None
+    try:
+        if stream_cfg.tail:
+            store = open_tail_store(stream_cfg.tail)
+            if store is None:
+                raise FileNotFoundError(
+                    f"no readable ingest segments under {stream_cfg.tail}")
+        else:
+            store = GpackDataset(stream_cfg.path)
+    except Exception as e:  # graftlint: disable=ROB001 (loud fallback: warned + note_fallback -> stream_fallback health event)
+        warnings.warn(
+            f"streaming store open failed ({e}); falling back to the "
+            f"in-memory data path", stacklevel=2)
+        note_fallback(f"store open failed: {e}")
+        return None
+    n = len(store)
+    if n == 0:
+        note_fallback("store is empty")
+        return None
+
+    perc_train = config["NeuralNetwork"]["Training"]["perc_train"]
+    if stream_cfg.tail:
+        # online mode has no held-out split: everything sealed so far
+        # trains (the tail loader re-reads the manifest each epoch), and
+        # val/test monitor a fixed early prefix for trend comparison
+        n_eval = max(1, n // 10)
+        splits = (np.arange(n, dtype=np.int64),
+                  np.arange(n_eval, dtype=np.int64),
+                  np.arange(n_eval, dtype=np.int64))
+    else:
+        splits = split_stream_indices(n, perc_train)
+
+    # serving provenance recorded at ingest time travels with the store
+    for key in ("edge_length_norm", "edge_build_max_neighbours"):
+        if store.attrs.get(key):
+            config.setdefault("Serving", {})[key] = store.attrs[key]
+
+    need_deg = config["NeuralNetwork"]["Architecture"]["model_type"] == "PNA"
+    stats = stats_from_store(store, need_deg=need_deg)
+    if world_size > 1:
+        stats = _reduce_stats_across_hosts(stats)
+    config = finalize(config, stats)
+    config = normalize_output_config(config)
+
+    head_specs = head_specs_from_config(config)
+    gslices, nslices = label_slices_from_config(config)
+    batch_size = int(config["NeuralNetwork"]["Training"]["batch_size"])
+    import jax
+
+    n_local = len(jax.local_devices())
+    if n_local > 1:
+        batch_size = max(1, -(-batch_size // n_local))
+
+    post_collate = None
+    if config["NeuralNetwork"]["Architecture"]["model_type"] == "DimeNet":
+        from hydragnn_tpu.models.dimenet import DnTriGate, add_dimenet_extras
+
+        max_per_sample = max_triplets_from_store(store)
+        max_triplets = -(-(batch_size * max_per_sample + 1) // 8) * 8
+        tri_gate = DnTriGate(max_edges_per_graph=stats.max_edges)
+        post_collate = lambda b: add_dimenet_extras(
+            b, max_triplets, tri_gate=tri_gate)
+
+    train_l, val_l, test_l = create_stream_dataloaders(
+        store,
+        splits,
+        batch_size,
+        head_specs,
+        stream_cfg,
         graph_feature_slices=gslices,
         node_feature_slices=nslices,
         rank=rank,

@@ -10,6 +10,25 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from hydragnn_tpu.data.abstract import AbstractBaseDataset
+
+
+class IndexedSubset(AbstractBaseDataset):
+    """Index-based view over a dataset — nothing is materialized, so
+    splitting a lazy/mmap-backed store (GpackDataset) stays O(indices)
+    in memory, not O(decoded samples)."""
+
+    def __init__(self, base, indices):
+        super().__init__()
+        self.base = base
+        self.indices = np.asarray(indices, np.int64)
+
+    def len(self) -> int:
+        return len(self.indices)
+
+    def get(self, idx: int):
+        return self.base[int(self.indices[idx])]
+
 
 def composition_category(x_col0: np.ndarray) -> Tuple:
     """Category key = sorted (element, count) signature of the structure
@@ -75,11 +94,19 @@ def split_dataset(
         perc_val = (1 - perc_train) / 2
         n_train = int(perc_train * n)
         n_val = int(perc_val * n)
-        data = list(dataset)
+        if isinstance(dataset, (list, tuple)):
+            data = list(dataset)
+            return (
+                data[:n_train],
+                data[n_train : n_train + n_val],
+                data[n_train + n_val :],
+            )
+        # lazy/mmap-backed dataset (AbstractBaseDataset etc.): hand out
+        # index views — splitting must not decode the whole store
         return (
-            data[:n_train],
-            data[n_train : n_train + n_val],
-            data[n_train + n_val :],
+            IndexedSubset(dataset, range(0, n_train)),
+            IndexedSubset(dataset, range(n_train, n_train + n_val)),
+            IndexedSubset(dataset, range(n_train + n_val, n)),
         )
     return compositional_stratified_splitting(dataset, perc_train, seed)
 

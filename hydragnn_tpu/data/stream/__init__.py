@@ -1,0 +1,40 @@
+"""Streaming data plane: bounded-memory epoch streams over the gpack store.
+
+The in-memory pipeline (GraphDataLoader over a list of decoded samples)
+holds the whole dataset resident; this subsystem replaces the *sample
+storage* layer while keeping every downstream contract intact — the
+prefetch/collate wrappers, the device pipeline, and the resume bundle all
+see the same duck-typed loader protocol.  Pieces:
+
+- :mod:`plan`    — StreamPlan: deterministic seeded per-host assignment of
+                   store rows, epoch-replayable given (seed, epoch, host).
+- :mod:`loader`  — StreamingGraphLoader + windowed epoch iterator: only
+                   ~W decoded samples resident, seeded replay, skip-first-N
+                   fast-forward for mid-epoch resume bit-parity.
+- :mod:`ingest`  — IngestWriter: sealed gpack segments + atomic manifest;
+                   tail-mode refresh so training can consume a growing set.
+- :mod:`halo`    — disk-backed feed for the PR-10 sharded giant-graph
+                   path: local+halo rows read straight from the store.
+- :mod:`config`  — StreamConfig: Dataset.stream_* keys + HYDRAGNN_STREAM_*
+                   env overrides (registered in analysis/registry.py).
+
+docs/DATA.md is the subsystem's narrative: format, plan/window semantics,
+the RAM model, and the ingestion runbook.
+"""
+
+from hydragnn_tpu.data.stream.config import (  # noqa: F401
+    StreamConfig,
+    stream_dataset_defaults,
+)
+from hydragnn_tpu.data.stream.plan import StreamPlan  # noqa: F401
+from hydragnn_tpu.data.stream.loader import (  # noqa: F401
+    StreamingGraphLoader,
+    find_stream_loader,
+    stats_from_store,
+    try_fast_forward,
+)
+from hydragnn_tpu.data.stream.ingest import (  # noqa: F401
+    IngestWriter,
+    ingest_jsonl,
+    read_manifest,
+)

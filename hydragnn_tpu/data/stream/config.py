@@ -1,0 +1,120 @@
+"""Streaming data-plane knobs (``Dataset`` section + HYDRAGNN_STREAM_* env).
+
+Same contract as the graph-shard knobs (graph/partition.py): config file
+value first, env override only when the env var is set AND non-empty,
+range/vocabulary validation raises, and config.finalize writes the
+defaults back into the ``Dataset`` section so a saved config.json
+documents the run's streaming settings.  Every env name here is
+registered in analysis/registry.py (graftlint REG001/REG002).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from hydragnn_tpu.data.stream.plan import STREAM_ORDERS
+from hydragnn_tpu.utils.env import env_int, env_str
+
+
+def check_stream_flag(value: Any) -> bool:
+    """Normalize a ``stream`` knob value; accepts the repo's flag
+    spellings (unset/empty/"0"/"off"/False -> off)."""
+    if value in (None, False, 0, "", "0", "off", "false", "False"):
+        return False
+    if value in (True, 1, "1", "on", "true", "True"):
+        return True
+    raise ValueError(f"Dataset.stream must be a flag, got {value!r}")
+
+
+def check_stream_order(value: Any) -> str:
+    v = str(value or "global")
+    if v not in STREAM_ORDERS:
+        raise ValueError(
+            f"Dataset.stream_order must be one of {STREAM_ORDERS}, "
+            f"got {value!r}")
+    return v
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    """Parsed streaming knobs (``Dataset`` section + env, env wins).
+
+    Env knobs: HYDRAGNN_STREAM, HYDRAGNN_STREAM_PATH,
+    HYDRAGNN_STREAM_WINDOW, HYDRAGNN_STREAM_ORDER, HYDRAGNN_STREAM_BLOCK,
+    HYDRAGNN_STREAM_TAIL.
+    """
+
+    enabled: bool = False   # stream the gpack store instead of decoding all
+    path: str = ""          # gpack base path (file, <base>.p*, or glob)
+    window: int = 1024      # max decoded samples resident per iterator
+    order: str = "global"   # global | sequential | block (plan.py)
+    block: int = 2048       # block size for order=block
+    tail: str = ""          # ingest dir to tail (grows between epochs)
+
+    @classmethod
+    def from_dataset(cls, dataset: Optional[Dict[str, Any]]
+                     ) -> "StreamConfig":
+        s = dict(dataset or {})
+        d = cls()
+        cfg = cls(
+            enabled=check_stream_flag(s.get("stream", d.enabled)),
+            path=str(s.get("stream_path", d.path) or ""),
+            window=int(s.get("stream_window", d.window)),
+            order=check_stream_order(s.get("stream_order", d.order)),
+            block=int(s.get("stream_block", d.block)),
+            tail=str(s.get("stream_tail", d.tail) or ""),
+        )
+        # set-but-EMPTY env falls through to the config value (the repo's
+        # env-knob convention, utils/env.py)
+        if os.environ.get("HYDRAGNN_STREAM"):
+            cfg.enabled = check_stream_flag(os.environ["HYDRAGNN_STREAM"])
+        if os.environ.get("HYDRAGNN_STREAM_PATH"):
+            cfg.path = env_str("HYDRAGNN_STREAM_PATH", d.path)
+        if os.environ.get("HYDRAGNN_STREAM_WINDOW"):
+            cfg.window = env_int("HYDRAGNN_STREAM_WINDOW", d.window)
+        if os.environ.get("HYDRAGNN_STREAM_ORDER"):
+            cfg.order = check_stream_order(
+                env_str("HYDRAGNN_STREAM_ORDER", d.order))
+        if os.environ.get("HYDRAGNN_STREAM_BLOCK"):
+            cfg.block = env_int("HYDRAGNN_STREAM_BLOCK", d.block)
+        if os.environ.get("HYDRAGNN_STREAM_TAIL"):
+            cfg.tail = env_str("HYDRAGNN_STREAM_TAIL", d.tail)
+        if cfg.window < 1:
+            raise ValueError(
+                f"Dataset.stream_window must be >= 1, got {cfg.window}")
+        if cfg.block < 1:
+            raise ValueError(
+                f"Dataset.stream_block must be >= 1, got {cfg.block}")
+        if cfg.tail:
+            cfg.enabled = True  # a tailed ingest dir only makes sense live
+        return cfg
+
+
+def stream_dataset_defaults() -> Dict[str, Any]:
+    """``Dataset``-section defaults written back by config.finalize."""
+    d = StreamConfig()
+    return {
+        "stream": d.enabled,
+        "stream_path": d.path,
+        "stream_window": d.window,
+        "stream_order": d.order,
+        "stream_block": d.block,
+        "stream_tail": d.tail,
+    }
+
+
+# -- fallback handoff ------------------------------------------------------
+# load_data runs before the MetricsLogger exists, so when the stream path
+# is requested but unusable it records the reason here; the trainer pops it
+# and emits the `stream_fallback` health event (REG004's emission site).
+_FALLBACK: Dict[str, str] = {}
+
+
+def note_fallback(reason: str) -> None:
+    _FALLBACK["reason"] = str(reason)
+
+
+def pop_fallback() -> Optional[str]:
+    return _FALLBACK.pop("reason", None)

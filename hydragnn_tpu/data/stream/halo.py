@@ -1,0 +1,226 @@
+"""Disk-backed halo feed: gpack store -> per-shard HaloBatch, no padding.
+
+The in-memory giant-graph path collates each sample into a padded
+GraphBatch, then ``apply_plan`` gathers per-shard rows out of it.  But
+``build_shard_plan``/``apply_plan`` only ever touch REAL rows (the plan's
+id arrays are -1 or < n_real, and ``_gather_rows`` maps -1 to fill), so
+an UNPADDED batch built from zero-copy store views produces a
+bit-identical :class:`HaloBatch` — with the crucial difference that the
+only materialized host arrays are the per-shard gathers (local + halo
+rows), never a padded copy of the whole graph.  That is what lets
+giant-graph training scale past host RAM, not just past HBM.
+
+Bit-parity notes (tests/test_stream.py asserts this against the
+in-memory ``ShardedGraphLoader``):
+
+- the pad ``G`` (``num_graphs``) must match the in-memory PadSpec, since
+  the plan pads graph ids with ``G - 1`` and replicates ``[G]`` arrays;
+- labels/extras replicate collate's packing (f32 casts, per-head column
+  slices) on unpadded views — gather∘cast ≡ cast∘gather elementwise;
+- only ``batch_size == 1`` matches (one sample per HaloBatch); the
+  trainer falls back to composition (ShardedGraphLoader over the
+  streaming loader) for larger batch sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.graph.batch import (
+    GraphBatch,
+    HeadSpec,
+    default_label_slices,
+)
+from hydragnn_tpu.graph.partition import (
+    GraphShardConfig,
+    ShardPlan,
+    apply_plan,
+    build_shard_plan,
+)
+from hydragnn_tpu.telemetry import pipeline as tele_pipe
+
+
+class GpackShardedLoader:
+    """Yield one :class:`HaloBatch` per store sample, reading local+halo
+    rows straight from the mmap-backed store via the shard plan.
+
+    Duck-types the surface the trainer uses on ``ShardedGraphLoader``:
+    ``set_epoch`` / ``__len__`` / ``__iter__`` / ``peek_stats()`` /
+    ``.stats``.  Plans are cached per store position (topology is
+    immutable on disk), bounded like the in-memory plan cache.
+    """
+
+    def __init__(
+        self,
+        store,
+        indices: Sequence[int],
+        n_shards: int,
+        cfg: GraphShardConfig,
+        hops: int,
+        head_specs: Sequence[HeadSpec],
+        graph_feature_slices: Optional[Sequence[Tuple[int, int]]] = None,
+        node_feature_slices: Optional[Sequence[Tuple[int, int]]] = None,
+        num_graphs: int = 2,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        self.store = store
+        self.indices = np.asarray(indices, np.int64)
+        self.n_shards = n_shards
+        self.cfg = cfg
+        self.hops = hops if cfg.hops == 0 else cfg.hops
+        self.head_specs = list(head_specs)
+        self.head_types = [h.type for h in self.head_specs]
+        if graph_feature_slices is None and node_feature_slices is None:
+            graph_feature_slices, node_feature_slices = \
+                default_label_slices(self.head_specs)
+        self.graph_feature_slices = graph_feature_slices
+        self.node_feature_slices = node_feature_slices
+        self.num_graphs = int(num_graphs)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self._plans: Dict[int, ShardPlan] = {}
+        self.stats: Dict[str, Any] = {}
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def _order(self) -> np.ndarray:
+        n = len(self.indices)
+        if self.shuffle:
+            return np.random.RandomState(
+                self.seed + self.epoch).permutation(n)
+        return np.arange(n)
+
+    # -- unpadded batch from store views ----------------------------------
+    def _batch_for(self, store_pos: int) -> GraphBatch:
+        view = lambda k: self.store.sample_view(int(store_pos), k)
+        x = view("x")
+        if x.ndim == 1:
+            x = x[:, None]
+        x = np.asarray(x, np.float32)
+        pos = np.asarray(view("pos"), np.float32)
+        n = x.shape[0]
+        ei = view("edge_index")
+        e = int(ei.shape[1]) if ei is not None else 0
+        senders = (ei[0].astype(np.int32) if e
+                   else np.zeros(0, np.int32))
+        receivers = (ei[1].astype(np.int32) if e
+                     else np.zeros(0, np.int32))
+        ea = view("edge_attr")
+        edge_attr = None if ea is None else np.asarray(ea, np.float32)
+        G = self.num_graphs
+        graph_mask = np.zeros(G, np.float32)
+        graph_mask[0] = 1.0
+        # labels: collate's per-head packing on unpadded rows (apply_plan
+        # gathers real rows only, so the pad tail is never consulted)
+        gy, ny = view("graph_y"), view("node_y")
+        labels: List[np.ndarray] = []
+        for i, h in enumerate(self.head_specs):
+            if h.type == "graph":
+                lab = np.zeros((G, h.dim), np.float32)
+                lo, hi = self.graph_feature_slices[i]
+                if gy is not None:
+                    lab[0] = np.asarray(gy, np.float32).reshape(-1)[lo:hi]
+            else:
+                lab = np.zeros((n, h.dim), np.float32)
+                lo, hi = self.node_feature_slices[i]
+                if ny is not None:
+                    lab[:] = np.asarray(ny[:, lo:hi], np.float32)
+            labels.append(lab)
+        c = view("cell")
+        cell = None
+        if c is not None:
+            cell = np.zeros((G, 3, 3), np.float32)
+            cell[0] = c
+        extras: Dict[str, np.ndarray] = {}
+        for name in self.store.extra_keys():
+            v = view(f"extra:{name}")
+            if v is None:
+                continue
+            v32 = np.asarray(v, np.float32)
+            if v32.shape and v32.shape[0] == n:
+                extras[name] = v32  # per-node (unpadded)
+            else:
+                arr = np.zeros((G,) + v32.shape, np.float32)
+                arr[0] = v32
+                extras[name] = arr
+        if tele_pipe.enabled():
+            tele_pipe.add("stream_read_samples", 1)
+            tele_pipe.add(
+                "stream_read_bytes",
+                int(x.nbytes + pos.nbytes
+                    + (0 if ei is None else ei.nbytes)
+                    + (0 if edge_attr is None else edge_attr.nbytes)))
+        return GraphBatch(
+            x=x,
+            pos=pos,
+            senders=senders,
+            receivers=receivers,
+            edge_attr=edge_attr,
+            node_gid=np.zeros(n, np.int32),
+            node_mask=np.ones(n, np.float32),
+            edge_mask=np.ones(e, np.float32),
+            graph_mask=graph_mask,
+            labels=tuple(labels),
+            cell=cell,
+            extras=extras,
+        )
+
+    def _plan_for(self, store_pos: int, batch: GraphBatch) -> ShardPlan:
+        plan = self._plans.get(store_pos)
+        if plan is None:
+            plan = build_shard_plan(
+                batch, self.n_shards, method=self.cfg.method,
+                hops=self.hops, halo_max=self.cfg.halo_max)
+            if len(self._plans) >= 64:  # bound host memory on huge stores
+                self._plans.clear()
+            self._plans[store_pos] = plan
+            self.stats = dict(plan.stats)
+        return plan
+
+    def peek_stats(self) -> Dict[str, Any]:
+        """Partition stats of the first sample (builds + caches its plan)."""
+        if not self.stats and len(self.indices):
+            pos = int(self.indices[0])
+            self._plan_for(pos, self._batch_for(pos))
+        return self.stats
+
+    def __iter__(self):
+        for i in self._order():
+            pos = int(self.indices[int(i)])
+            batch = self._batch_for(pos)
+            yield apply_plan(batch, self._plan_for(pos, batch),
+                             self.head_types)
+
+
+def sharded_from_stream(loader, n_shards: int, cfg: GraphShardConfig,
+                        hops: int) -> Optional[GpackShardedLoader]:
+    """Build the gpack-backed sharded loader from a (possibly wrapped)
+    streaming loader chain, or None when the chain doesn't qualify —
+    caller then composes ShardedGraphLoader over the stream instead.
+    Only ``batch_size == 1`` maps one store sample to one HaloBatch."""
+    from hydragnn_tpu.data.stream.loader import find_stream_loader
+
+    base = find_stream_loader(loader)
+    if base is None or base.batch_size != 1 or base.world_size != 1:
+        return None
+    return GpackShardedLoader(
+        base.store,
+        base.indices,
+        n_shards,
+        cfg,
+        hops,
+        base.head_specs,
+        graph_feature_slices=base.graph_feature_slices,
+        node_feature_slices=base.node_feature_slices,
+        num_graphs=base.pad_spec.num_graphs,
+        shuffle=base.shuffle,
+        seed=base.seed,
+    )

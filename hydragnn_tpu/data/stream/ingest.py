@@ -1,0 +1,212 @@
+"""Online ingestion: append-only gpack segments behind an atomic manifest.
+
+Write side (:class:`IngestWriter`): samples accumulate host-side and are
+sealed into immutable gpack segment files (``segment-%06d.gpack``) of
+``seal_every`` samples; after each seal the manifest is rewritten
+atomically (temp + fsync + rename, resilience/ckpt_io.py).  The manifest
+is the ONLY source of truth — a segment file not yet listed does not
+exist as far as readers are concerned, so writer crashes can never tear
+the dataset, only lose the unsealed remainder.
+
+Read side (:func:`read_manifest` / :func:`open_tail_store`): each listed
+segment is validated against its recorded byte size; torn or missing
+segments are skipped loudly (``stream_torn_segment`` health event when a
+telemetry logger is attached).  ``open_tail_store`` turns the currently
+valid segment list into a normal :class:`GpackDataset`, which is what the
+train loader's tail mode re-opens between epochs to pick up growth.
+
+:func:`ingest_jsonl` converts a served-request capture (JSONL records in
+the serve/server.py ``sample_from_json`` schema: ``x``, ``pos``, optional
+``edge_index``/``edge_attr``/``graph_y``/``node_y``) into segments — the
+serve -> collect -> train loop's missing input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.data.gpack import GpackDataset, GpackWriter
+from hydragnn_tpu.graph.batch import GraphSample
+from hydragnn_tpu.resilience.ckpt_io import atomic_write_json
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = "hydragnn-ingest-v1"
+
+
+class IngestWriter:
+    """Append samples; seal immutable gpack segments + atomic manifest.
+
+    Safe against writer crashes at any point: segments are written to a
+    dotted temp name, renamed into place, and only then listed in the
+    atomically-replaced manifest.  Re-opening an existing ingest dir
+    resumes after the last sealed segment.
+    """
+
+    def __init__(self, out_dir: str, seal_every: int = 512,
+                 attrs: Optional[Dict[str, Any]] = None, telemetry=None):
+        if seal_every < 1:
+            raise ValueError(f"seal_every must be >= 1, got {seal_every}")
+        self.out_dir = out_dir
+        self.seal_every = int(seal_every)
+        self.attrs = attrs or {}
+        self.telemetry = telemetry
+        os.makedirs(out_dir, exist_ok=True)
+        self._segments: List[Dict[str, Any]] = read_manifest(
+            out_dir, telemetry=telemetry)
+        self._pending: List[GraphSample] = []
+
+    @property
+    def n_sealed(self) -> int:
+        return sum(int(s["n"]) for s in self._segments)
+
+    def add(self, sample: GraphSample) -> None:
+        self._pending.append(sample)
+        if len(self._pending) >= self.seal_every:
+            self.seal()
+
+    def seal(self) -> Optional[str]:
+        """Flush pending samples into one sealed segment; returns the
+        segment file name (None when nothing is pending)."""
+        if not self._pending:
+            return None
+        seg_id = len(self._segments)
+        fname = f"segment-{seg_id:06d}.gpack"
+        final = os.path.join(self.out_dir, fname)
+        tmp_base = os.path.join(self.out_dir, f".{fname}.tmp")
+        # GpackWriter appends ".p0" to a plain path; take the path it
+        # actually wrote and rename THAT into place
+        written = GpackWriter(tmp_base, attrs=self.attrs).save(self._pending)
+        fd = os.open(written, os.O_RDONLY)
+        try:
+            os.fsync(fd)  # durable before the manifest can reference it
+        finally:
+            os.close(fd)
+        os.replace(written, final)
+        self._segments.append({
+            "file": fname,
+            "n": len(self._pending),
+            "bytes": int(os.path.getsize(final)),
+        })
+        self._pending = []
+        self._write_manifest()
+        return fname
+
+    def _write_manifest(self) -> None:
+        atomic_write_json(
+            os.path.join(self.out_dir, MANIFEST_NAME),
+            {"format": MANIFEST_FORMAT, "segments": self._segments},
+        )
+
+    def close(self) -> None:
+        self.seal()
+
+
+def read_manifest(out_dir: str, telemetry=None) -> List[Dict[str, Any]]:
+    """Validated segment list of an ingest dir ([] when no manifest yet).
+
+    Every listed segment must exist with exactly its recorded byte size;
+    violations are skipped with a loud warning (and a
+    ``stream_torn_segment`` health event when ``telemetry`` is given) —
+    a torn segment must never reach training as silent garbage.
+    """
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        manifest = json.load(f)
+    if manifest.get("format") != MANIFEST_FORMAT:
+        raise ValueError(
+            f"{path}: unknown ingest manifest format "
+            f"{manifest.get('format')!r}")
+    valid: List[Dict[str, Any]] = []
+    for seg in manifest.get("segments", []):
+        fpath = os.path.join(out_dir, str(seg.get("file", "")))
+        want = int(seg.get("bytes", -1))
+        have = os.path.getsize(fpath) if os.path.exists(fpath) else -2
+        if have != want:
+            warnings.warn(
+                f"ingest segment {fpath} torn or missing "
+                f"(bytes {have} != manifest {want}); skipping it",
+                stacklevel=2)
+            if telemetry is not None:
+                telemetry.health("stream_torn_segment", file=str(fpath),
+                                 bytes_found=int(have),
+                                 bytes_manifest=int(want))
+            continue
+        valid.append(dict(seg))
+    return valid
+
+
+def open_tail_store(out_dir: str, telemetry=None,
+                    use_native: bool = True) -> Optional[GpackDataset]:
+    """Open the currently valid segment list as one GpackDataset (None when
+    the manifest lists no readable segments yet)."""
+    segs = read_manifest(out_dir, telemetry=telemetry)
+    if not segs:
+        return None
+    files = [os.path.join(out_dir, s["file"]) for s in segs]
+    return GpackDataset(files, use_native=use_native)
+
+
+# ---------------------------------------------------------------------------
+# JSONL request-capture conversion
+# ---------------------------------------------------------------------------
+
+_OPTIONAL_KEYS = ("edge_attr", "graph_y", "node_y", "cell")
+
+
+def _record_to_sample(rec: Dict[str, Any]) -> GraphSample:
+    if "x" not in rec and isinstance(rec.get("request"), dict):
+        rec = rec["request"]  # telemetry capture wraps the request body
+    x = np.asarray(rec["x"], np.float32)
+    pos = np.asarray(rec["pos"], np.float32)
+    ei = rec.get("edge_index")
+    edge_index = (np.asarray(ei, np.int64).reshape(2, -1) if ei is not None
+                  else np.zeros((2, 0), np.int64))
+    kwargs: Dict[str, Any] = {}
+    for k in _OPTIONAL_KEYS:
+        if rec.get(k) is not None:
+            kwargs[k] = np.asarray(rec[k], np.float32)
+    return GraphSample(x=x, pos=pos, edge_index=edge_index, **kwargs)
+
+
+def ingest_jsonl(jsonl_path: str, out_dir: str, seal_every: int = 512,
+                 attrs: Optional[Dict[str, Any]] = None,
+                 telemetry=None) -> int:
+    """Convert a JSONL request capture into sealed ingest segments.
+
+    Tolerant: malformed lines are skipped with a warning.  A gpack segment
+    requires every sample to carry the same key set, so optional keys
+    (edge_attr, labels, cell) are kept only when EVERY parsed record has
+    them.  Returns the number of ingested samples.
+    """
+    samples: List[GraphSample] = []
+    with open(jsonl_path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                samples.append(_record_to_sample(json.loads(line)))
+            except Exception as e:  # graftlint: disable=ROB001 (tolerant line-by-line converter; every skip is warned)
+                warnings.warn(
+                    f"{jsonl_path}:{lineno}: skipping malformed record "
+                    f"({e})", stacklevel=2)
+    if not samples:
+        return 0
+    # uniform key set per segment: drop optional keys any record lacks
+    for k in _OPTIONAL_KEYS:
+        if any(getattr(s, k) is None for s in samples):
+            for s in samples:
+                setattr(s, k, None)
+    writer = IngestWriter(out_dir, seal_every=seal_every, attrs=attrs,
+                          telemetry=telemetry)
+    for s in samples:
+        writer.add(s)
+    writer.close()
+    return len(samples)

@@ -1,0 +1,133 @@
+"""StreamPlan: deterministic, seeded per-host assignment of store rows.
+
+The plan answers one question with no I/O and no samples in hand: *which
+dataset positions does host ``rank`` visit this epoch, in what order?*
+It is a pure function of ``(n_total, seed, epoch, rank, world_size,
+mode)``, so every host computes its own share independently, an epoch can
+be replayed bit-exactly after a crash, and a resumed run can fast-forward
+by slicing the order instead of re-reading data.
+
+Ordering modes:
+
+- ``global``     — full-dataset seeded permutation; EXACTLY mirrors the
+                   in-memory ``GraphDataLoader._local_indices`` (same RNG,
+                   same wrap-pad, same rank stride), which is what makes
+                   streamed losses bit-identical to the in-memory loader.
+                   Reads are random-access; the mmap page cache absorbs it.
+- ``sequential`` — ``arange`` order (scans, benches, ingestion tails).
+- ``block``      — seeded shuffle of fixed-size blocks plus an intra-block
+                   shuffle: bounded seek span for cold/remote stores.
+                   Deterministic and replayable, but NOT order-identical
+                   to the in-memory loader (documented in docs/DATA.md).
+
+The host split (wrap-pad to a multiple of world_size, then stride
+``[rank::world_size]``) is DistributedSampler semantics, shared with the
+in-memory loader verbatim.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+STREAM_ORDERS = ("global", "sequential", "block")
+
+
+@dataclass(frozen=True)
+class StreamPlan:
+    """Per-host epoch ordering over ``n_total`` dataset positions."""
+
+    n_total: int
+    seed: int = 0
+    rank: int = 0
+    world_size: int = 1
+    shuffle: bool = True
+    mode: str = "global"
+    block: int = 2048
+
+    def __post_init__(self):
+        if self.mode not in STREAM_ORDERS:
+            raise ValueError(
+                f"stream order {self.mode!r} not in {STREAM_ORDERS}")
+        if self.block < 1:
+            raise ValueError(f"stream block must be >= 1, got {self.block}")
+        if not (0 <= self.rank < self.world_size):
+            raise ValueError(
+                f"rank {self.rank} out of range for world_size "
+                f"{self.world_size}")
+
+    # -- ordering ---------------------------------------------------------
+    def _global_order(self, epoch: int) -> np.ndarray:
+        n = self.n_total
+        if self.shuffle:
+            # bit-parity contract: identical RNG stream to the in-memory
+            # GraphDataLoader._local_indices
+            return np.random.RandomState(self.seed + epoch).permutation(n)
+        return np.arange(n)
+
+    def _block_order(self, epoch: int) -> np.ndarray:
+        n = self.n_total
+        if not self.shuffle:
+            return np.arange(n)
+        rng = np.random.RandomState(self.seed + epoch)
+        n_blocks = int(math.ceil(n / self.block)) or 1
+        parts: List[np.ndarray] = []
+        for b in rng.permutation(n_blocks):
+            seg = np.arange(b * self.block, min((b + 1) * self.block, n))
+            rng.shuffle(seg)
+            parts.append(seg)
+        return np.concatenate(parts) if parts else np.arange(0)
+
+    def epoch_order(self, epoch: int) -> np.ndarray:
+        """Positions host ``rank`` visits in epoch ``epoch``, in order."""
+        if self.mode == "sequential":
+            order = np.arange(self.n_total)
+        elif self.mode == "block":
+            order = self._block_order(epoch)
+        else:
+            order = self._global_order(epoch)
+        if self.world_size > 1:
+            # wrap-pad so every rank sees the same number of samples
+            total = int(math.ceil(self.n_total / self.world_size)) \
+                * self.world_size
+            order = np.concatenate([order, order[: total - self.n_total]])
+            order = order[self.rank :: self.world_size]
+        return order
+
+    def host_share(self) -> int:
+        """Samples per host per epoch (constant across epochs)."""
+        if self.world_size > 1:
+            return int(math.ceil(self.n_total / self.world_size))
+        return self.n_total
+
+    # -- introspection ----------------------------------------------------
+    def part_ranges(self, bounds: np.ndarray,
+                    epoch: int = 0) -> List[Tuple[int, int, int]]:
+        """Per part-file ``(part_id, first_row, last_row)`` touched by this
+        host in ``epoch`` — ``bounds`` is the store's cumulative part-size
+        array (``GpackDataset._bounds``).  Diagnostic/bench metadata; the
+        loader itself resolves rows through the store."""
+        order = self.epoch_order(epoch)
+        out: List[Tuple[int, int, int]] = []
+        if order.size == 0:
+            return out
+        part = np.searchsorted(bounds, order, side="right") - 1
+        for pid in np.unique(part):
+            rows = order[part == pid]
+            out.append((int(pid), int(rows.min()), int(rows.max())))
+        return out
+
+    def describe(self) -> Dict[str, object]:
+        return {
+            "n_total": int(self.n_total),
+            "seed": int(self.seed),
+            "rank": int(self.rank),
+            "world_size": int(self.world_size),
+            "shuffle": bool(self.shuffle),
+            "mode": self.mode,
+            "block": int(self.block),
+            "host_share": self.host_share(),
+        }

@@ -117,7 +117,8 @@ def snapshot(reset: bool = False) -> Dict[str, float]:
         if reset:
             _counters.clear()
     # derived: average queue depth per get, average bytes per batch
-    for base in ("prefetch_qdepth", "device_prefetch_qdepth"):
+    for base in ("prefetch_qdepth", "device_prefetch_qdepth",
+                 "stream_window_fill"):
         n = out.get(base + "_gets", 0.0)
         if n:
             out[base + "_avg"] = out.get(base + "_sum", 0.0) / n

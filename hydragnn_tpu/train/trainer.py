@@ -568,7 +568,11 @@ def load_state(state: TrainState, log_name: str, path: str = "./logs/") -> Train
 
 def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
                steps_per_item: int = 1, telemetry=None, guard=None,
-               preempt=None, chaos=None, skip_first: int = 0):
+               preempt=None, chaos=None, skip_first: int = 0,
+               consumed_base: int = 0):
+    # ``consumed_base`` dispatch units were already skipped INSIDE the
+    # loader (streaming fast-forward): the resume bundle's items_consumed
+    # must still count them, but the iterator never yields them here.
     # Metrics accumulate as DEVICE scalars: no float() in the batch loop, so
     # steps dispatch back-to-back with no device->host sync (the reference
     # accumulates on device and reduces at epoch end,
@@ -600,7 +604,7 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
             # skip_first was consumed by the previous run) instead of
             # burning the grace window.
             if train and preempt is not None and preempt.poll():
-                preempt.consumed = skip_first
+                preempt.consumed = consumed_base + skip_first
                 break
             continue
         if train:
@@ -636,7 +640,7 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
             if preempt.poll():
                 # stop at the batch boundary: the dispatched step's state is
                 # complete; record the step-within-epoch for the bundle
-                preempt.consumed = ibatch + 1
+                preempt.consumed = consumed_base + ibatch + 1
                 break
     return state, (None if total is None else (total, tasks, n))
 
@@ -779,6 +783,28 @@ def train_validate_test(
     resident_on = (env_flag("HYDRAGNN_RESIDENT_DATASET")
                    if "HYDRAGNN_RESIDENT_DATASET" in os.environ
                    else auto_resident)
+    # -- streaming data plane (data/stream/, docs/DATA.md) ------------------
+    # load_data could not emit health events (no MetricsLogger yet); a
+    # recorded fallback reason surfaces here, and an active stream loader
+    # forces device residency OFF — caching every collated batch on device
+    # would re-materialize the epoch the stream exists to avoid holding.
+    from hydragnn_tpu.data.stream.config import pop_fallback
+    from hydragnn_tpu.data.stream.loader import (
+        find_stream_loader,
+        try_fast_forward,
+    )
+
+    stream_fb = pop_fallback()
+    if stream_fb:
+        telemetry.health("stream_fallback", reason=stream_fb)
+    stream_base = find_stream_loader(train_loader)
+    if stream_base is not None:
+        resident_on = False
+        telemetry.health(
+            "stream_open", n_samples=int(len(stream_base.indices)),
+            window=int(stream_base.window), order=str(stream_base.order),
+            batch_size=int(stream_base.batch_size),
+            tail=bool(stream_base.tail_dir))
     if use_mesh_dp:
         from hydragnn_tpu.parallel.mesh import (
             DeviceStackLoader,
@@ -885,12 +911,37 @@ def train_validate_test(
                     "rows would train on silently wrong neighborhoods; "
                     "set it >= num_conv_layers or leave it 0 (auto)")
             head_types = list(cfg.output_type)
-            train_loader = ShardedGraphLoader(
-                train_loader, n_shards, gs_cfg, hops, head_types)
-            val_loader = ShardedGraphLoader(
-                val_loader, n_shards, gs_cfg, hops, head_types)
-            test_loader = ShardedGraphLoader(
-                test_loader, n_shards, gs_cfg, hops, head_types)
+            gs_train = gs_val = gs_test = None
+            if stream_base is not None:
+                # disk-backed halo feed: shard gathers read straight off the
+                # mmap store — the padded whole graph is never materialized
+                from hydragnn_tpu.data.stream.halo import sharded_from_stream
+
+                gs_train = sharded_from_stream(
+                    train_loader, n_shards, gs_cfg, hops)
+                gs_val = sharded_from_stream(
+                    val_loader, n_shards, gs_cfg, hops)
+                gs_test = sharded_from_stream(
+                    test_loader, n_shards, gs_cfg, hops)
+            if gs_train and gs_val and gs_test:
+                train_loader, val_loader, test_loader = \
+                    gs_train, gs_val, gs_test
+            else:
+                if stream_base is not None:
+                    import warnings
+
+                    warnings.warn(
+                        "disk-backed halo feed needs batch_size=1 single-"
+                        "host streaming loaders; composing the in-memory "
+                        "partitioner over the stream instead (still "
+                        "windowed, but each batch is padded host-side)",
+                        stacklevel=2)
+                train_loader = ShardedGraphLoader(
+                    train_loader, n_shards, gs_cfg, hops, head_types)
+                val_loader = ShardedGraphLoader(
+                    val_loader, n_shards, gs_cfg, hops, head_types)
+                test_loader = ShardedGraphLoader(
+                    test_loader, n_shards, gs_cfg, hops, head_types)
             gs_stats = train_loader.peek_stats()
             train_step = make_halo_train_step(
                 model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
@@ -1238,6 +1289,18 @@ def train_validate_test(
             t0 = time.time()
             telemetry.begin_epoch(epoch)
             train_loader.set_epoch(epoch)
+            if stream_base is not None and stream_base.tail_grew:
+                old_n, new_n = stream_base.tail_grew
+                stream_base.tail_grew = None
+                telemetry.health("stream_tail_grow", old=int(old_n),
+                                 new=int(new_n))
+            # mid-epoch resume: a streaming loader skips the already-
+            # consumed units inside its plan (never decoding them); other
+            # loaders fall back to _run_epoch's iterate-and-discard
+            sf = skip_first if epoch == start_epoch else 0
+            ff_base = 0
+            if sf and try_fast_forward(train_loader, sf):
+                ff_base, sf = sf, 0
             # train/val/test all DISPATCH without a device->host sync; ONE
             # combined device_get drains the queue per epoch (each separate
             # sync costs a full tunnel round trip, ~100 ms on remote PJRT —
@@ -1250,7 +1313,7 @@ def train_validate_test(
                 steps_per_item=steps_per_dispatch,
                 telemetry=telemetry if telemetry.enabled else None,
                 guard=guard_monitor, preempt=preempt, chaos=chaos,
-                skip_first=skip_first if epoch == start_epoch else 0)
+                skip_first=sf, consumed_base=ff_base)
             tr.stop("train")
             if preempt is not None and preempt.stop_requested:
                 # preemption agreed mid-epoch: bundle the exact position

@@ -1,0 +1,520 @@
+"""Streaming data plane (hydragnn_tpu/data/stream/) — tier-1 contracts.
+
+The load-bearing claims, each asserted here:
+
+- StreamPlan is a pure function of (seed, epoch, rank): identical replay,
+  and the rank shares partition the wrap-padded epoch exactly;
+- the windowed loader's batch stream is BIT-IDENTICAL to the in-memory
+  GraphDataLoader on the same seed — for any window size, because the
+  window bounds residency, not order;
+- residency really is bounded: peak decoded samples <= window + one
+  in-flight batch, independent of dataset size;
+- fast-forward (mid-epoch resume) yields exactly the uninterrupted
+  epoch's surviving suffix;
+- ingest segments are atomic: torn files are rejected loudly, growth is
+  picked up between epochs;
+- the gpack-backed halo feed produces bit-identical HaloBatches to the
+  in-memory partitioner;
+- split_dataset / DistDataset no longer materialize lazy datasets.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.dataloader import (
+    GraphDataLoader,
+    PadSpec,
+    bucket_pad_specs,
+    bucket_pad_specs_from_sizes,
+    pad_spec_for,
+)
+from hydragnn_tpu.data.gpack import GpackDataset, GpackWriter
+from hydragnn_tpu.data.stream.config import (
+    StreamConfig,
+    check_stream_flag,
+    stream_dataset_defaults,
+)
+from hydragnn_tpu.data.stream.ingest import (
+    IngestWriter,
+    ingest_jsonl,
+    open_tail_store,
+    read_manifest,
+)
+from hydragnn_tpu.data.stream.loader import (
+    StreamingGraphLoader,
+    find_stream_loader,
+    split_stream_indices,
+    stats_from_store,
+    try_fast_forward,
+)
+from hydragnn_tpu.data.stream.plan import StreamPlan
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec
+from hydragnn_tpu.graph.neighborlist import radius_graph
+
+
+def _samples(n, n_nodes=12, seed=11):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        pos = rng.rand(n_nodes, 3).astype(np.float32) * 2.0
+        x = rng.rand(n_nodes, 1).astype(np.float32)
+        out.append(GraphSample(
+            x=x, pos=pos, edge_index=radius_graph(pos, 1.2, n_nodes),
+            graph_y=x.sum(keepdims=True)[0], node_y=x))
+    return out
+
+
+HEADS = [HeadSpec("e", "graph", 1)]
+
+
+@pytest.fixture(scope="module")
+def store_and_samples(tmp_path_factory):
+    d = tmp_path_factory.mktemp("stream_store")
+    samples = _samples(40)
+    written = GpackWriter(str(d / "s.gpack")).save(samples)
+    store = GpackDataset(written)
+    yield store, samples
+    store.close()
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype
+        assert np.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+
+
+def test_plan_deterministic_and_partitions_hosts():
+    n, ws = 101, 4
+    plans = [StreamPlan(n, seed=5, rank=r, world_size=ws) for r in range(ws)]
+    for epoch in (0, 1, 7):
+        shares = [p.epoch_order(epoch) for p in plans]
+        # identical replay for the same (seed, epoch, rank)
+        for r, p in enumerate(plans):
+            assert np.array_equal(shares[r], p.epoch_order(epoch))
+        # equal-length shares covering the wrap-padded epoch exactly
+        total = -(-n // ws) * ws
+        assert all(len(s) == total // ws for s in shares)
+        joined = np.concatenate(shares)
+        assert len(joined) == total
+        assert set(joined.tolist()) == set(range(n))
+    # different epochs shuffle differently
+    p0 = plans[0]
+    assert not np.array_equal(p0.epoch_order(0), p0.epoch_order(1))
+
+
+def test_plan_modes():
+    p = StreamPlan(50, seed=3, mode="sequential", shuffle=False)
+    assert np.array_equal(p.epoch_order(4), np.arange(50))
+    b = StreamPlan(50, seed=3, mode="block", block=16)
+    order = b.epoch_order(2)
+    assert np.array_equal(order, b.epoch_order(2))  # deterministic
+    assert sorted(order.tolist()) == list(range(50))  # a permutation
+    with pytest.raises(ValueError):
+        StreamPlan(10, mode="bogus")
+
+
+# ---------------------------------------------------------------------------
+# windowed loader: parity, replay, fast-forward, bounded residency
+# ---------------------------------------------------------------------------
+
+
+def _stream_loader(store, n, bs, window, shuffle=True, pad=None):
+    return StreamingGraphLoader(
+        store, np.arange(n), HEADS, bs, window=window, shuffle=shuffle,
+        seed=13, pad_specs=[pad] if pad else None)
+
+
+def test_stream_matches_in_memory_bitexact(store_and_samples):
+    store, samples = store_and_samples
+    pad = pad_spec_for(samples, 8)
+    mem = GraphDataLoader(samples, HEADS, 8, pad_spec=pad, shuffle=True,
+                          seed=13)
+    for window in (3, 8, 64):  # window < batch, == batch, >> dataset/bs
+        st = _stream_loader(store, 40, 8, window, pad=pad)
+        for epoch in (0, 2):
+            mem.set_epoch(epoch)
+            st.set_epoch(epoch)
+            mb, sb = list(mem), list(st)
+            assert len(mb) == len(sb) == len(st)
+            for a, b in zip(mb, sb):
+                _leaves_equal(a, b)
+
+
+def test_replay_same_epoch_identical(store_and_samples):
+    store, _ = store_and_samples
+    st = _stream_loader(store, 40, 8, 6)
+    st.set_epoch(1)
+    first = list(st)
+    second = list(st)  # re-iterating replays the same plan
+    assert len(first) == len(second)
+    for a, b in zip(first, second):
+        _leaves_equal(a, b)
+
+
+def test_fast_forward_matches_suffix(store_and_samples):
+    store, _ = store_and_samples
+    st = _stream_loader(store, 40, 8, 6)
+    st.set_epoch(0)
+    full = list(st)
+    st.set_epoch(0)
+    assert try_fast_forward(st, 2)
+    tail = list(st)
+    assert len(tail) == len(full) - 2
+    for a, b in zip(full[2:], tail):
+        _leaves_equal(a, b)
+    # wrapped chains: the walker finds the base and scales by fan-in
+    class Wrap:
+        def __init__(self, loader):
+            self.loader = loader
+            self.n_devices = 2
+
+    w = Wrap(st)
+    assert find_stream_loader(w) is st
+    st.set_epoch(0)
+    assert try_fast_forward(w, 1)
+    assert len(list(st)) == len(full) - 2  # 1 unit * fan-in 2
+    assert not try_fast_forward(object(), 1)
+
+
+def test_bounded_residency(store_and_samples):
+    store, _ = store_and_samples
+    bs, window = 4, 5
+    st = _stream_loader(store, 40, bs, window)
+    st.set_epoch(0)
+    n_batches = sum(1 for _ in st)
+    assert n_batches == 10
+    # the bounded-memory contract: W + one in-flight batch, << dataset
+    assert st.last_resident_peak <= window + bs
+    assert st.last_resident_peak < 40
+
+
+def test_streamed_training_loss_bitparity(store_and_samples, tmp_path):
+    """One epoch of real training: streamed loader vs in-memory loader
+    produce bit-identical loss trajectories (same model/opt/seed)."""
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state, train_validate_test
+
+    store, samples = store_and_samples
+    pad = pad_spec_for(samples, 8)
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    conf = {"Training": {"num_epoch": 1},
+            "Variables_of_interest": {"output_names": ["e"]}}
+
+    def _train(train_loader, val_loader, test_loader, name):
+        model = create_model(cfg)
+        opt = select_optimizer({"type": "AdamW", "learning_rate": 0.01})
+        state = create_train_state(model, next(iter(train_loader)), opt)
+        _, hist = train_validate_test(
+            model, cfg, state, opt, train_loader, val_loader, test_loader,
+            conf, log_name=name, verbosity=0, logs_dir=str(tmp_path),
+            use_mesh_dp=False)
+        return hist
+
+    mk_mem = lambda lo, hi, sh: GraphDataLoader(  # noqa: E731
+        samples[lo:hi], HEADS, 8, pad_spec=pad, shuffle=sh, seed=13)
+    mk_st = lambda lo, hi, sh: StreamingGraphLoader(  # noqa: E731
+        store, np.arange(lo, hi), HEADS, 8, window=6, shuffle=sh, seed=13,
+        pad_specs=[pad])
+    h_mem = _train(mk_mem(0, 24, True), mk_mem(24, 32, False),
+                   mk_mem(32, 40, False), "mem")
+    h_st = _train(mk_st(0, 24, True), mk_st(24, 32, False),
+                  mk_st(32, 40, False), "stream")
+    assert h_mem["train"] == h_st["train"]
+    assert h_mem["val"] == h_st["val"]
+    assert h_mem["test"] == h_st["test"]
+
+
+# ---------------------------------------------------------------------------
+# store-level stats, splits, bucket ladders from size arrays
+# ---------------------------------------------------------------------------
+
+
+def test_stats_from_store_matches_from_samples(store_and_samples):
+    from hydragnn_tpu.config.config import DatasetStats
+
+    store, samples = store_and_samples
+    a = stats_from_store(store, need_deg=True)
+    b = DatasetStats.from_samples(samples, need_deg=True)
+    assert a.max_nodes == b.max_nodes
+    assert a.max_edges == b.max_edges
+    assert a.graph_size_variable == b.graph_size_variable
+    assert a.pna_deg == b.pna_deg
+
+
+def test_split_stream_indices_matches_split_dataset():
+    n, perc = 40, 0.7
+    tr, va, te = split_stream_indices(n, perc)
+    data = list(range(n))
+    n_train = int(perc * n)
+    n_val = int(((1 - perc) / 2) * n)
+    assert tr.tolist() == data[:n_train]
+    assert va.tolist() == data[n_train:n_train + n_val]
+    assert te.tolist() == data[n_train + n_val:]
+
+
+def test_bucket_specs_from_sizes_match_sample_path():
+    samples = _samples(30, seed=4)
+    nodes = np.asarray([s.num_nodes for s in samples])
+    edges = np.asarray([s.num_edges for s in samples])
+    assert (bucket_pad_specs_from_sizes(nodes, edges, 8, n_buckets=3)
+            == bucket_pad_specs(samples, 8, n_buckets=3))
+
+
+# ---------------------------------------------------------------------------
+# ingestion: atomic manifest, torn rejection, tail growth, JSONL
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_manifest_atomic_and_torn_rejected(tmp_path):
+    d = str(tmp_path / "ingest")
+    w = IngestWriter(d, seal_every=4)
+    for s in _samples(10, seed=3):
+        w.add(s)
+    w.close()
+    segs = read_manifest(d)
+    assert [s["n"] for s in segs] == [4, 4, 2]
+    assert w.n_sealed == 10
+    # every listed segment exists at exactly its recorded size
+    for s in segs:
+        assert os.path.getsize(os.path.join(d, s["file"])) == s["bytes"]
+    # resume appends after the last sealed segment
+    w2 = IngestWriter(d, seal_every=4)
+    for s in _samples(4, seed=5):
+        w2.add(s)
+    w2.close()
+    assert len(open_tail_store(d)) == 14
+    # tear a segment: it must be excluded loudly, the rest still load
+    victim = read_manifest(d)[1]
+    with open(os.path.join(d, victim["file"]), "r+b") as f:
+        f.truncate(victim["bytes"] - 8)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        valid = read_manifest(d)
+    assert len(valid) == 3
+    assert any("torn" in str(r.message) for r in rec)
+    assert len(open_tail_store(d)) == 10
+    # an unknown manifest format must refuse, not misread
+    with open(os.path.join(d, "manifest.json"), "w") as f:  # graftlint: disable=ROB002 (test deliberately plants a bad manifest)
+        json.dump({"format": "v999", "segments": []}, f)
+    with pytest.raises(ValueError):
+        read_manifest(d)
+
+
+def test_tail_mode_picks_up_growth(tmp_path):
+    d = str(tmp_path / "tail")
+    w = IngestWriter(d, seal_every=4)
+    for s in _samples(8, seed=6):
+        w.add(s)
+    w.close()
+    store = open_tail_store(d)
+    st = StreamingGraphLoader(store, np.arange(8), HEADS, 4, window=4,
+                              shuffle=False, tail_dir=d)
+    st.set_epoch(0)
+    assert sum(1 for _ in st) == 2
+    # growth between epochs: the next set_epoch re-reads the manifest
+    w2 = IngestWriter(d, seal_every=4)
+    for s in _samples(4, seed=7):
+        w2.add(s)
+    w2.close()
+    st.set_epoch(1)
+    assert st.tail_grew == (8, 12)
+    assert sum(1 for _ in st) == 3
+
+
+def test_ingest_jsonl_tolerant(tmp_path):
+    jl = tmp_path / "cap.jsonl"
+    recs = [
+        {"x": [[1.0]], "pos": [[0.0, 0.0, 0.0]]},
+        {"request": {"x": [[2.0], [3.0]],
+                     "pos": [[0, 0, 0], [1, 0, 0]],
+                     "edge_index": [[0, 1], [1, 0]]}},
+    ]
+    jl.write_text("\n".join([json.dumps(recs[0]), "NOT JSON",
+                             json.dumps(recs[1])]) + "\n")
+    d = str(tmp_path / "out")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        n = ingest_jsonl(str(jl), d, seal_every=2)
+    assert n == 2
+    assert any("malformed" in str(r.message) for r in rec)
+    store = open_tail_store(d)
+    assert len(store) == 2
+    assert store[1].edge_index.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# disk-backed halo feed
+# ---------------------------------------------------------------------------
+
+
+def test_gpack_halo_bit_equality(tmp_path):
+    from hydragnn_tpu.data.stream.halo import (
+        GpackShardedLoader,
+        sharded_from_stream,
+    )
+    from hydragnn_tpu.graph.partition import (
+        GraphShardConfig,
+        ShardedGraphLoader,
+    )
+
+    heads = [HeadSpec("charge", "node", 1)]
+    rng = np.random.RandomState(7)
+    samples = []
+    for _ in range(3):
+        pos = rng.rand(24, 3).astype(np.float32) * 2.0
+        x = rng.rand(24, 1).astype(np.float32)
+        samples.append(GraphSample(
+            x=x, pos=pos, edge_index=radius_graph(pos, 1.0, 24), node_y=x))
+    maxn = max(s.num_nodes for s in samples)
+    maxe = max(s.num_edges for s in samples)
+    pad = PadSpec(num_nodes=maxn + 8, num_edges=maxe + 8, num_graphs=2)
+    cfg = GraphShardConfig(backend="halo", method="sfc", hops=0, halo_max=0)
+
+    mem = GraphDataLoader(samples, heads, 1, pad_spec=pad, shuffle=False)
+    ref = ShardedGraphLoader(mem, 4, cfg, 2, ["node"])
+    written = GpackWriter(str(tmp_path / "h.gpack")).save(samples)
+    store = GpackDataset(written)
+    gp = GpackShardedLoader(store, np.arange(3), 4, cfg, 2, heads,
+                            num_graphs=2)
+    ra, rb = list(ref), list(gp)
+    assert len(ra) == len(rb) == 3
+    for a, b in zip(ra, rb):
+        _leaves_equal(a, b)
+    assert gp.peek_stats()["n_shards"] == 4
+
+    # sharded_from_stream only qualifies batch_size==1 single-host chains
+    st1 = StreamingGraphLoader(store, np.arange(3), heads, 1,
+                               pad_specs=[pad])
+    assert sharded_from_stream(st1, 4, cfg, 2) is not None
+    st2 = StreamingGraphLoader(store, np.arange(3), heads, 2,
+                               pad_specs=[pad])
+    assert sharded_from_stream(st2, 4, cfg, 2) is None
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_stream_config_spellings_and_env(monkeypatch):
+    assert check_stream_flag(True) and check_stream_flag("on")
+    assert not check_stream_flag(None) and not check_stream_flag("off")
+    with pytest.raises(ValueError):
+        check_stream_flag("maybe")
+    cfg = StreamConfig.from_dataset({"stream": True, "stream_path": "/a",
+                                     "stream_window": 7})
+    assert cfg.enabled and cfg.path == "/a" and cfg.window == 7
+    monkeypatch.setenv("HYDRAGNN_STREAM_WINDOW", "9")
+    monkeypatch.setenv("HYDRAGNN_STREAM_ORDER", "block")
+    cfg = StreamConfig.from_dataset({"stream": True, "stream_path": "/a"})
+    assert cfg.window == 9 and cfg.order == "block"
+    # tail implies enabled
+    cfg = StreamConfig.from_dataset({"stream_tail": "/cap"})
+    assert cfg.enabled and cfg.tail == "/cap"
+    monkeypatch.delenv("HYDRAGNN_STREAM_WINDOW")
+    with pytest.raises(ValueError):
+        StreamConfig.from_dataset({"stream": True, "stream_window": 0})
+
+
+def test_finalize_writes_stream_defaults(store_and_samples):
+    from hydragnn_tpu.config.config import DatasetStats, finalize
+
+    _, samples = store_and_samples
+    stats = DatasetStats.from_samples(samples)
+    config = {
+        "Dataset": {},
+        "NeuralNetwork": {
+            "Architecture": {"model_type": "SAGE", "hidden_dim": 8,
+                             "num_conv_layers": 2,
+                             "output_heads": {"graph": {
+                                 "num_sharedlayers": 1,
+                                 "dim_sharedlayers": 8,
+                                 "num_headlayers": 1,
+                                 "dim_headlayers": [8]}}},
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["e"], "output_index": [0],
+                "type": ["graph"], "output_dim": [1]},
+            "Training": {"batch_size": 8, "num_epoch": 1,
+                         "perc_train": 0.7},
+        },
+    }
+    out = finalize(config, stats)
+    ds = out["Dataset"]
+    for k, v in stream_dataset_defaults().items():
+        assert k in ds, k
+    assert ds["stream"] is False
+
+
+# ---------------------------------------------------------------------------
+# lazy splitting / no-materialize satellites
+# ---------------------------------------------------------------------------
+
+
+class _CountingDataset:
+    """Sequence that counts item decodes — materialization detector."""
+
+    def __init__(self, n):
+        self.n = n
+        self.gets = 0
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            self.gets += 1
+            return int(i)
+        raise TypeError(i)
+
+
+def test_split_dataset_lazy_no_materialize():
+    from hydragnn_tpu.data.splitting import IndexedSubset, split_dataset
+
+    ds = _CountingDataset(40)
+    tr, va, te = split_dataset(ds, 0.7)
+    assert ds.gets == 0  # splitting decoded NOTHING
+    assert isinstance(tr, IndexedSubset)
+    assert len(tr) == 28 and len(va) == 6 and len(te) == 6
+    assert tr[0] == 0 and va[0] == 28 and te[-1] == 39
+    assert ds.gets == 3
+    # list inputs keep returning plain list slices
+    tr2, va2, te2 = split_dataset(list(range(40)), 0.7)
+    assert isinstance(tr2, list) and tr2 == list(range(28))
+    assert [len(va2), len(te2)] == [6, 6]
+
+
+def test_numpy_part_mmap_close(tmp_path):
+    samples = _samples(5, seed=9)
+    written = GpackWriter(str(tmp_path / "m.gpack")).save(samples)
+    store = GpackDataset(written, use_native=False)
+    s0 = store[0]
+    assert np.array_equal(s0.x, samples[0].x)
+    view = store.sample_view(2, "x")  # zero-copy view over the mmap
+    assert np.array_equal(view, samples[2].x)
+    nodes, edges = store.sizes()
+    assert nodes.tolist() == [s.num_nodes for s in samples]
+    assert edges.tolist() == [s.num_edges for s in samples]
+    store.close()  # live view exported — close must not raise
+    assert np.array_equal(np.asarray(view), samples[2].x)

@@ -12,6 +12,12 @@ Usage:
         [--chaos-step K]       deterministic injected preemption at train
                                dispatch K instead of a wall-clock SIGTERM
         [--mesh]               run the mesh-DP path (local devices)
+        [--stream]             feed training from a gpack store through the
+                               streaming data plane (data/stream/): the
+                               resume child fast-forwards INSIDE the
+                               stream plan instead of iterate-and-discard,
+                               proving the skip-first-N path keeps mid-
+                               epoch bit parity
         [--zero N]             ZeRO stage (1 or 2; implies --mesh): the
                                victim's optimizer state (and stage-2
                                params) train SHARDED, the resume bundle is
@@ -43,7 +49,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # ---------------------------------------------------------------------------
 
 
-def _build(n_train: int, batch_size: int, epochs: int, mesh: bool):
+def _build(n_train: int, batch_size: int, epochs: int, mesh: bool,
+           stream: bool = False, workdir: str = ""):
     import numpy as np
 
     from hydragnn_tpu.data.dataloader import GraphDataLoader, pad_spec_for
@@ -65,11 +72,32 @@ def _build(n_train: int, batch_size: int, epochs: int, mesh: bool):
                                    node_y=x))
     heads = [HeadSpec("e", "graph", 1)]
     pad = pad_spec_for(samples, batch_size)
-    mk = lambda split, shuffle: GraphDataLoader(  # noqa: E731
-        split, heads, batch_size, pad_spec=pad, shuffle=shuffle, seed=13)
-    loaders = (mk(samples[:n_train], True),
-               mk(samples[n_train:n_train + 8], False),
-               mk(samples[n_train + 8:], False))
+    if stream:
+        # identical samples land in a gpack store; the three phases train
+        # through StreamingGraphLoaders with the same seed/shuffle, so any
+        # parity break is the stream plan's fault, nothing else's
+        from hydragnn_tpu.data.gpack import GpackDataset, GpackWriter
+        from hydragnn_tpu.data.stream.loader import StreamingGraphLoader
+
+        store_path = os.path.join(workdir, "stream_store.gpack")
+        written = store_path + ".p0"  # GpackWriter's rank-0 suffix
+        if not os.path.exists(written):
+            GpackWriter(store_path).save(samples)
+        store = GpackDataset(written)
+        n = len(samples)
+        mks = lambda lo, hi, shuffle: StreamingGraphLoader(  # noqa: E731
+            store, np.arange(lo, hi), heads, batch_size,
+            window=max(4, 2 * batch_size), shuffle=shuffle, seed=13,
+            pad_specs=[pad])
+        loaders = (mks(0, n_train, True),
+                   mks(n_train, n_train + 8, False),
+                   mks(n_train + 8, n, False))
+    else:
+        mk = lambda split, shuffle: GraphDataLoader(  # noqa: E731
+            split, heads, batch_size, pad_spec=pad, shuffle=shuffle, seed=13)
+        loaders = (mk(samples[:n_train], True),
+                   mk(samples[n_train:n_train + 8], False),
+                   mk(samples[n_train + 8:], False))
     cfg = ModelConfig(
         model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
         output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
@@ -90,7 +118,8 @@ def run_child(args) -> int:
 
     n_train = 8 * args.batch_size if args.mesh else 6 * args.batch_size
     model, cfg, opt, state, loaders = _build(
-        n_train, args.batch_size, args.epochs, args.mesh)
+        n_train, args.batch_size, args.epochs, args.mesh,
+        stream=args.stream, workdir=args.workdir)
     logs_dir = os.path.join(args.workdir, "logs")
     log_name = "crashtest" if args.mode != "baseline" else "baseline"
 
@@ -171,6 +200,8 @@ def _spawn(args, mode, extra_env=None):
            "--epoch-sleep", str(args.epoch_sleep)]
     if args.mesh:
         cmd.append("--mesh")
+    if args.stream:
+        cmd.append("--stream")
     if args.zero:
         cmd += ["--zero", str(args.zero)]
     return subprocess.Popen(cmd, cwd=REPO, env=env,
@@ -194,7 +225,8 @@ def run_parent(args) -> int:
     import shutil
 
     for stale in ("logs", "baseline_final.pk", "victim_final.pk",
-                  "resume_final.pk"):
+                  "resume_final.pk", "stream_store.gpack",
+                  "stream_store.gpack.p0"):
         path = os.path.join(args.workdir, stale)
         if os.path.isdir(path):
             shutil.rmtree(path, ignore_errors=True)
@@ -300,6 +332,10 @@ def main(argv=None) -> int:
                          "of a real SIGTERM (fully deterministic)")
     ap.add_argument("--mesh", action="store_true",
                     help="exercise the mesh-DP path")
+    ap.add_argument("--stream", action="store_true",
+                    help="train all three phases through the streaming "
+                         "data plane (gpack store + windowed loaders); the "
+                         "resume phase fast-forwards inside the stream plan")
     ap.add_argument("--zero", type=int, nargs="?", const=1, default=0,
                     choices=(0, 1, 2),
                     help="ZeRO stage for all three phases (implies --mesh): "
