@@ -233,6 +233,13 @@ _KNOB_LIST = [
     _k("HYDRAGNN_CHAOS_REPLICA_FLAP", "Serving.FleetChaos.flap", "off",
        "hydragnn_tpu/resilience/chaos.py",
        "kill the target at EVERY armed tick (crash loop)"),
+    _k("HYDRAGNN_CHAOS_TENANT_HOT", "Serving.FleetChaos.tenant_hot", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "mark a tenant hot at probe tick spec tick[:tenant]|tick+ "
+       "(router sheds it 429)"),
+    _k("HYDRAGNN_CHAOS_SCALE_FAIL", "Serving.FleetChaos.scale_fail", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "make the next scale-up's fresh replica die at probe tick spec"),
     # -- serving ----------------------------------------------------------
     _k("HYDRAGNN_SERVE_BUCKETS", "Serving.buckets", "1,4,16",
        "hydragnn_tpu/serve/config.py",
@@ -318,6 +325,36 @@ _KNOB_LIST = [
     _k("HYDRAGNN_SERVE_FLEET_QUORUM", "Serving.fleet_quorum",
        "0 (majority)", "hydragnn_tpu/serve/config.py",
        "live replicas below this -> fleet_degraded"),
+    _k("HYDRAGNN_SERVE_FLEET_MIN", "Serving.fleet_min_replicas", "1",
+       "hydragnn_tpu/serve/config.py",
+       "autoscaler floor: scale-down never goes below this"),
+    _k("HYDRAGNN_SERVE_FLEET_MAX", "Serving.fleet_max_replicas", "0",
+       "hydragnn_tpu/serve/config.py",
+       "autoscaler ceiling (0 = closed-loop autoscaling off)"),
+    _k("HYDRAGNN_SERVE_AUTOSCALE_UP_FRAC", "Serving.autoscale_up_frac",
+       "0.5", "hydragnn_tpu/serve/config.py",
+       "scale up when est queue wait exceeds this fraction of the "
+       "request deadline"),
+    _k("HYDRAGNN_SERVE_AUTOSCALE_UP_TICKS", "Serving.autoscale_up_ticks",
+       "3", "hydragnn_tpu/serve/config.py",
+       "consecutive hot probe ticks before a scale-up (hysteresis)"),
+    _k("HYDRAGNN_SERVE_AUTOSCALE_QUIET_S", "Serving.autoscale_quiet_s",
+       "60", "hydragnn_tpu/serve/config.py",
+       "sustained empty-queue window before a zero-drop scale-down"),
+    _k("HYDRAGNN_SERVE_AUTOSCALE_COOLDOWN_S",
+       "Serving.autoscale_cooldown_s", "30",
+       "hydragnn_tpu/serve/config.py",
+       "minimum spacing between scale decisions"),
+    _k("HYDRAGNN_SERVE_MAX_TENANTS", "Serving.max_tenants", "4",
+       "hydragnn_tpu/serve/config.py",
+       "resident tenant engines per replica incl. default (LRU beyond)"),
+    _k("HYDRAGNN_SERVE_TENANT_BUDGET_FRAC", "Serving.tenant_budget_frac",
+       "0", "hydragnn_tpu/serve/config.py",
+       "per-tenant outstanding cap as a fraction of fleet drain "
+       "capacity (0 = budgets off)"),
+    _k("HYDRAGNN_SERVE_MAX_EXECUTABLES", "Serving.max_resident_executables",
+       "0", "hydragnn_tpu/serve/config.py",
+       "engine AOT-executable LRU cap (0 = unbounded)"),
     # -- misc -------------------------------------------------------------
     _k("HYDRAGNN_SYSTEM", "", "",
        "hydragnn_tpu/hpo.py",
@@ -424,6 +461,17 @@ _HEALTH_LIST = [
        "live replicas dropped below quorum"),
     _h("fleet_empty", "hydragnn_tpu/serve/router.py",
        "a request found no live replica (503)"),
+    # autoscaler + tenancy (docs/TELEMETRY.md "Autoscaler/tenancy kinds")
+    _h("fleet_scale_up", "hydragnn_tpu/serve/fleet.py",
+       "autoscaler added a replica (carries the drain-rate signal)"),
+    _h("fleet_scale_down", "hydragnn_tpu/serve/fleet.py",
+       "autoscaler retired a replica zero-drop after the quiet window"),
+    _h("tenant_shed", "hydragnn_tpu/serve/router.py",
+       "one tenant's request shed 429 (budget exceeded or chaos-hot)"),
+    _h("tenant_evict", "hydragnn_tpu/serve/fleet.py",
+       "LRU evicted a resident tenant engine from a replica"),
+    _h("executable_evict", "hydragnn_tpu/serve/engine.py",
+       "engine AOT-executable LRU evicted a compiled bucket"),
     # streaming data plane (docs/TELEMETRY.md "Streaming events")
     _h("stream_open", "hydragnn_tpu/train/trainer.py",
        "streaming data plane active (store, plan and window metadata)"),

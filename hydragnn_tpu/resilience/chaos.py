@@ -68,6 +68,17 @@ replicas):
                                restart loop turns this into up/down
                                flapping that exercises backoff and the
                                restart-storm cap
+  HYDRAGNN_CHAOS_TENANT_HOT    "3+:tenantB" | "2,7" — mark a TENANT (by
+                               name after the colon; default tenant
+                               when omitted) hot at those probe ticks:
+                               the router sheds that tenant's traffic
+                               (429) while the others keep serving —
+                               the per-tenant isolation drill
+  HYDRAGNN_CHAOS_SCALE_FAIL    "3" | "5+" — the next autoscaler
+                               scale-up at an armed tick spawns a
+                               replica that dies on arrival; backoff
+                               restart + the scale cooldown must absorb
+                               it without a spawn storm
 """
 
 from __future__ import annotations
@@ -292,6 +303,27 @@ def _parse_replica_spec(spec: str):
     return out
 
 
+def _parse_tenant_spec(spec: str):
+    """Replica-spec shape with a tenant NAME after the colon:
+    '3:tenantB' / '5+:tenantB' / '2,7' -> list of
+    ``(tick, every_tick_from, tenant_name_or_None)`` triples (None =
+    the default tenant)."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name: Optional[str] = None
+        if ":" in part:
+            part, _, n = part.partition(":")
+            name = n.strip() or None
+        if part.endswith("+"):
+            out.append((int(part[:-1]), True, name))
+        else:
+            out.append((int(part), False, name))
+    return out
+
+
 class FleetChaos:
     """Fault injector for the replica fleet (serve/fleet.py): hard
     kills, predict hangs, and up/down flapping, armed per SUPERVISOR
@@ -300,21 +332,30 @@ class FleetChaos:
     overlay an optional ``Serving.FleetChaos`` config dict, None when
     nothing is armed — zero production overhead)."""
 
-    ACTIONS = ("kill", "hang", "flap")
+    ACTIONS = ("kill", "hang", "flap", "tenant_hot", "scale_fail")
 
-    def __init__(self, kill=(), hang=(), flap=()):
+    def __init__(self, kill=(), hang=(), flap=(), tenant_hot=(),
+                 scale_fail=()):
         self.kill = list(kill)
         self.hang = list(hang)
         self.flap = list(flap)
+        # tenancy/autoscaler faults: tenant_hot marks a tenant hot for
+        # every armed tick (the router sheds its traffic 429 as if its
+        # budget were exhausted); scale_fail makes the NEXT autoscaler
+        # scale-up spawn a replica that dies on arrival (the backoff
+        # restart machinery must absorb it under the scale cooldown)
+        self.tenant_hot = list(tenant_hot)
+        self.scale_fail = list(scale_fail)
         self._tick = 0
         self.injected = {a: 0 for a in self.ACTIONS}
 
     @classmethod
     def from_env(cls, section: Optional[Dict[str, Any]] = None
                  ) -> Optional["FleetChaos"]:
-        """HYDRAGNN_CHAOS_REPLICA_KILL/_HANG/_FLAP env knobs overlaying
-        an optional ``Serving.FleetChaos`` dict (env wins); None when
-        nothing is armed."""
+        """HYDRAGNN_CHAOS_REPLICA_KILL/_HANG/_FLAP +
+        HYDRAGNN_CHAOS_TENANT_HOT / HYDRAGNN_CHAOS_SCALE_FAIL env knobs
+        overlaying an optional ``Serving.FleetChaos`` dict (env wins);
+        None when nothing is armed."""
         s = dict(section or {})
         kill = os.environ.get("HYDRAGNN_CHAOS_REPLICA_KILL",
                               str(s.get("kill", "") or ""))
@@ -322,19 +363,27 @@ class FleetChaos:
                               str(s.get("hang", "") or ""))
         flap = os.environ.get("HYDRAGNN_CHAOS_REPLICA_FLAP",
                               str(s.get("flap", "") or ""))
+        hot = os.environ.get("HYDRAGNN_CHAOS_TENANT_HOT",
+                             str(s.get("tenant_hot", "") or ""))
+        sfail = os.environ.get("HYDRAGNN_CHAOS_SCALE_FAIL",
+                               str(s.get("scale_fail", "") or ""))
         kill_s = _parse_replica_spec(kill) if kill else []
         hang_s = _parse_replica_spec(hang) if hang else []
         flap_s = _parse_replica_spec(flap) if flap else []
-        if not kill_s and not hang_s and not flap_s:
+        hot_s = _parse_tenant_spec(hot) if hot else []
+        sfail_s = _parse_replica_spec(sfail) if sfail else []
+        if not kill_s and not hang_s and not flap_s and not hot_s \
+                and not sfail_s:
             return None
-        return cls(kill_s, hang_s, flap_s)
+        return cls(kill_s, hang_s, flap_s, hot_s, sfail_s)
 
     def on_probe(self):
         """Count one supervisor probe tick; return the armed actions as
-        ``(action, replica_idx_or_None)`` pairs (None = the supervisor
-        picks a live replica round-robin).  ``flap`` arms a kill every
-        matching tick — the supervisor's restart loop supplies the "up"
-        half of the flap."""
+        ``(action, target)`` pairs — ``target`` is a replica index (or
+        None = round-robin) for kill/hang/flap/scale_fail, a tenant NAME
+        (or None = default tenant) for tenant_hot.  ``flap`` arms a kill
+        every matching tick — the supervisor's restart loop supplies the
+        "up" half of the flap."""
         self._tick += 1
         acts = []
         for action in self.ACTIONS:

@@ -23,6 +23,15 @@ fleet is saturated, 503 only when it is empty) with
 backoff + a storm cap and fanning hot reloads out as a rolling
 one-replica-at-a-time update.
 
+Closed-loop autoscaling + multi-tenancy (docs/SERVING.md "Multi-tenant
+fleet & autoscaler"): :class:`FleetAutoscaler` consumes the drain-rate
+signal in the supervisor's probe loop (scale up under backlog, retire
+replicas zero-drop after a quiet window, hysteresis/cooldown/bounds);
+in-process replicas host extra tenants (``model`` field on /predict) as
+:meth:`InferenceEngine.fork` engines behind a bounded LRU, with
+per-tenant admission budgets shedding a hot tenant's 429s while the
+other tenants keep their SLO.
+
 Exports resolve lazily (PEP 562): ``config.finalize`` imports
 ``serve.config`` for the written-back Serving defaults, and that must
 not drag the engine/server stack (flax, http.server, the trainer) into
@@ -43,13 +52,17 @@ _EXPORTS = {
     "PredictTimeoutError": "hydragnn_tpu.serve.batcher",
     "QueueFullError": "hydragnn_tpu.serve.batcher",
     "RequestShedError": "hydragnn_tpu.serve.batcher",
+    "DEFAULT_TENANT": "hydragnn_tpu.serve.config",
     "ServingConfig": "hydragnn_tpu.serve.config",
     "serving_defaults": "hydragnn_tpu.serve.config",
+    "FleetAutoscaler": "hydragnn_tpu.serve.autoscale",
+    "ScaleDecision": "hydragnn_tpu.serve.autoscale",
     "FleetSupervisor": "hydragnn_tpu.serve.fleet",
     "InProcessReplica": "hydragnn_tpu.serve.fleet",
     "PredictRequest": "hydragnn_tpu.serve.fleet",
     "ReplicaDeadError": "hydragnn_tpu.serve.fleet",
     "SubprocessReplica": "hydragnn_tpu.serve.fleet",
+    "UnknownTenantError": "hydragnn_tpu.serve.fleet",
     "spawn_argv": "hydragnn_tpu.serve.fleet",
     "FleetEmptyError": "hydragnn_tpu.serve.router",
     "FleetRouter": "hydragnn_tpu.serve.router",

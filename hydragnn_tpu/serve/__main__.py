@@ -98,6 +98,9 @@ def main(argv=None) -> int:
                 for i in range(n)
             ]
             cfg, pbc = base.cfg, base.pbc
+
+            def replica_factory(i):
+                return InProcessReplica(i, base.fork, serving, telemetry)
         else:
             builder = spawn_argv(args.config, logs_dir=args.logs_dir)
             replicas = [
@@ -105,10 +108,17 @@ def main(argv=None) -> int:
                 for i in range(n)
             ]
             cfg, pbc = None, False
+
+            def replica_factory(i):
+                return SubprocessReplica(i, builder, serving, telemetry)
+        # fleet_max_replicas > 0 arms the closed-loop autoscaler: the
+        # supervisor builds the FleetAutoscaler policy itself and grows
+        # or shrinks the fleet via this factory (serve/autoscale.py)
         fleet = FleetSupervisor(replicas, serving, telemetry=telemetry,
                                 chaos=FleetChaos.from_env(
                                     config.get("Serving", {}).get(
-                                        "FleetChaos")))
+                                        "FleetChaos")),
+                                replica_factory=replica_factory)
         router = FleetRouter(fleet, serving=serving, cfg=cfg, pbc=pbc,
                              telemetry=telemetry)
         mode = "in-process" if serving.fleet_inprocess else "subprocess"

@@ -1,0 +1,134 @@
+"""Closed-loop fleet autoscaler: the policy behind ``autoscale.value``.
+
+PR 8 published the fleet's drain-rate EWMA sum in ``/metrics`` as an
+autoscaling signal; this module is the consumer.  ``FleetAutoscaler`` is
+a pure state machine evaluated once per supervisor probe tick
+(fleet.py:FleetSupervisor.probe_once) on the same numbers the PR-5
+admission shed uses — estimated backlog wait = queued work / fleet drain
+rate — so the shed and the scaler can never disagree about whether the
+fleet is overloaded:
+
+* **up** when the backlog estimate exceeds ``autoscale_up_frac`` of the
+  request deadline for ``autoscale_up_ticks`` CONSECUTIVE ticks
+  (hysteresis: one slow flush can't add a replica),
+* **down** after ``autoscale_quiet_s`` of sustained zero queued work
+  (retirement goes through drain-and-replace machinery, so it drops
+  nothing),
+* never outside ``[fleet_min_replicas, fleet_max_replicas]``, and never
+  within ``autoscale_cooldown_s`` of the previous scale event — the dead
+  time that keeps scaling from flapping or interacting with restart
+  storms.
+
+Cold start never scales: with no drain-rate sample yet there is no
+backlog estimate, exactly like the admission shed's cold-start
+never-sheds rule.  The supervisor turns each returned decision into a
+replica add/retire plus a ``fleet_scale_up`` / ``fleet_scale_down``
+health event carrying the signal value that triggered it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    """One autoscaler verdict: scale ``direction`` ("up"/"down"),
+    triggered at ``signal`` seconds of estimated backlog wait with
+    ``live`` routable replicas."""
+
+    direction: str
+    signal: float
+    live: int
+
+
+class FleetAutoscaler:
+    """Hysteresis + cooldown + bounds around the drain-rate signal.
+
+    Pure and clock-free: callers pass ``now`` (monotonic seconds) into
+    :meth:`evaluate`, so tests drive the state machine with a fake
+    clock.  Disabled (``evaluate`` always None) unless
+    ``fleet_max_replicas > 0``.
+    """
+
+    def __init__(self, serving):
+        self.serving = serving
+        self.min_replicas = max(1, int(serving.fleet_min_replicas))
+        self.max_replicas = int(serving.fleet_max_replicas)
+        self.up_frac = float(serving.autoscale_up_frac)
+        self.up_ticks = max(1, int(serving.autoscale_up_ticks))
+        self.quiet_s = float(serving.autoscale_quiet_s)
+        self.cooldown_s = float(serving.autoscale_cooldown_s)
+        # with deadlines disabled the shed is off too; 1 s keeps the
+        # up-threshold meaningful instead of dividing by zero
+        self.deadline_ref_s = (
+            float(serving.request_deadline_ms) / 1e3
+            if float(serving.request_deadline_ms) > 0 else 1.0)
+        self._hot_ticks = 0
+        self._quiet_since: Optional[float] = None
+        self._last_scale_at: Optional[float] = None
+        self._last_est: Optional[float] = None
+
+    def enabled(self) -> bool:
+        return self.max_replicas > 0
+
+    def _cooled(self, now: float) -> bool:
+        return (self._last_scale_at is None
+                or now - self._last_scale_at >= self.cooldown_s)
+
+    def evaluate(self, queued: float, drain_rate_rps: float, live: int,
+                 now: float) -> Optional[ScaleDecision]:
+        """One probe tick: ``queued`` requests waiting fleet-wide,
+        ``drain_rate_rps`` the fleet's summed drain-rate EWMA, ``live``
+        routable replicas.  Returns a decision or None."""
+        if not self.enabled():
+            return None
+        est = (float(queued) / drain_rate_rps) \
+            if drain_rate_rps and drain_rate_rps > 0 else None
+        self._last_est = est
+        decision = None
+        if est is not None and est > self.up_frac * self.deadline_ref_s:
+            self._quiet_since = None
+            self._hot_ticks += 1
+            if (self._hot_ticks >= self.up_ticks
+                    and live < self.max_replicas and self._cooled(now)):
+                decision = ScaleDecision("up", est, live)
+        else:
+            self._hot_ticks = 0
+            if float(queued) <= 0:
+                if self._quiet_since is None:
+                    self._quiet_since = now
+                if (now - self._quiet_since >= self.quiet_s
+                        and live > self.min_replicas
+                        and self._cooled(now)):
+                    decision = ScaleDecision(
+                        "down", est if est is not None else 0.0, live)
+            else:
+                self._quiet_since = None
+        if decision is not None:
+            # cooldown starts at the DECISION, whether or not the scale
+            # attempt succeeds — a failing scale-up must not retry every
+            # tick into a storm
+            self._last_scale_at = now
+            self._hot_ticks = 0
+            self._quiet_since = None
+        return decision
+
+    def state(self, now: Optional[float] = None) -> dict:
+        """Introspection for /metrics: thresholds + live counters."""
+        cooldown_left = 0.0
+        if now is not None and self._last_scale_at is not None:
+            cooldown_left = max(
+                0.0, self.cooldown_s - (now - self._last_scale_at))
+        return {
+            "enabled": self.enabled(),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "up_threshold_s": self.up_frac * self.deadline_ref_s,
+            "hot_ticks": self._hot_ticks,
+            "quiet_for_s": (0.0 if self._quiet_since is None or now is None
+                            else max(0.0, now - self._quiet_since)),
+            "cooldown_remaining_s": cooldown_left,
+            "est_wait_s": self._last_est,
+        }

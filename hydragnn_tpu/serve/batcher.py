@@ -688,6 +688,10 @@ class MicroBatcher:
                 "max_wait_ms": self.max_wait_s * 1e3,
                 "drain_rate_rps": round(self._rate_ewma, 2)
                                   if self._rate_ewma else 0.0,
+                # the service-time half of the admission estimate, for
+                # consumers of the drain signal (autoscaler, budgets)
+                "predict_ewma_ms": round(self._predict_ewma_s * 1e3, 2)
+                                   if self._predict_ewma_s else 0.0,
                 "avg_fill_pct": (self._fill_sum / ok) if ok else 0.0,
                 "avg_pad_nodes_pct": (self._pad_nodes_sum / ok) if ok
                                      else 0.0,

@@ -21,6 +21,12 @@ from typing import Any, Dict, Optional, Tuple
 
 from hydragnn_tpu.utils.env import env_int
 
+# the implicit tenant every fleet serves: the checkpoint the engine was
+# built from.  Requests without a "model" field route here, and it is
+# never evicted from a replica's tenant pool.  (Lives in config.py so
+# server.py/fleet.py/router.py can all import it without cycles.)
+DEFAULT_TENANT = "default"
+
 
 def _parse_buckets(v) -> Tuple[int, ...]:
     if isinstance(v, str):
@@ -49,7 +55,13 @@ class ServingConfig:
     HYDRAGNN_SERVE_FLEET_MAX_RESTARTS,
     HYDRAGNN_SERVE_FLEET_RESTART_WINDOW_S, HYDRAGNN_SERVE_FLEET_DRAIN_S,
     HYDRAGNN_SERVE_FLEET_STARTUP_S, HYDRAGNN_SERVE_FLEET_QUORUM
-    (docs/SERVING.md "Replica fleet").
+    (docs/SERVING.md "Replica fleet"), and the autoscaler/tenancy knobs
+    HYDRAGNN_SERVE_FLEET_MIN, HYDRAGNN_SERVE_FLEET_MAX,
+    HYDRAGNN_SERVE_AUTOSCALE_UP_FRAC, HYDRAGNN_SERVE_AUTOSCALE_UP_TICKS,
+    HYDRAGNN_SERVE_AUTOSCALE_QUIET_S, HYDRAGNN_SERVE_AUTOSCALE_COOLDOWN_S,
+    HYDRAGNN_SERVE_MAX_TENANTS, HYDRAGNN_SERVE_TENANT_BUDGET_FRAC,
+    HYDRAGNN_SERVE_MAX_EXECUTABLES (docs/SERVING.md "Multi-tenant fleet
+    & autoscaler").
     """
 
     # batch-capacity ladder (graphs per bucket), ascending; each entry
@@ -147,6 +159,41 @@ class ServingConfig:
     # live replicas below this -> fleet_degraded telemetry + teleview
     # WARNING; 0 = majority (N//2 + 1)
     fleet_quorum: int = 0
+    # -- closed-loop autoscaler (serve/autoscale.py; docs/SERVING.md
+    #    "Multi-tenant fleet & autoscaler") --
+    # scale-down floor: the autoscaler never retires below this many
+    # live replicas
+    fleet_min_replicas: int = 1
+    # scale-up ceiling; 0 = autoscaler disabled (the static-fleet
+    # topology of PR 7 — fleet_replicas is the fixed size)
+    fleet_max_replicas: int = 0
+    # scale up when the drain-rate backlog estimate (queued work /
+    # fleet drain rate, the same EWMA the admission shed uses) exceeds
+    # this fraction of the request deadline
+    autoscale_up_frac: float = 0.5
+    # hysteresis: that many CONSECUTIVE hot probe ticks before a
+    # scale-up fires (one slow flush can't add a replica)
+    autoscale_up_ticks: int = 3
+    # scale down only after the fleet has been completely idle (zero
+    # queued work) for this long
+    autoscale_quiet_s: float = 60.0
+    # dead time after ANY scale event before the next may fire, so
+    # scaling can't flap or interact with restart storms
+    autoscale_cooldown_s: float = 30.0
+    # -- multi-tenancy --
+    # resident tenant engines per replica INCLUDING the default tenant;
+    # beyond this the least-recently-used extra tenant is evicted
+    # (re-admission is cheap: forks share the compiled cache)
+    max_tenants: int = 4
+    # per-tenant admission budget as a fraction of fleet capacity:
+    # cap = max(1, ceil(frac * drain_rate_rps * deadline_s)) outstanding
+    # requests per tenant; over budget -> 429 for THAT tenant only.
+    # 0 = budgets disabled (fleet-wide shed only).
+    tenant_budget_frac: float = 0.0
+    # bounded LRU over AOT executables in the engine compile cache, for
+    # structurally-distinct tenants; 0 = unbounded (single-tenant
+    # default).  Sizing below one tenant's bucket ladder thrashes.
+    max_resident_executables: int = 0
 
     def __post_init__(self):
         self.buckets = _parse_buckets(self.buckets)
@@ -173,17 +220,32 @@ class ServingConfig:
                      "reload_watch_s", "fleet_restart_backoff_s",
                      "fleet_restart_backoff_max_s",
                      "fleet_restart_window_s", "fleet_drain_timeout_s",
-                     "fleet_startup_timeout_s"):
+                     "fleet_startup_timeout_s", "autoscale_up_frac",
+                     "autoscale_quiet_s", "autoscale_cooldown_s",
+                     "tenant_budget_frac"):
             if float(getattr(self, name)) < 0:
                 raise ValueError(
                     f"Serving.{name} must be >= 0, "
                     f"got {getattr(self, name)}")
         for name in ("fleet_replicas", "fleet_max_restarts",
-                     "fleet_quorum"):
+                     "fleet_quorum", "fleet_max_replicas",
+                     "max_resident_executables"):
             if int(getattr(self, name)) < 0:
                 raise ValueError(
                     f"Serving.{name} must be >= 0, "
                     f"got {getattr(self, name)}")
+        for name in ("fleet_min_replicas", "autoscale_up_ticks",
+                     "max_tenants"):
+            if int(getattr(self, name)) < 1:
+                raise ValueError(
+                    f"Serving.{name} must be >= 1, "
+                    f"got {getattr(self, name)}")
+        if int(self.fleet_max_replicas) > 0 \
+                and int(self.fleet_min_replicas) \
+                > int(self.fleet_max_replicas):
+            raise ValueError(
+                f"Serving.fleet_min_replicas ({self.fleet_min_replicas}) "
+                f"exceeds fleet_max_replicas ({self.fleet_max_replicas})")
         if float(self.fleet_probe_s) <= 0:
             raise ValueError(
                 f"Serving.fleet_probe_s must be > 0, "
@@ -264,6 +326,23 @@ class ServingConfig:
             fleet_startup_timeout_s=float(s.get(
                 "fleet_startup_timeout_s", d.fleet_startup_timeout_s)),
             fleet_quorum=int(s.get("fleet_quorum", d.fleet_quorum)),
+            fleet_min_replicas=int(s.get("fleet_min_replicas",
+                                         d.fleet_min_replicas)),
+            fleet_max_replicas=int(s.get("fleet_max_replicas",
+                                         d.fleet_max_replicas)),
+            autoscale_up_frac=float(s.get("autoscale_up_frac",
+                                          d.autoscale_up_frac)),
+            autoscale_up_ticks=int(s.get("autoscale_up_ticks",
+                                         d.autoscale_up_ticks)),
+            autoscale_quiet_s=float(s.get("autoscale_quiet_s",
+                                          d.autoscale_quiet_s)),
+            autoscale_cooldown_s=float(s.get("autoscale_cooldown_s",
+                                             d.autoscale_cooldown_s)),
+            max_tenants=int(s.get("max_tenants", d.max_tenants)),
+            tenant_budget_frac=float(s.get("tenant_budget_frac",
+                                           d.tenant_budget_frac)),
+            max_resident_executables=int(s.get(
+                "max_resident_executables", d.max_resident_executables)),
         )
         if "HYDRAGNN_SERVE_BUCKETS" in os.environ:
             cfg.buckets = _parse_buckets(os.environ["HYDRAGNN_SERVE_BUCKETS"])
@@ -338,6 +417,34 @@ class ServingConfig:
         if "HYDRAGNN_SERVE_FLEET_QUORUM" in os.environ:
             cfg.fleet_quorum = env_int("HYDRAGNN_SERVE_FLEET_QUORUM",
                                        d.fleet_quorum)
+        if "HYDRAGNN_SERVE_FLEET_MIN" in os.environ:
+            cfg.fleet_min_replicas = env_int("HYDRAGNN_SERVE_FLEET_MIN",
+                                             d.fleet_min_replicas)
+        if "HYDRAGNN_SERVE_FLEET_MAX" in os.environ:
+            cfg.fleet_max_replicas = env_int("HYDRAGNN_SERVE_FLEET_MAX",
+                                             d.fleet_max_replicas)
+        if "HYDRAGNN_SERVE_AUTOSCALE_UP_FRAC" in os.environ:
+            cfg.autoscale_up_frac = float(
+                os.environ["HYDRAGNN_SERVE_AUTOSCALE_UP_FRAC"])
+        if "HYDRAGNN_SERVE_AUTOSCALE_UP_TICKS" in os.environ:
+            cfg.autoscale_up_ticks = env_int(
+                "HYDRAGNN_SERVE_AUTOSCALE_UP_TICKS", d.autoscale_up_ticks)
+        if "HYDRAGNN_SERVE_AUTOSCALE_QUIET_S" in os.environ:
+            cfg.autoscale_quiet_s = float(
+                os.environ["HYDRAGNN_SERVE_AUTOSCALE_QUIET_S"])
+        if "HYDRAGNN_SERVE_AUTOSCALE_COOLDOWN_S" in os.environ:
+            cfg.autoscale_cooldown_s = float(
+                os.environ["HYDRAGNN_SERVE_AUTOSCALE_COOLDOWN_S"])
+        if "HYDRAGNN_SERVE_MAX_TENANTS" in os.environ:
+            cfg.max_tenants = env_int("HYDRAGNN_SERVE_MAX_TENANTS",
+                                      d.max_tenants)
+        if "HYDRAGNN_SERVE_TENANT_BUDGET_FRAC" in os.environ:
+            cfg.tenant_budget_frac = float(
+                os.environ["HYDRAGNN_SERVE_TENANT_BUDGET_FRAC"])
+        if "HYDRAGNN_SERVE_MAX_EXECUTABLES" in os.environ:
+            cfg.max_resident_executables = env_int(
+                "HYDRAGNN_SERVE_MAX_EXECUTABLES",
+                d.max_resident_executables)
         # re-validate after the env overlay (the dataclass validated the
         # config values; env strings can be just as wrong)
         cfg.__post_init__()
@@ -380,4 +487,13 @@ def serving_defaults() -> Dict[str, Any]:
         "fleet_drain_timeout_s": d.fleet_drain_timeout_s,
         "fleet_startup_timeout_s": d.fleet_startup_timeout_s,
         "fleet_quorum": d.fleet_quorum,
+        "fleet_min_replicas": d.fleet_min_replicas,
+        "fleet_max_replicas": d.fleet_max_replicas,
+        "autoscale_up_frac": d.autoscale_up_frac,
+        "autoscale_up_ticks": d.autoscale_up_ticks,
+        "autoscale_quiet_s": d.autoscale_quiet_s,
+        "autoscale_cooldown_s": d.autoscale_cooldown_s,
+        "max_tenants": d.max_tenants,
+        "tenant_budget_frac": d.tenant_budget_frac,
+        "max_resident_executables": d.max_resident_executables,
     }

@@ -55,6 +55,7 @@ old state, so a reload drops zero requests.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import pickle
@@ -219,11 +220,16 @@ class InferenceEngine:
             "fallback": False,
         }
         self._golden_f32: Optional[List[np.ndarray]] = None
-        self._compiled: Dict[tuple, Any] = {}
+        # LRU order (oldest first) so Serving.max_resident_executables
+        # can bound residency for structurally-distinct tenants; with
+        # the 0 (unbounded) default this is a plain dict in practice
+        self._compiled: "collections.OrderedDict[tuple, Any]" = \
+            collections.OrderedDict()
         self._lock = threading.Lock()
         self._hits = 0
         self._misses = 0
         self._warmup_compiles = 0
+        self._evictions = 0
         # hot-reload machinery: previous state kept for instant rollback,
         # golden-batch reference outputs recorded at warmup
         self._reload_lock = threading.Lock()
@@ -430,6 +436,7 @@ class InferenceEngine:
         with self._lock:
             exe = self._compiled.get(key)
             if exe is not None:
+                self._compiled.move_to_end(key)  # LRU freshness
                 if not warmup:
                     self._hits += 1
                 return exe
@@ -452,8 +459,23 @@ class InferenceEngine:
         if state is None:
             state = self.state
         exe = self._eval_fn(policy).lower(state, batch).compile()
+        cap = int(self.serving.max_resident_executables)
+        evicted: List[tuple] = []
         with self._lock:
-            return self._compiled.setdefault(key, exe)
+            exe = self._compiled.setdefault(key, exe)
+            self._compiled.move_to_end(key)
+            # bounded residency for structurally-distinct tenants: drop
+            # the least-recently-used executables beyond the cap (a cap
+            # below one bucket ladder thrashes — docs/SERVING.md)
+            while cap > 0 and len(self._compiled) > cap:
+                old, _ = self._compiled.popitem(last=False)
+                self._evictions += 1
+                evicted.append(old)
+        for old in evicted:
+            self.telemetry.health(
+                "executable_evict", policy=old[0], nodes=old[1],
+                edges=old[2], graphs=old[3], cap=cap)
+        return exe
 
     def warmup(self) -> int:
         """AOT-compile every configured bucket (server startup), then
@@ -693,6 +715,7 @@ class InferenceEngine:
                 "hits": self._hits,
                 "misses": self._misses,
                 "warmup_compiles": self._warmup_compiles,
+                "evictions": self._evictions,
                 "hit_rate": (self._hits / total) if total else 1.0,
                 "compiled_buckets": len(self._compiled),
                 "buckets": [

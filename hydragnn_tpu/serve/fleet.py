@@ -86,12 +86,18 @@ from hydragnn_tpu.serve.batcher import (
     QueueFullError,
     RequestShedError,
 )
-from hydragnn_tpu.serve.config import ServingConfig
+from hydragnn_tpu.serve.config import DEFAULT_TENANT, ServingConfig
 
 
 class ReplicaDeadError(RuntimeError):
     """The replica died under this request (SIGKILL, worker exit,
     connection reset) — the router retries on a DIFFERENT replica."""
+
+
+class UnknownTenantError(Exception):
+    """The request names a model this fleet does not host (HTTP 404).
+    Never failed over: every replica hosts the same tenant set, so a
+    second replica would only repeat the answer."""
 
 
 @dataclass
@@ -100,11 +106,15 @@ class PredictRequest:
     to a replica: ``sample`` drives in-process dispatch, ``body`` (the
     JSON-encoded graph) drives the subprocess HTTP proxy — the deadline
     always travels separately as the REMAINING budget, so a retried
-    request never re-spends time a previous replica already burned."""
+    request never re-spends time a previous replica already burned.
+    ``tenant`` is the request's ``model`` field (default tenant when
+    absent): in-process replicas dispatch to that tenant's batcher,
+    subprocess replicas forward the body and let the child resolve it."""
 
     sample: Any = None          # GraphSample (in-process replicas)
     body: Optional[bytes] = None  # raw JSON body (subprocess replicas)
     num_nodes: int = 0
+    tenant: str = DEFAULT_TENANT
 
 
 def free_port() -> int:
@@ -173,13 +183,27 @@ class InProcessReplica:
     near-free restarts.  ``chaos_factory`` (optional) supplies a fresh
     inner ServeChaos per incarnation — per-replica fault injection for
     the breaker/ejection tests.
+
+    ``tenant_factories`` (optional) maps extra model names to engine
+    factories: a request whose ``model`` field names one dispatches to
+    that tenant's OWN engine + micro-batcher, built lazily on first use
+    and kept in a bounded LRU (``Serving.max_tenants`` resident per
+    replica, default tenant included and never evicted).  Tenant
+    factories are usually :meth:`InferenceEngine.fork` closures too —
+    structurally identical tenants share the compiled cache, so
+    admission and re-admission after eviction cost zero compiles; a
+    factory may ``reload_state`` different weights or carry its own
+    autotuned bucket ladder.  Tenant batchers share the replica's
+    breaker and chaos slot: replica-level failure semantics stay whole.
     """
 
     kind = "inprocess"
 
     def __init__(self, idx: int, engine_factory: Callable[[], Any],
                  serving: ServingConfig, telemetry,
-                 chaos_factory: Optional[Callable[[], Any]] = None):
+                 chaos_factory: Optional[Callable[[], Any]] = None,
+                 tenant_factories: Optional[
+                     Dict[str, Callable[[], Any]]] = None):
         self.idx = int(idx)
         self._engine_factory = engine_factory
         self._chaos_factory = chaos_factory
@@ -194,6 +218,12 @@ class InProcessReplica:
         self.chaos: Optional[_ReplicaChaos] = None
         self.outstanding = 0
         self._out_lock = threading.Lock()
+        self._tenant_factories = dict(tenant_factories or {})
+        # resident non-default tenants, LRU order (oldest first)
+        self._tenants: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._tenant_lock = threading.Lock()
+        self.tenant_evictions = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -224,6 +254,8 @@ class InProcessReplica:
             default_deadline_ms=s.request_deadline_ms,
             predict_timeout_s=s.predict_timeout_s, breaker=self.breaker,
             chaos=self.chaos).start()
+        with self._tenant_lock:
+            self._tenants = collections.OrderedDict()
         self._set_state("live")
 
     def _on_breaker_open(self) -> None:
@@ -237,6 +269,9 @@ class InProcessReplica:
     def stop(self, drain: bool = True) -> None:
         if self.chaos is not None:
             self.chaos.release()
+        for _, batcher in self._drop_tenants():
+            batcher.close(drain=drain,
+                          timeout=self.serving.drain_timeout_s)
         if self.batcher is not None:
             self.batcher.close(drain=drain,
                                timeout=self.serving.drain_timeout_s)
@@ -256,6 +291,8 @@ class InProcessReplica:
         like a real SIGKILL, which the victim never observes."""
         if self.chaos is not None:
             self.chaos.kill()
+        for _, batcher in self._drop_tenants():
+            batcher.close(drain=False)
         if self.batcher is not None:
             self.batcher.close(drain=False)
 
@@ -304,11 +341,72 @@ class InProcessReplica:
         b = self.batcher
         return b.retry_after_s() if b is not None else 1.0
 
+    # -- tenancy -------------------------------------------------------------
+
+    def tenants(self) -> List[str]:
+        """Every model name this replica can serve (resident or not)."""
+        return [DEFAULT_TENANT] + sorted(self._tenant_factories)
+
+    def _drop_tenants(self) -> List[Any]:
+        """Detach the whole tenant pool (stop/kill paths); the caller
+        closes the returned (name, batcher) pairs outside the lock."""
+        with self._tenant_lock:
+            tenants, self._tenants = self._tenants, \
+                collections.OrderedDict()
+        return [(name, batcher) for name, (_, batcher)
+                in tenants.items()]
+
+    def _tenant_batcher(self, name: str) -> MicroBatcher:
+        """The batcher serving tenant ``name``, building it on first
+        use and evicting the least-recently-used extra tenant beyond
+        ``max_tenants`` (eviction is cheap to undo — forks share the
+        compiled cache, so re-admission recompiles nothing)."""
+        if name == DEFAULT_TENANT:
+            return self.batcher
+        factory = self._tenant_factories.get(name)
+        if factory is None:
+            raise UnknownTenantError(
+                f"unknown model {name!r} (hosted: {self.tenants()})")
+        s = self.serving
+        evicted: List[Any] = []
+        with self._tenant_lock:
+            ent = self._tenants.get(name)
+            if ent is not None:
+                self._tenants.move_to_end(name)
+                return ent[1]
+            engine = factory()
+            if engine._golden is None:
+                engine.warmup()
+            batcher = MicroBatcher(
+                engine, max_wait_ms=s.max_wait_ms,
+                max_queue=s.max_queue, telemetry=self.telemetry,
+                default_deadline_ms=s.request_deadline_ms,
+                predict_timeout_s=s.predict_timeout_s,
+                breaker=self.breaker, chaos=self.chaos).start()
+            self._tenants[name] = (engine, batcher)
+            # default tenant occupies one resident slot but lives
+            # outside the pool; at least one extra stays admittable
+            cap = max(1, int(s.max_tenants) - 1)
+            while len(self._tenants) > cap:
+                old, (_, ob) = self._tenants.popitem(last=False)
+                evicted.append((old, ob))
+        for old, ob in evicted:
+            # short drain: the LRU tenant is idle by construction, and
+            # anything still queued fails over to a replica that will
+            # rebuild it
+            ob.close(drain=True, timeout=1.0)
+            self.tenant_evictions += 1
+            self.telemetry.health("tenant_evict", replica=self.idx,
+                                  tenant=old,
+                                  resident=len(self._tenants) + 1)
+        return batcher
+
     def predict(self, req: PredictRequest,
                 deadline_s: Optional[float]) -> Dict[str, Any]:
         """One attempt on THIS replica; shed/breaker/timeout/dead errors
         propagate for the router to map or fail over."""
-        fut = self.batcher.submit(req.sample, deadline_s=deadline_s)
+        fut = self._tenant_batcher(req.tenant).submit(
+            req.sample, deadline_s=deadline_s)
         if deadline_s is None:
             wait = 30.0
         else:
@@ -350,6 +448,20 @@ class InProcessReplica:
             out["drain_rate_rps"] = st["drain_rate_rps"]
             out["requests"] = st["requests"]
             out["batches"] = st["batches"]
+            # resident tenant batchers contribute to the replica's load
+            # signal — the autoscaler and the admission budgets must see
+            # EVERY queue, not just the default tenant's
+            with self._tenant_lock:
+                extras = list(self._tenants.items())
+            for _, (_, batcher) in extras:
+                ts = batcher.stats()
+                out["queue_depth"] += ts["queue_depth"]
+                out["drain_rate_rps"] += ts["drain_rate_rps"]
+                out["requests"] += ts["requests"]
+                out["batches"] += ts["batches"]
+            out["tenants_resident"] = \
+                [DEFAULT_TENANT] + [name for name, _ in extras]
+            out["tenant_evictions"] = self.tenant_evictions
         if self.engine is not None:
             out["reload"] = self.engine.reload_stats()
             cache = self.engine.cache_stats()
@@ -619,6 +731,10 @@ def _error_from_status(e: "urllib.error.HTTPError") -> Exception:
         from hydragnn_tpu.serve.engine import BucketOverflowError
 
         return BucketOverflowError(msg)
+    if e.code == 404:
+        # the child is a single-model server: an unknown "model" field
+        # 404s there, and no sibling replica would answer differently
+        return UnknownTenantError(msg)
     if e.code == 400:
         return ValueError(msg)
     return RuntimeError(f"replica error {e.code}: {msg}")
@@ -634,7 +750,8 @@ class FleetSupervisor:
     UNRESPONSIVE_PROBES = 3
 
     def __init__(self, replicas: List[Any], serving: ServingConfig,
-                 telemetry=None, chaos=None):
+                 telemetry=None, chaos=None, replica_factory=None,
+                 autoscaler=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.replicas = list(replicas)
@@ -645,6 +762,24 @@ class FleetSupervisor:
             telemetry = MetricsLogger.disabled()
         self.telemetry = telemetry
         self.chaos = chaos  # resilience.chaos.FleetChaos or None
+        # closed-loop autoscaling (serve/autoscale.py): with a factory
+        # for fresh replicas and fleet_max_replicas > 0, the probe loop
+        # evaluates the drain-rate policy once per tick
+        self._replica_factory = replica_factory
+        if autoscaler is None and replica_factory is not None \
+                and int(serving.fleet_max_replicas) > 0:
+            from hydragnn_tpu.serve.autoscale import FleetAutoscaler
+
+            autoscaler = FleetAutoscaler(serving)
+        self.autoscaler = autoscaler
+        # tenants the chaos layer marked hot THIS tick: the router sheds
+        # their traffic (429) as if their budget were exhausted
+        self.hot_tenants: set = set()
+        self._scale_fail_next = False
+        # last probe tick's load signal, cached for the router's
+        # per-tenant budget math (zero until the first armed tick)
+        self.last_queue_depth = 0.0
+        self.last_drain_rate = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -760,14 +895,24 @@ class FleetSupervisor:
     def probe_once(self) -> None:
         """One supervision tick (public so tests and the bench can drive
         deterministic ticks): apply armed chaos, check every replica,
-        update the quorum latch."""
+        update the quorum latch, evaluate the autoscaler."""
         if self.chaos is not None:
+            hot: set = set()
             for action, idx in self.chaos.on_probe():
-                self._apply_chaos(action, idx)
+                if action == "tenant_hot":
+                    # the target is a tenant NAME for this action
+                    hot.add(idx if idx is not None else DEFAULT_TENANT)
+                elif action == "scale_fail":
+                    with self._lock:
+                        self._scale_fail_next = True
+                else:
+                    self._apply_chaos(action, idx)
+            self.hot_tenants = hot
         now = time.monotonic()
-        for r in self.replicas:
+        for r in list(self.replicas):  # scale events mutate the list
             self._check(r, now)
         self._check_quorum()
+        self._autoscale(now)
 
     def _apply_chaos(self, action: str, idx: Optional[int]) -> None:
         if idx is not None:
@@ -924,6 +1069,116 @@ class FleetSupervisor:
                                   quorum=self.quorum)
         self._was_degraded = degraded
 
+    # -- closed-loop autoscaling (serve/autoscale.py) ------------------------
+
+    def _load_signal(self) -> "tuple":
+        """(queued, drain_rate_rps) summed over routable replicas: the
+        SAME numbers the admission shed divides, so the scaler and the
+        shed agree about overload by construction."""
+        queued = 0.0
+        rate = 0.0
+        for r in self.routable():
+            s = r.snapshot()
+            queued += float(s.get("queue_depth") or 0.0)
+            rate += float(s.get("drain_rate_rps") or 0.0)
+        return queued, rate
+
+    def _autoscale(self, now: float) -> None:
+        a = self.autoscaler
+        want_scale = a is not None and a.enabled()
+        # per-tenant budgets read the cached signal too — sampling it
+        # here (once per tick) keeps the request path free of
+        # per-request snapshot() calls
+        want_budget = float(self.serving.tenant_budget_frac) > 0
+        if not (want_scale or want_budget):
+            return
+        queued, rate = self._load_signal()
+        self.last_queue_depth = queued
+        self.last_drain_rate = rate
+        if not want_scale:
+            return
+        decision = a.evaluate(queued, rate, self.live_count(), now)
+        if decision is None:
+            return
+        if decision.direction == "up":
+            self.scale_up(signal=decision.signal)
+        else:
+            self.scale_down(signal=decision.signal)
+
+    def scale_up(self, signal: float = 0.0) -> bool:
+        """Add one replica (autoscaler "up", public for tests/tools):
+        build via the replica factory at the next free index, start it,
+        admit it to routing.  A failed start enters the normal dead ->
+        backoff-restart machinery instead of being retried inline — a
+        scale-up must never turn into a spawn storm."""
+        factory = self._replica_factory
+        if factory is None:
+            return False
+        with self._lock:
+            cap = int(self.serving.fleet_max_replicas)
+            if cap > 0 and len(self.replicas) >= cap:
+                return False
+            idx = max(r.idx for r in self.replicas) + 1
+            chaos_fail = self._scale_fail_next
+            self._scale_fail_next = False
+        r = factory(idx)
+        try:
+            r.start()
+        except Exception as e:  # noqa: BLE001 — hand off to backoff restart
+            with self._lock:
+                r.state = "dead"
+                self.replicas.append(r)
+                self._restart_at[r.idx] = \
+                    time.monotonic() + self._base_backoff
+            self.telemetry.health("fleet_scale_up", replica=r.idx,
+                                  signal=round(float(signal), 3),
+                                  live=self.live_count(), ok=False,
+                                  error=repr(e)[:200])
+            return False
+        with self._lock:
+            self.replicas.append(r)
+        if chaos_fail:
+            # chaos: the fresh replica dies the moment it joins — the
+            # backoff restart machinery must absorb it, and the cooldown
+            # must keep the scaler from stacking more spawns on top
+            r.kill()
+            self.mark_dead(r, reason="chaos_scale_fail")
+        self.telemetry.health("fleet_scale_up", replica=r.idx,
+                              signal=round(float(signal), 3),
+                              live=self.live_count(),
+                              replicas=len(self.replicas))
+        return True
+
+    def scale_down(self, signal: float = 0.0) -> bool:
+        """Retire one replica (autoscaler "down", public for tests and
+        tools) with ZERO dropped requests: highest-index live replica
+        leaves routing (state ``draining``), in-flight work completes,
+        drain-stop answers everything queued, then it is removed from
+        the pool entirely — the drain_and_replace discipline, minus the
+        replacement."""
+        with self._lock:
+            live = [x for x in self.replicas if x.state == "live"]
+            if len(live) <= max(1, int(self.serving.fleet_min_replicas)):
+                return False
+            r = max(live, key=lambda x: x.idx)
+            r.state = "draining"
+        self.telemetry.health("replica_drain", replica=r.idx)
+        deadline = time.monotonic() + self.serving.fleet_drain_timeout_s
+        while r.outstanding > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        r.stop(drain=True)
+        with self._lock:
+            self.replicas = [x for x in self.replicas if x is not r]
+            for d in (self._backoff, self._restart_at,
+                      self._last_restart, self._restart_times,
+                      self._unresponsive, self._replica_gen):
+                d.pop(r.idx, None)
+        self.telemetry.health("fleet_scale_down", replica=r.idx,
+                              signal=round(float(signal), 3),
+                              live=self.live_count(),
+                              replicas=len(self.replicas))
+        return True
+
     # -- drain-and-replace ---------------------------------------------------
 
     def drain_and_replace(self, idx: int) -> bool:
@@ -1022,10 +1277,11 @@ class FleetSupervisor:
         # approaches it, in when it dwarfs the offered load
         drain_sum = sum(float(s.get("drain_rate_rps") or 0.0)
                         for s in reps)
+        queue_sum = sum(float(s.get("queue_depth") or 0.0) for s in reps)
         cache = {k: sum(int((s.get("cache") or {}).get(k, 0))
                         for s in reps)
                  for k in ("hits", "misses", "warmup_compiles")}
-        return {
+        out = {
             "replicas": reps,
             "total": len(self.replicas),
             "live": live,
@@ -1034,10 +1290,22 @@ class FleetSupervisor:
             "below_quorum": live < self.quorum,
             "restarts_total": sum(int(s.get("restarts", 0)) for s in reps),
             "drain_rate_rps_sum": round(drain_sum, 2),
+            # the dividend of the backlog estimate the autoscaler and
+            # the per-tenant budgets both derive from drain_rate_rps_sum
+            "queue_depth_sum": round(queue_sum, 2),
             # fleet-wide compile-cache totals: steady state must stay at
             # zero misses across EVERY replica, restarts included
             "cache": cache,
         }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.state(
+                now=time.monotonic())
+        if self.hot_tenants:
+            out["hot_tenants"] = sorted(self.hot_tenants)
+        evs = sum(int(s.get("tenant_evictions") or 0) for s in reps)
+        if evs:
+            out["tenant_evictions"] = evs
+        return out
 
 
 def spawn_argv(config_path: str, logs_dir: str = "./logs/") -> Any:

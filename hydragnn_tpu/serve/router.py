@@ -34,6 +34,7 @@ reload with first-replica rollback (FleetSupervisor.rolling_reload).
 from __future__ import annotations
 
 import json
+import math
 import random
 import threading
 import time
@@ -49,11 +50,12 @@ from hydragnn_tpu.serve.batcher import (
     QueueFullError,
     RequestShedError,
 )
-from hydragnn_tpu.serve.config import ServingConfig
+from hydragnn_tpu.serve.config import DEFAULT_TENANT, ServingConfig
 from hydragnn_tpu.serve.fleet import (
     FleetSupervisor,
     PredictRequest,
     ReplicaDeadError,
+    UnknownTenantError,
 )
 from hydragnn_tpu.serve.server import (
     JsonRequestHandler,
@@ -109,8 +111,12 @@ class FleetRouter:
         self._n: Dict[str, int] = {
             "requests": 0, "responses_200": 0, "failovers": 0,
             "shed_attempts": 0, "saturated_429": 0, "empty_503": 0,
-            "errors": 0}
+            "tenant_shed_429": 0, "errors": 0}
         self._per_replica: Dict[int, int] = {}
+        # per-tenant admission state: outstanding counts gate the
+        # budget, the counters feed /metrics "tenancy"
+        self._tenant_out: Dict[str, int] = {}
+        self._per_tenant: Dict[str, Dict[str, int]] = {}
         self._was_empty = False
         self._t0 = time.time()
         # bind in the constructor (same contract as InferenceServer):
@@ -145,14 +151,87 @@ class FleetRouter:
         request's deadline budget runs out, every live replica shed it
         (:class:`FleetSaturatedError` -> 429 with the MIN surviving
         drain estimate), or none remain (:class:`FleetEmptyError` ->
-        503).  Returns ``{"heads": ..., "replica": idx}``."""
+        503).  The request first clears its tenant's admission gate
+        (:meth:`_admit_tenant` -> 429 for THAT tenant only).  Returns
+        ``{"heads": ..., "replica": idx}``."""
+        with self._lock:
+            self._n["requests"] += 1
+            tn = self._per_tenant.setdefault(
+                req.tenant,
+                {"requests": 0, "responses_200": 0, "shed_429": 0})
+            tn["requests"] += 1
+        self._admit_tenant(req.tenant, deadline_s)
+        try:
+            out = self._dispatch(req, deadline_s)
+        finally:
+            with self._lock:
+                self._tenant_out[req.tenant] = max(
+                    0, self._tenant_out.get(req.tenant, 1) - 1)
+        with self._lock:
+            self._per_tenant[req.tenant]["responses_200"] += 1
+        return out
+
+    def _tenant_cap(self, deadline_s: Optional[float]) -> Optional[int]:
+        """Per-tenant outstanding-work cap: the share of the fleet's
+        measured drain rate (last probe tick's EWMA sum) one tenant may
+        hold for a deadline's worth of time —
+        ``ceil(tenant_budget_frac * drain_rate_rps * deadline_s)``.
+        None (no cap) when budgets are off or before the first drain
+        sample: cold start never sheds, same rule as the admission
+        shed."""
+        frac = float(self.serving.tenant_budget_frac)
+        if frac <= 0:
+            return None
+        rate = float(getattr(self.fleet, "last_drain_rate", 0.0) or 0.0)
+        if rate <= 0:
+            return None
+        ref = deadline_s if deadline_s and deadline_s > 0 \
+            else ((self.serving.request_deadline_ms / 1e3) or 1.0)
+        return max(1, math.ceil(frac * rate * ref))
+
+    def _admit_tenant(self, tenant: str,
+                      deadline_s: Optional[float]) -> None:
+        """Tenant admission gate; on admit the tenant's outstanding
+        count is already incremented (route_predict releases it).
+        Sheds (429) when the tenant is over its budget cap or marked
+        hot by chaos — the OTHER tenants' traffic is untouched, which
+        is the whole point."""
+        hot = tenant in getattr(self.fleet, "hot_tenants", set())
+        cap = None if hot else self._tenant_cap(deadline_s)
+        with self._lock:
+            out = self._tenant_out.get(tenant, 0)
+            shed = hot or (cap is not None and out >= cap)
+            if not shed:
+                self._tenant_out[tenant] = out + 1
+            else:
+                self._n["tenant_shed_429"] += 1
+                self._per_tenant.setdefault(
+                    tenant,
+                    {"requests": 0, "responses_200": 0, "shed_429": 0}
+                )["shed_429"] += 1
+        if not shed:
+            return
+        if hot:
+            self.telemetry.health("tenant_shed", tenant=tenant,
+                                  reason="chaos_hot")
+            raise RequestShedError(
+                f"tenant {tenant!r} marked hot (chaos)",
+                retry_after_s=1.0)
+        rate = float(getattr(self.fleet, "last_drain_rate", 0.0) or 0.0)
+        retry = max(1.0, out / rate) if rate > 0 else 1.0
+        self.telemetry.health("tenant_shed", tenant=tenant,
+                              reason="budget", outstanding=out, cap=cap)
+        raise RequestShedError(
+            f"tenant {tenant!r} over its admission budget "
+            f"({out}/{cap} outstanding)", retry_after_s=retry)
+
+    def _dispatch(self, req: PredictRequest,
+                  deadline_s: Optional[float]) -> Dict[str, Any]:
         deadline_abs = None if deadline_s is None \
             else time.perf_counter() + deadline_s
         tried: set = set()
         shed_estimates: List[float] = []
         last_exc: Optional[Exception] = None
-        with self._lock:
-            self._n["requests"] += 1
         while True:
             live = self.fleet.routable()
             if not live:
@@ -247,6 +326,10 @@ class FleetRouter:
                 last_exc = PredictTimeoutError(
                     "replica did not answer within the request budget")
                 continue
+            except UnknownTenantError:
+                # terminal: every replica hosts the SAME tenant set, so
+                # failing over would only repeat the 404
+                raise
             except (ValueError, FileNotFoundError):
                 # client error (subprocess replicas validate bodies
                 # themselves): not retryable, not a replica fault
@@ -313,6 +396,9 @@ class FleetRouter:
                     deadline_s = router.serving.request_deadline_ms / 1e3
                 try:
                     out = router.route_predict(req, deadline_s)
+                except UnknownTenantError as e:
+                    self._reply(404, {"error": str(e)})
+                    return
                 except FleetEmptyError as e:
                     self._reply(503, {"error": str(e), "fleet": "empty"},
                                 headers=self._retry_after(e.retry_after_s))
@@ -395,7 +481,14 @@ class FleetRouter:
 
     def build_request(self, obj: Dict[str, Any]) -> PredictRequest:
         """Parse/validate once at the router (in-process fleets), or
-        package the raw body for proxying (subprocess fleets)."""
+        package the raw body for proxying (subprocess fleets).  The
+        optional ``model`` field selects the tenant; whether the fleet
+        hosts it is decided at dispatch (UnknownTenantError -> 404)."""
+        tenant = DEFAULT_TENANT
+        if isinstance(obj, dict) and "model" in obj:
+            tenant = obj["model"]
+            if not isinstance(tenant, str) or not tenant:
+                raise ValueError('"model" must be a non-empty string')
         if self._parse:
             sample = sample_from_json(
                 obj, self.cfg,
@@ -406,11 +499,13 @@ class FleetRouter:
             if self.fleet.replicas[0].kind == "subprocess":
                 body = json.dumps(obj).encode()
             return PredictRequest(sample=sample, body=body,
-                                  num_nodes=int(sample.num_nodes))
+                                  num_nodes=int(sample.num_nodes),
+                                  tenant=tenant)
         if not isinstance(obj, dict):
             raise ValueError("request body must be a JSON object")
         n = len(obj.get("x") or ())
-        return PredictRequest(body=json.dumps(obj).encode(), num_nodes=n)
+        return PredictRequest(body=json.dumps(obj).encode(), num_nodes=n,
+                              tenant=tenant)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -482,9 +577,21 @@ class FleetRouter:
             router = dict(self._n)
             per_replica = {str(k): v
                            for k, v in sorted(self._per_replica.items())}
+            per_tenant = {k: dict(v)
+                          for k, v in sorted(self._per_tenant.items())}
+            tenant_out = {k: v for k, v in
+                          sorted(self._tenant_out.items()) if v}
         cache = dict(snap["cache"])
         total = cache["hits"] + cache["misses"]
         cache["hit_rate"] = (cache["hits"] / total) if total else 1.0
+        autoscale = {"signal": "drain_rate_rps_sum",
+                     "value": snap["drain_rate_rps_sum"],
+                     "queued": snap.get("queue_depth_sum", 0.0),
+                     "live": snap["live"]}
+        if "autoscaler" in snap:
+            # the closed loop's policy state: thresholds, hysteresis
+            # counters, cooldown — ROADMAP item 3's consumer
+            autoscale["policy"] = snap["autoscaler"]
         return {
             "uptime_s": round(time.time() - self._t0, 3),
             "fleet": snap,
@@ -495,8 +602,12 @@ class FleetRouter:
             "router": {**router, "per_replica_200": per_replica},
             # the autoscaling signal (ROADMAP item 1): fleet service
             # capacity as the sum of per-replica drain-rate EWMAs
-            "autoscale": {"signal": "drain_rate_rps_sum",
-                          "value": snap["drain_rate_rps_sum"],
-                          "live": snap["live"]},
+            "autoscale": autoscale,
+            "tenancy": {
+                "per_tenant": per_tenant,
+                "outstanding": tenant_out,
+                "budget_frac": float(self.serving.tenant_budget_frac),
+                "hot": sorted(getattr(self.fleet, "hot_tenants", ())),
+            },
             "health_events": self.telemetry.health_counts,
         }

@@ -80,7 +80,7 @@ from hydragnn_tpu.serve.batcher import (
     QueueFullError,
     RequestShedError,
 )
-from hydragnn_tpu.serve.config import ServingConfig
+from hydragnn_tpu.serve.config import DEFAULT_TENANT, ServingConfig
 from hydragnn_tpu.serve.engine import (
     BucketOverflowError,
     InferenceEngine,
@@ -363,6 +363,18 @@ class InferenceServer:
                 t0 = time.perf_counter()
                 try:
                     obj = self._read_json()
+                    model = obj.get("model") if isinstance(obj, dict) \
+                        else None
+                    if model is not None and model != DEFAULT_TENANT:
+                        # single-model server (also the subprocess fleet
+                        # replica): tenancy lives in the in-process
+                        # fleet; an unknown model is a 404, not a 400 —
+                        # the router maps it to UnknownTenantError
+                        self._reply(404, {
+                            "error": f"unknown model {model!r}: this "
+                                     "server hosts a single model "
+                                     f"({DEFAULT_TENANT!r})"})
+                        return
                     deadline_s = extract_deadline_s(self.headers, obj)
                     sample = sample_from_json(
                         obj, server.engine.cfg,

@@ -660,7 +660,8 @@ def test_fleet_chaos_env_parsing(monkeypatch):
     assert c.on_probe() == [("flap", None)]
     assert c.on_probe() == [("kill", 1), ("flap", None)]
     assert c.on_probe() == [("flap", None)]
-    assert c.injected == {"kill": 1, "hang": 0, "flap": 3}
+    assert c.injected == {"kill": 1, "hang": 0, "flap": 3,
+                          "tenant_hot": 0, "scale_fail": 0}
     # config-dict spelling, env wins
     monkeypatch.delenv("HYDRAGNN_CHAOS_REPLICA_HANG")
     monkeypatch.delenv("HYDRAGNN_CHAOS_REPLICA_FLAP")

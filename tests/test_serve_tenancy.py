@@ -1,0 +1,623 @@
+"""Closed-loop autoscaler + multi-tenant fleet (docs/SERVING.md
+"Multi-tenant fleet & autoscaler"): the FleetAutoscaler state machine
+(hysteresis, cooldown, bounds, cold-start never scales) on a fake
+clock, supervisor scale-up/scale-down through the replica factory
+(zero-drop retirement, chaos-failed spawns absorbed by the backoff
+restart machinery), tenant-aware routing (``model`` field -> per-tenant
+fork engines behind the bounded LRU, 404 for unknown tenants with NO
+failover), per-tenant admission budgets + chaos hot-tenant shedding
+(one tenant's 429s leave the others serving), the engine
+AOT-executable LRU, and the new Serving/FleetChaos knob plumbing.
+
+Tier-1 budget discipline: same as test_serve_fleet.py — ONE tiny SAGE
+engine with ONE bucket compiled once for the module; replicas AND
+tenants are ``engine.fork()``s sharing that compile cache, so
+multi-tenant fleets cost milliseconds and tenant admission costs zero
+compiles.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.resilience import FleetChaos
+from hydragnn_tpu.serve import (
+    DEFAULT_TENANT,
+    FleetAutoscaler,
+    FleetRouter,
+    FleetSupervisor,
+    InProcessReplica,
+    InferenceEngine,
+    InferenceState,
+    ServingConfig,
+)
+from hydragnn_tpu.serve.batcher import RequestShedError
+
+_HEADS = [HeadSpec("energy", "graph", 1)]
+
+
+def _sample(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    pos = rng.rand(n, 3).astype(np.float32) * 2.0
+    return GraphSample(x=rng.rand(n, 1).astype(np.float32), pos=pos,
+                       edge_index=radius_graph(pos, 1.2, 8))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One tiny SAGE engine, ONE bucket, compiled once for the module;
+    replicas and tenants all fork it (shared executable cache)."""
+    import jax
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    pads = [PadSpec.for_batch(4, 16, 64)]
+    example = collate([_sample()], pads[0], _HEADS)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    eng = InferenceEngine(cfg, state, _HEADS, pads)
+    eng.warmup()
+    return eng
+
+
+class _Tel:
+    """Recording telemetry stub (same shape as test_serve_fleet's)."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def health(self, kind, **fields):
+        with self._lock:
+            self.events.append((kind, fields))
+
+    @property
+    def health_counts(self):
+        with self._lock:
+            out = {}
+            for k, _ in self.events:
+                out[k] = out.get(k, 0) + 1
+            return out
+
+    def kinds(self, kind):
+        with self._lock:
+            return [f for k, f in self.events if k == kind]
+
+    def serve_step(self, *a, **kw):
+        # the micro-batcher emits a full step record per flush when a
+        # replica shares this recording stub (tel_replicas=True)
+        pass
+
+
+def _mk_router(engine, n=2, tenants=("ta", "tb"), fleet_chaos=None,
+               tel_replicas=False, **overrides):
+    """Multi-tenant fleet helper: every replica (including ones the
+    autoscaler adds through the factory) hosts the same tenant set as
+    fork closures of the module engine.  ``tel_replicas`` routes the
+    replicas' own events (tenant_evict) into the recording telemetry
+    instead of the disabled logger."""
+    kw = dict(port=0, max_wait_ms=2, request_deadline_ms=10_000.0,
+              breaker_threshold=2, breaker_cooldown_s=0.25,
+              predict_timeout_s=5.0, fleet_probe_s=0.02,
+              fleet_restart_backoff_s=0.05,
+              fleet_restart_backoff_max_s=0.4, fleet_max_restarts=6,
+              fleet_restart_window_s=30.0, fleet_drain_timeout_s=5.0)
+    kw.update(overrides)
+    serving = ServingConfig(**kw)
+    tel = _Tel()
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    tfs = {name: engine.fork for name in tenants}
+
+    rtel = tel if tel_replicas else MetricsLogger.disabled()
+
+    def factory(i):
+        return InProcessReplica(i, engine.fork, serving, rtel,
+                                tenant_factories=tfs)
+
+    replicas = [factory(i) for i in range(n)]
+    fleet = FleetSupervisor(replicas, serving, telemetry=tel,
+                            chaos=fleet_chaos, replica_factory=factory)
+    router = FleetRouter(fleet, serving=serving, cfg=engine.cfg,
+                         telemetry=tel)
+    router.start()
+    return router
+
+
+def _wait_until(cond, timeout=10.0, step=0.01):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def _post(port, path, obj, timeout=30.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _sample_json(s, **extra):
+    return {"x": s.x.tolist(), "pos": s.pos.tolist(),
+            "edge_index": s.edge_index.tolist(), **extra}
+
+
+# ---------------------------------------------------------------------------
+# FleetAutoscaler: the pure state machine on a fake clock
+# ---------------------------------------------------------------------------
+
+
+def _scaler(**overrides):
+    kw = dict(fleet_min_replicas=1, fleet_max_replicas=4,
+              autoscale_up_frac=0.5, autoscale_up_ticks=3,
+              autoscale_quiet_s=60.0, autoscale_cooldown_s=30.0,
+              request_deadline_ms=10_000.0)
+    kw.update(overrides)
+    return FleetAutoscaler(ServingConfig(port=0, **kw))
+
+
+def test_autoscaler_disabled_without_max():
+    a = _scaler(fleet_max_replicas=0)
+    assert not a.enabled()
+    assert a.evaluate(1e9, 1.0, 1, now=0.0) is None
+
+
+def test_scale_up_after_exactly_up_ticks():
+    """est = queued/rate = 100 s >> 5 s threshold: the decision fires
+    on the up_ticks-th CONSECUTIVE hot tick, not before."""
+    a = _scaler()
+    assert a.evaluate(100.0, 1.0, 1, now=0.0) is None
+    assert a.evaluate(100.0, 1.0, 1, now=1.0) is None
+    d = a.evaluate(100.0, 1.0, 1, now=2.0)
+    assert d is not None and d.direction == "up"
+    assert d.signal == pytest.approx(100.0) and d.live == 1
+
+
+def test_hysteresis_one_cool_tick_resets():
+    a = _scaler()
+    a.evaluate(100.0, 1.0, 1, now=0.0)
+    a.evaluate(100.0, 1.0, 1, now=1.0)
+    # est 1 s < 5 s threshold — the streak resets
+    assert a.evaluate(1.0, 1.0, 1, now=2.0) is None
+    assert a.evaluate(100.0, 1.0, 1, now=3.0) is None
+    assert a.evaluate(100.0, 1.0, 1, now=4.0) is None
+    assert a.evaluate(100.0, 1.0, 1, now=5.0).direction == "up"
+
+
+def test_cold_start_never_scales_up():
+    """No drain-rate sample -> no backlog estimate -> never hot, same
+    rule as the admission shed's cold-start never-sheds."""
+    a = _scaler(autoscale_up_ticks=1)
+    for t in range(5):
+        assert a.evaluate(1e6, 0.0, 1, now=float(t)) is None
+    assert a.state()["est_wait_s"] is None
+
+
+def test_up_bounded_by_max_replicas():
+    a = _scaler(autoscale_up_ticks=1, fleet_max_replicas=2)
+    assert a.evaluate(100.0, 1.0, 2, now=0.0) is None  # live == max
+    assert a.evaluate(100.0, 1.0, 1, now=1.0).direction == "up"
+
+
+def test_cooldown_blocks_back_to_back_decisions():
+    a = _scaler(autoscale_up_ticks=1, autoscale_cooldown_s=10.0)
+    assert a.evaluate(100.0, 1.0, 1, now=0.0).direction == "up"
+    # still hot, but inside the cooldown window
+    assert a.evaluate(100.0, 1.0, 2, now=5.0) is None
+    assert a.evaluate(100.0, 1.0, 2, now=9.9) is None
+    # cooldown elapsed: the sustained-hot streak fires immediately
+    d = a.evaluate(100.0, 1.0, 2, now=10.0)
+    assert d is not None and d.direction == "up"
+
+
+def test_quiet_window_scale_down_and_min_bound():
+    a = _scaler(autoscale_quiet_s=5.0, autoscale_cooldown_s=0.0,
+                fleet_min_replicas=1)
+    assert a.evaluate(0.0, 1.0, 2, now=0.0) is None  # quiet timer starts
+    assert a.evaluate(0.0, 1.0, 2, now=4.0) is None
+    d = a.evaluate(0.0, 1.0, 2, now=5.0)
+    assert d is not None and d.direction == "down" and d.live == 2
+    # at the floor: quiet forever, never below min
+    for t in range(6, 20):
+        assert a.evaluate(0.0, 1.0, 1, now=float(t)) is None
+
+
+def test_queued_work_resets_quiet_timer():
+    a = _scaler(autoscale_quiet_s=5.0, autoscale_cooldown_s=0.0)
+    a.evaluate(0.0, 10.0, 2, now=0.0)
+    # backlog below the hot threshold but non-empty: not quiet
+    a.evaluate(3.0, 10.0, 2, now=4.0)
+    assert a.evaluate(0.0, 10.0, 2, now=8.0) is None  # timer restarted
+    assert a.evaluate(0.0, 10.0, 2, now=9.5) is None
+    assert a.evaluate(0.0, 10.0, 2, now=13.0).direction == "down"
+
+
+def test_autoscaler_state_dict():
+    a = _scaler()
+    a.evaluate(100.0, 1.0, 1, now=0.0)
+    st = a.state(now=1.0)
+    assert st["enabled"] and st["max_replicas"] == 4
+    assert st["up_threshold_s"] == pytest.approx(5.0)
+    assert st["hot_ticks"] == 1
+    assert st["est_wait_s"] == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor scale-up / scale-down through the replica factory
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_and_bounds(engine):
+    router = _mk_router(engine, n=2, fleet_max_replicas=3)
+    fleet = router.fleet
+    try:
+        assert fleet.scale_up(signal=7.5) is True
+        assert _wait_until(lambda: fleet.live_count() == 3)
+        ev = router.telemetry.kinds("fleet_scale_up")
+        assert ev and ev[-1]["signal"] == pytest.approx(7.5)
+        assert ev[-1]["replica"] == 2 and ev[-1]["replicas"] == 3
+        # at the ceiling: refused without touching the pool
+        assert fleet.scale_up() is False
+        assert len(fleet.replicas) == 3
+        # the new replica actually serves
+        code, out = _post(router.port, "/predict", _sample_json(_sample()))
+        assert code == 200 and out["replica"] in (0, 1, 2)
+    finally:
+        router.shutdown()
+
+
+def test_scale_down_zero_drop(engine):
+    """Retirement drains: requests racing the scale-down all answer
+    200 and the highest-index replica leaves the pool."""
+    router = _mk_router(engine, n=3, fleet_max_replicas=4,
+                        fleet_min_replicas=1)
+    fleet = router.fleet
+    try:
+        results = []
+        lock = threading.Lock()
+
+        def fire(i):
+            try:
+                code, _ = _post(router.port, "/predict",
+                                _sample_json(_sample(5, seed=i)))
+            except urllib.error.HTTPError as e:
+                code = e.code
+            with lock:
+                results.append(code)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        assert fleet.scale_down(signal=0.1) is True
+        for t in threads:
+            t.join(timeout=30.0)
+        assert results == [200] * 12
+        assert len(fleet.replicas) == 2
+        assert {r.idx for r in fleet.replicas} == {0, 1}
+        ev = router.telemetry.kinds("fleet_scale_down")
+        assert ev and ev[-1]["replica"] == 2 and ev[-1]["replicas"] == 2
+        # below min+1 live: refused
+        fleet.scale_down()
+        assert fleet.scale_down() is False or len(fleet.replicas) == 1
+    finally:
+        router.shutdown()
+
+
+def test_closed_loop_scales_up_then_down(engine):
+    """The probe loop drives the whole loop: a sustained backlog signal
+    grows the fleet to max, a sustained quiet window shrinks it back to
+    min — each transition a health event carrying the signal."""
+    router = _mk_router(engine, n=1, fleet_max_replicas=3,
+                        fleet_min_replicas=1, autoscale_up_ticks=2,
+                        autoscale_cooldown_s=0.0, autoscale_quiet_s=0.15)
+    fleet = router.fleet
+    try:
+        assert fleet.autoscaler is not None and fleet.autoscaler.enabled()
+        # 50 requests queued against 1 rps drain: est 50 s >> 5 s
+        fleet._load_signal = lambda: (50.0, 1.0)
+        assert _wait_until(lambda: fleet.live_count() == 3)
+        ups = router.telemetry.kinds("fleet_scale_up")
+        assert len(ups) == 2
+        assert all(e["signal"] == pytest.approx(50.0) for e in ups)
+        m = _get(router.port, "/metrics")
+        assert m["autoscale"]["policy"]["max_replicas"] == 3
+        # drained: quiet window retires back to the floor
+        fleet._load_signal = lambda: (0.0, 1.0)
+        assert _wait_until(lambda: fleet.live_count() == 1)
+        downs = router.telemetry.kinds("fleet_scale_down")
+        assert len(downs) == 2
+        assert router.metrics()["router"]["errors"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_chaos_scale_fail_absorbed_by_restart(engine):
+    """HYDRAGNN_CHAOS_SCALE_FAIL: the autoscaler's fresh replica dies
+    the moment it joins; the backoff-restart machinery (not an inline
+    retry storm) brings it back."""
+    chaos = FleetChaos.from_env({"scale_fail": "1"})
+    router = _mk_router(engine, n=1, fleet_chaos=chaos,
+                        fleet_max_replicas=2, autoscale_up_ticks=1,
+                        autoscale_cooldown_s=30.0)
+    fleet = router.fleet
+    try:
+        fleet._load_signal = lambda: (50.0, 1.0)
+        assert _wait_until(lambda: any(
+            f.get("reason") == "chaos_scale_fail"
+            for f in router.telemetry.kinds("replica_dead")))
+        # the supervisor restarts the chaos-killed spawn under backoff
+        assert _wait_until(lambda: fleet.live_count() == 2)
+        assert any(f["replica"] == 1
+                   for f in router.telemetry.kinds("replica_restart"))
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tenancy: routing, LRU, isolation
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_routing_and_unknown_404(engine):
+    router = _mk_router(engine, n=2)
+    try:
+        for model in (None, "ta", "tb"):
+            body = _sample_json(_sample())
+            if model is not None:
+                body["model"] = model
+            code, out = _post(router.port, "/predict", body)
+            assert code == 200
+            assert len(out["heads"]["energy"]) == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(), model="nope"))
+        assert ei.value.code == 404
+        assert "nope" in json.loads(ei.value.read())["error"]
+        # unknown tenant is terminal: no failover retries burned on it
+        assert router.metrics()["router"]["failovers"] == 0
+        snap = router.fleet.snapshot()
+        res = snap["replicas"][0]["tenants_resident"]
+        assert res[0] == DEFAULT_TENANT and set(res[1:]) <= {"ta", "tb"}
+    finally:
+        router.shutdown()
+
+
+def test_tenant_lru_eviction_recompiles_nothing(engine):
+    """max_tenants=2 leaves ONE extra resident slot: touching ta then
+    tb evicts ta (tenant_evict), re-touching ta re-admits it — and the
+    shared fork cache means the whole dance compiles nothing."""
+    misses_before = engine.cache_stats()["misses"]
+    router = _mk_router(engine, n=1, max_tenants=2, tel_replicas=True)
+    try:
+        for model in ("ta", "tb", "ta"):
+            code, _ = _post(router.port, "/predict",
+                            _sample_json(_sample(), model=model))
+            assert code == 200
+        snap = router.fleet.snapshot()["replicas"][0]
+        assert snap["tenant_evictions"] >= 2
+        assert snap["tenants_resident"] == [DEFAULT_TENANT, "ta"]
+        ev = router.telemetry.kinds("tenant_evict")
+        assert [e["tenant"] for e in ev][:2] == ["ta", "tb"]
+        assert engine.cache_stats()["misses"] == misses_before
+    finally:
+        router.shutdown()
+
+
+def test_chaos_hot_tenant_sheds_only_that_tenant(engine):
+    """HYDRAGNN_CHAOS_TENANT_HOT marks tb hot from tick 1 on: tb gets
+    429 + Retry-After, the default tenant and ta keep serving 200."""
+    chaos = FleetChaos.from_env({"tenant_hot": "1+:tb"})
+    router = _mk_router(engine, n=2, fleet_chaos=chaos)
+    try:
+        assert _wait_until(lambda: "tb" in router.fleet.hot_tenants)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(), model="tb"))
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") is not None
+        for model in (None, "ta"):
+            body = _sample_json(_sample())
+            if model is not None:
+                body["model"] = model
+            code, _ = _post(router.port, "/predict", body)
+            assert code == 200
+        m = router.metrics()
+        assert m["tenancy"]["hot"] == ["tb"]
+        assert m["tenancy"]["per_tenant"]["tb"]["shed_429"] >= 1
+        assert m["tenancy"]["per_tenant"]["ta"]["shed_429"] == 0
+        sheds = router.telemetry.kinds("tenant_shed")
+        assert sheds and all(f["reason"] == "chaos_hot" for f in sheds)
+    finally:
+        router.shutdown()
+
+
+def test_tenant_budget_shed_isolates(engine):
+    """Per-tenant admission budget: cap = ceil(frac * drain_rate *
+    deadline).  A tenant over its outstanding cap sheds 429
+    (reason=budget) while the other tenants' traffic is untouched."""
+    router = _mk_router(engine, n=1, tenant_budget_frac=0.04)
+    fleet = router.fleet
+    try:
+        # pin the measured drain rate the cap derives from (the probe
+        # loop caches whatever _load_signal reports)
+        fleet._load_signal = lambda: (0.0, 5.0)
+        assert _wait_until(lambda: fleet.last_drain_rate == 5.0)
+        # cap = ceil(0.04 * 5 rps * 10 s) = 2; saturate tb's slots
+        with router._lock:
+            router._tenant_out["tb"] = 2
+            router._per_tenant["tb"] = {
+                "requests": 0, "responses_200": 0, "shed_429": 0}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/predict",
+                  _sample_json(_sample(), model="tb"))
+        assert ei.value.code == 429
+        code, _ = _post(router.port, "/predict", _sample_json(_sample()))
+        assert code == 200
+        shed = router.telemetry.kinds("tenant_shed")
+        assert shed and shed[-1]["reason"] == "budget"
+        assert shed[-1]["cap"] == 2 and shed[-1]["outstanding"] == 2
+        # cold start never caps: no drain sample -> no shed
+        fleet.last_drain_rate = 0.0
+        fleet._load_signal = lambda: (0.0, 0.0)
+        assert router._tenant_cap(10.0) is None
+    finally:
+        router.shutdown()
+
+
+def test_tenant_failover_after_replica_kill(engine):
+    """A tenant request rides the same failover ladder: kill the
+    replica mid-fleet and tenant traffic lands on the survivor."""
+    router = _mk_router(engine, n=2)
+    fleet = router.fleet
+    try:
+        victim = fleet.replicas[0]
+        victim.kill()
+        fleet.mark_dead(victim, reason="probe_dead")
+        for i in range(4):
+            code, out = _post(router.port, "/predict",
+                              _sample_json(_sample(5, seed=i), model="ta"))
+            assert code == 200 and out["replica"] == 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Engine executable LRU
+# ---------------------------------------------------------------------------
+
+
+def test_executable_lru_eviction():
+    """max_resident_executables=1 with a 2-bucket ladder: the second
+    warmup compile evicts the first (executable_evict), and re-touching
+    the evicted bucket is a counted recompile."""
+    import jax
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    pads = [PadSpec.for_batch(2, 16, 64), PadSpec.for_batch(4, 16, 64)]
+    example = collate([_sample()], pads[0], _HEADS)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    tel = _Tel()
+    eng = InferenceEngine(cfg, state, _HEADS, pads,
+                          serving=ServingConfig(
+                              port=0, max_resident_executables=1),
+                          telemetry=tel)
+    eng.warmup()
+    stats = eng.cache_stats()
+    # compile b0 -> compile b1 (evicts b0) -> golden replay recompiles
+    # b0 (evicts b1): a cap below one bucket ladder thrashes, exactly
+    # what docs/SERVING.md warns about
+    assert stats["evictions"] == 2
+    ev = tel.kinds("executable_evict")
+    assert len(ev) == 2 and all(e["cap"] == 1 for e in ev)
+    assert [e["graphs"] for e in ev] == [pads[0].num_graphs,
+                                         pads[1].num_graphs]
+    # the smallest bucket is resident (the golden replay compiled it
+    # last); touching the other is a counted recompile + eviction
+    eng._executable(pads[1])
+    s2 = eng.cache_stats()
+    assert s2["misses"] == stats["misses"] + 1
+    assert s2["evictions"] == 3
+    eng._executable(pads[1])
+    assert eng.cache_stats()["hits"] == s2["hits"] + 1
+
+
+def test_unbounded_cache_never_evicts(engine):
+    assert engine.cache_stats()["evictions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Knob plumbing: config section, env overlays, validation, chaos specs
+# ---------------------------------------------------------------------------
+
+
+def test_config_section_and_env_overlays(monkeypatch):
+    cfg = ServingConfig.from_section({
+        "port": 0, "fleet_min_replicas": 2, "fleet_max_replicas": 5,
+        "autoscale_up_frac": 0.25, "autoscale_up_ticks": 7,
+        "autoscale_quiet_s": 12.0, "autoscale_cooldown_s": 3.0,
+        "max_tenants": 8, "tenant_budget_frac": 0.5,
+        "max_resident_executables": 6})
+    assert (cfg.fleet_min_replicas, cfg.fleet_max_replicas) == (2, 5)
+    assert cfg.autoscale_up_frac == 0.25 and cfg.autoscale_up_ticks == 7
+    assert cfg.max_tenants == 8 and cfg.max_resident_executables == 6
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_MIN", "3")
+    monkeypatch.setenv("HYDRAGNN_SERVE_FLEET_MAX", "9")
+    monkeypatch.setenv("HYDRAGNN_SERVE_AUTOSCALE_UP_TICKS", "2")
+    monkeypatch.setenv("HYDRAGNN_SERVE_MAX_TENANTS", "2")
+    monkeypatch.setenv("HYDRAGNN_SERVE_TENANT_BUDGET_FRAC", "0.1")
+    monkeypatch.setenv("HYDRAGNN_SERVE_MAX_EXECUTABLES", "4")
+    cfg = ServingConfig.from_section({"port": 0})
+    assert (cfg.fleet_min_replicas, cfg.fleet_max_replicas) == (3, 9)
+    assert cfg.autoscale_up_ticks == 2 and cfg.max_tenants == 2
+    assert cfg.tenant_budget_frac == 0.1
+    assert cfg.max_resident_executables == 4
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="fleet_min_replicas"):
+        ServingConfig(port=0, fleet_min_replicas=4, fleet_max_replicas=2)
+    with pytest.raises(ValueError):
+        ServingConfig(port=0, autoscale_up_frac=-0.1)
+    with pytest.raises(ValueError):
+        ServingConfig(port=0, max_tenants=0)
+    # min <= max only enforced when the autoscaler is armed
+    ServingConfig(port=0, fleet_min_replicas=4, fleet_max_replicas=0)
+
+
+def test_fleet_chaos_tenant_specs(monkeypatch):
+    chaos = FleetChaos.from_env({"tenant_hot": "2:tb", "scale_fail": "1"})
+    assert chaos.on_probe() == [("scale_fail", None)]
+    assert chaos.on_probe() == [("tenant_hot", "tb")]
+    assert chaos.on_probe() == []
+    monkeypatch.setenv("HYDRAGNN_CHAOS_TENANT_HOT", "1+")
+    chaos = FleetChaos.from_env(None)
+    # env wins; no name after the colon targets the default tenant
+    assert chaos.on_probe() == [("tenant_hot", None)]
+    assert chaos.on_probe() == [("tenant_hot", None)]
+
+
+def test_default_tenant_shed_maps_to_429(engine):
+    """RequestShedError from the tenant gate carries retry_after_s like
+    the batcher's admission shed."""
+    router = _mk_router(engine, n=1)
+    try:
+        router.fleet.hot_tenants = {"ta"}
+        with pytest.raises(RequestShedError) as ei:
+            router._admit_tenant("ta", 10.0)
+        assert ei.value.retry_after_s >= 1.0
+    finally:
+        router.shutdown()

@@ -9,6 +9,7 @@
         [--rate 0] [--duration 8] [--deadline-ms 250]     # overload probe
     python tools/servebench.py --quant-ab                 # f32/bf16/int8 A/B
     python tools/servebench.py --fleet 3 [--duration 8]   # chaos-kill bench
+    python tools/servebench.py --tenants 3 [--duration 8] # autoscaler+tenancy
 
 Closed loop (default): each of ``--concurrency`` workers POSTs random
 graphs to ``/predict`` back-to-back (next request only after the
@@ -797,6 +798,396 @@ def run_fleet_bench(n: int, duration_s: float, max_nodes: int,
     }
 
 
+class _Recorder:
+    """Timestamped health-event recorder for the supervisor/router:
+    the scale-event timeline BENCH_serve_tenancy.json publishes."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def health(self, kind: str, **fields) -> None:
+        with self._lock:
+            self.events.append(
+                {"t_s": round(time.perf_counter() - self.t0, 3),
+                 "kind": kind, **fields})
+
+    def serve_step(self, *a, **kw) -> None:
+        pass
+
+    def kinds(self, kind: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [e for e in self.events if e["kind"] == kind]
+
+    @property
+    def health_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for e in self.events:
+                out[e["kind"]] = out.get(e["kind"], 0) + 1
+            return out
+
+
+def _selftest_tenant_fleet(n: int, tenants: Tuple[str, ...] = (),
+                           fleet_max: int = 0, recorder=None,
+                           chaos_predict_ms: float = 15.0,
+                           deadline_ms: float = 500.0,
+                           budget_frac: float = 0.0,
+                           probe_s: float = 0.1,
+                           up_ticks: int = 2, up_frac: float = 0.1,
+                           cooldown_s: float = 1.0,
+                           quiet_s: float = 0.8):
+    """Multi-tenant fleet selftest: like :func:`_selftest_fleet` plus
+    extra tenants (every replica hosts the same fork-closure tenant
+    set), an armed autoscaler when ``fleet_max > 0`` (the replica
+    factory builds scale-up replicas with the SAME tenants), and
+    per-tenant admission budgets when ``budget_frac > 0``."""
+    from hydragnn_tpu.resilience import ServeChaos
+    from hydragnn_tpu.serve import (
+        FleetRouter, FleetSupervisor, InProcessReplica, ServingConfig)
+    from hydragnn_tpu.telemetry import MetricsLogger
+
+    serving = ServingConfig(
+        buckets=(1, 2, 4), max_nodes_per_graph=16, max_edges_per_graph=128,
+        max_wait_ms=5.0, port=0, request_deadline_ms=deadline_ms,
+        fleet_probe_s=probe_s, fleet_restart_backoff_s=0.5,
+        fleet_restart_backoff_max_s=8.0, fleet_max_restarts=10,
+        fleet_restart_window_s=60.0, fleet_min_replicas=max(1, n - 1),
+        fleet_max_replicas=fleet_max, autoscale_up_frac=up_frac,
+        autoscale_up_ticks=up_ticks, autoscale_cooldown_s=cooldown_s,
+        autoscale_quiet_s=quiet_s,
+        max_tenants=max(4, len(tenants) + 1),
+        tenant_budget_frac=budget_frac)
+    base = _tiny_engine(serving)
+    base.warmup()
+    tel = recorder if recorder is not None else MetricsLogger.disabled()
+    dis = MetricsLogger.disabled()
+    tfs = {name: base.fork for name in tenants}
+
+    def chaos_factory():
+        return ServeChaos(predict_ms=chaos_predict_ms, lat_from=1) \
+            if chaos_predict_ms > 0 else None
+
+    def factory(i):
+        return InProcessReplica(i, base.fork, serving, dis,
+                                chaos_factory=chaos_factory,
+                                tenant_factories=tfs)
+
+    replicas = [factory(i) for i in range(n)]
+    fleet = FleetSupervisor(replicas, serving, telemetry=tel,
+                            replica_factory=factory)
+    router = FleetRouter(fleet, serving=serving, cfg=base.cfg,
+                         telemetry=tel)
+    router.start()
+    return router
+
+
+def _tenant_phase(router, duration_s: float, max_nodes: int,
+                  input_dim: int, rates: Dict[str, float],
+                  deadline_ms: float, hot: str = "",
+                  burst_rate: float = 0.0,
+                  burst_window: Tuple[float, float] = (0.0, 0.0),
+                  live_samples: List[Tuple[float, int]] = None
+                  ) -> Dict[str, Any]:
+    """Open-loop multi-tenant run: each tenant in ``rates`` fires at
+    its own fixed arrival rate; the ``hot`` tenant switches to
+    ``burst_rate`` inside ``burst_window``.  Latency is measured from
+    the SCHEDULED fire time (coordinated-omission-safe, same rule as
+    run_overload).  ``live_samples``, when given, collects a
+    (t_rel, live_replicas) timeline — the autoscaled A/B's evidence."""
+    import urllib.error
+
+    url = f"http://127.0.0.1:{router.port}"
+    # precompute the fire plan: (t_fire_rel, tenant), merged and sorted
+    plan: List[Tuple[float, str]] = []
+    for tenant, base_rate in rates.items():
+        t = 0.0
+        while t < duration_s:
+            r = burst_rate if (tenant == hot and burst_rate > 0
+                               and burst_window[0] <= t < burst_window[1]) \
+                else base_rate
+            plan.append((t, tenant))
+            t += 1.0 / max(r, 1e-9)
+    plan.sort()
+    rng = np.random.RandomState(13)
+    bodies: Dict[str, List[bytes]] = {}
+    for tenant in rates:
+        extra = {"timeout_ms": deadline_ms}
+        if tenant != "default":
+            extra["model"] = tenant
+        bodies[tenant] = [
+            json.dumps({**random_graph(rng, max_nodes, input_dim),
+                        **extra}).encode()
+            for _ in range(32)]
+
+    # per-tenant fire plans with per-tenant WORKER POOLS: each tenant is
+    # an independent client, so the hot tenant's burst backlog cannot
+    # delay the other tenants' scheduled fires — measured p99 is the
+    # server's isolation, not generator-side head-of-line blocking
+    plans: Dict[str, List[Tuple[float, str]]] = {
+        t: [p for p in plan if p[1] == t] for t in rates}
+    lock = threading.Lock()
+    idx: Dict[str, List[int]] = {t: [0] for t in rates}
+    events: List[Tuple[str, int, float]] = []  # (tenant, code, dt_ms)
+    transport_errors: List[str] = []
+    t0 = time.perf_counter() + 0.2
+
+    def worker(pool: str) -> None:
+        while True:
+            with lock:
+                i = idx[pool][0]
+                if i >= len(plans[pool]):
+                    return
+                idx[pool][0] += 1
+            t_rel, tenant = plans[pool][i]
+            t_fire = t0 + t_rel
+            now = time.perf_counter()
+            if t_fire > now:
+                time.sleep(t_fire - now)
+            req = urllib.request.Request(
+                url + "/predict", data=bodies[tenant][i % 32],
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+            except Exception as e:  # noqa: BLE001 — transport failure
+                with lock:
+                    transport_errors.append(repr(e))
+                continue
+            dt_ms = (time.perf_counter() - t_fire) * 1e3
+            with lock:
+                events.append((tenant, code, dt_ms))
+
+    def sampler() -> None:
+        while time.perf_counter() < t0 + duration_s:
+            live_samples.append(
+                (round(time.perf_counter() - t0, 2),
+                 router.fleet.live_count()))
+            time.sleep(0.2)
+
+    threads: List[threading.Thread] = []
+    for tenant, base_rate in rates.items():
+        peak = max(base_rate, burst_rate if tenant == hot else 0.0)
+        n_workers = max(8, min(192, int(peak)))
+        threads.extend(threading.Thread(target=worker, args=(tenant,))
+                       for _ in range(n_workers))
+    if live_samples is not None:
+        threads.append(threading.Thread(target=sampler))
+    t_wall = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_wall
+
+    per_tenant: Dict[str, Any] = {}
+    for tenant in sorted(rates):
+        evs = [(c, dt) for tn, c, dt in events if tn == tenant]
+        acc = np.asarray(sorted(dt for c, dt in evs if c == 200)) \
+            if any(c == 200 for c, _ in evs) else np.zeros(1)
+        n200 = sum(1 for c, _ in evs if c == 200)
+        per_tenant[tenant] = {
+            "offered": len(evs),
+            "accepted": n200,
+            "shed_429": sum(1 for c, _ in evs if c == 429),
+            "errors_5xx": sum(1 for c, _ in evs if c >= 500),
+            "other": sum(1 for c, _ in evs
+                         if c not in (200, 429) and c < 500),
+            "goodput_rps": round(n200 / duration_s, 2),
+            "p50_ms": round(float(np.percentile(acc, 50)), 2),
+            "p99_ms": round(float(np.percentile(acc, 99)), 2),
+        }
+    n_answered = len(events)
+    n_shed = sum(1 for _, c, _ in events if c == 429)
+    return {
+        "duration_s": duration_s,
+        "rates_rps": {k: round(v, 2) for k, v in rates.items()},
+        "hot_tenant": hot or None,
+        "burst_rate_rps": round(burst_rate, 2) if burst_rate else None,
+        "burst_window_s": list(burst_window) if burst_rate else None,
+        "wall_s": round(wall_s, 3),
+        "answered": n_answered,
+        "shed_429": n_shed,
+        "shed_rate": round(n_shed / n_answered, 4) if n_answered else 0.0,
+        "errors_5xx": sum(1 for _, c, _ in events if c >= 500),
+        "transport_errors": len(transport_errors),
+        "transport_error_samples": transport_errors[:3],
+        "per_tenant": per_tenant,
+    }
+
+
+def run_tenancy_bench(n_tenants: int, duration_s: float, max_nodes: int,
+                      input_dim: int = 1,
+                      chaos_predict_ms: float = 40.0) -> Dict[str, Any]:
+    """The ISSUE-14 acceptance bench, three phases into
+    BENCH_serve_tenancy.json:
+
+    1. **static**: a 2-replica fleet under the PR-8 open-loop overload
+       (1.6x measured closed-loop capacity) — the shed-rate baseline.
+    2. **autoscaled**: the same overload against a 2-start/4-cap fleet
+       with the closed loop armed; the drain-rate signal must grow the
+       fleet mid-run and beat the static shed rate with zero 5xx, then
+       a post-load trickle must ride through zero-drop scale-downs.
+    3. **isolation**: >= 3 resident tenants with per-tenant admission
+       budgets; the hot tenant's mid-run burst is shed with ITS 429s
+       while the other tenants' p99 stays within the deadline SLO.
+    """
+    if n_tenants < 3:
+        raise SystemExit("--tenants needs >= 3 (the acceptance requires "
+                         ">= 3 resident tenants)")
+    deadline_ms = 500.0
+
+    # -- capacity probe (closed loop against the static topology) ------
+    router = _selftest_tenant_fleet(2, chaos_predict_ms=chaos_predict_ms,
+                                    deadline_ms=10_000.0)
+    try:
+        probe = run_bench(f"http://127.0.0.1:{router.port}", 16, 240,
+                          max_nodes, input_dim)
+    finally:
+        router.shutdown()
+    capacity = max(float(probe["throughput_rps"]), 2.0)
+    # 1.6x the STATIC fleet's measured capacity: a genuine overload for
+    # 2 replicas that a 4-replica fleet (~2x capacity) can absorb, and
+    # light enough that the thread-pool open loop can actually offer it
+    rate = max(1.6 * capacity, 8.0)
+    print(f"tenancy bench: capacity {capacity:.1f} rps -> offering "
+          f"{rate:.1f} rps", flush=True)
+
+    # -- phase 1: static 2-replica fleet under overload ----------------
+    router = _selftest_tenant_fleet(2, chaos_predict_ms=chaos_predict_ms,
+                                    deadline_ms=deadline_ms)
+    try:
+        static = _tenant_phase(router, duration_s, max_nodes, input_dim,
+                               {"default": rate}, deadline_ms)
+    finally:
+        router.shutdown()
+
+    # -- phase 2: autoscaled 2 -> 4 fleet under the same overload ------
+    rec = _Recorder()
+    live_tl: List[Tuple[float, int]] = []
+    router = _selftest_tenant_fleet(2, fleet_max=4, recorder=rec,
+                                    chaos_predict_ms=chaos_predict_ms,
+                                    deadline_ms=deadline_ms)
+    try:
+        auto = _tenant_phase(router, duration_s, max_nodes, input_dim,
+                             {"default": rate}, deadline_ms,
+                             live_samples=live_tl)
+        peak_live = max(v for _, v in live_tl) if live_tl else 2
+        # post-load trickle: the quiet window must retire replicas with
+        # ZERO dropped requests while light traffic keeps flowing
+        url = f"http://127.0.0.1:{router.port}"
+        trickle_codes: List[int] = []
+        rng = np.random.RandomState(17)
+        t_stop = time.perf_counter() + 25.0
+        scaled_down = False
+        while time.perf_counter() < t_stop:
+            try:
+                _post(url, {**random_graph(rng, max_nodes, input_dim),
+                            "timeout_ms": 10_000.0})
+                trickle_codes.append(200)
+            except Exception as e:  # noqa: BLE001 — any non-200 is a drop
+                trickle_codes.append(
+                    getattr(e, "code", 599) or 599)
+            if rec.kinds("fleet_scale_down"):
+                scaled_down = True
+                if len(trickle_codes) >= 8:
+                    break
+            time.sleep(0.4)
+        auto_metrics = _get(url, "/metrics")
+    finally:
+        router.shutdown()
+    scale_events = [e for e in rec.events
+                    if e["kind"] in ("fleet_scale_up", "fleet_scale_down")]
+
+    # -- phase 3: tenant isolation under a hot-tenant burst ------------
+    tenants = tuple(f"tenant{c}" for c in "bcdefgh"[:n_tenants - 1])
+    hot = tenants[0]
+    rec_iso = _Recorder()
+    router = _selftest_tenant_fleet(
+        2, tenants=tenants, recorder=rec_iso,
+        chaos_predict_ms=chaos_predict_ms, deadline_ms=deadline_ms,
+        budget_frac=0.25)
+    try:
+        url = f"http://127.0.0.1:{router.port}"
+        # make every tenant resident before measuring
+        rng = np.random.RandomState(19)
+        for name in tenants:
+            _post(url, {**random_graph(rng, max_nodes, input_dim),
+                        "model": name, "timeout_ms": 10_000.0})
+        rates = {"default": capacity / (2.0 * n_tenants)}
+        rates.update({name: capacity / (2.0 * n_tenants)
+                      for name in tenants})
+        iso = _tenant_phase(
+            router, duration_s, max_nodes, input_dim, rates, deadline_ms,
+            hot=hot, burst_rate=max(2.0 * capacity, 8.0),
+            burst_window=(duration_s / 3.0, 2.0 * duration_s / 3.0))
+        iso_metrics = _get(url, "/metrics")
+        resident = iso_metrics["fleet"]["replicas"][0].get(
+            "tenants_resident", [])
+    finally:
+        router.shutdown()
+
+    others = ["default"] + [t for t in tenants if t != hot]
+    # CPU transport allowance on top of the deadline, same rationale as
+    # run_overload (client-side connect/parse/GIL scheduling)
+    p99_bound_ms = deadline_ms + 50.0
+    slo = {
+        "zero_5xx": static["errors_5xx"] == 0 and auto["errors_5xx"] == 0
+                    and iso["errors_5xx"] == 0,
+        "scaled_up": any(e["kind"] == "fleet_scale_up"
+                         for e in scale_events),
+        "peak_live_above_start": peak_live > 2,
+        "autoscaled_shed_below_static":
+            auto["shed_rate"] < static["shed_rate"],
+        "scaled_down": scaled_down,
+        "scale_down_zero_drop": scaled_down
+                                and all(c == 200 for c in trickle_codes),
+        "resident_tenants_ge_3": len(resident) >= 3,
+        "hot_tenant_shed": iso["per_tenant"][hot]["shed_429"] > 0,
+        "other_tenants_unshed": all(
+            iso["per_tenant"][t]["shed_429"] == 0 for t in others),
+        "p99_bound_ms": p99_bound_ms,
+        "other_tenants_p99_within_slo": all(
+            iso["per_tenant"][t]["p99_ms"] <= p99_bound_ms
+            for t in others),
+    }
+    slo["ok"] = all(bool(v) for k, v in slo.items()
+                    if k != "p99_bound_ms")
+    return {
+        "bench": "serve_tenancy",
+        "config": {
+            "tenants": n_tenants,
+            "duration_s": duration_s,
+            "max_nodes": max_nodes,
+            "chaos_predict_ms": chaos_predict_ms,
+            "deadline_ms": deadline_ms,
+            "measured_capacity_rps": round(capacity, 2),
+            "overload_rate_rps": round(rate, 2),
+            "fleet": {"start": 2, "max": 4},
+            "tenant_budget_frac": 0.25,
+        },
+        "static": static,
+        "autoscaled": auto,
+        "scale_events": scale_events,
+        "live_timeline": [list(x) for x in live_tl],
+        "trickle": {
+            "requests": len(trickle_codes),
+            "non_200": sum(1 for c in trickle_codes if c != 200),
+        },
+        "autoscaler_state": auto_metrics.get("autoscale", {}).get(
+            "policy"),
+        "isolation": iso,
+        "tenancy_metrics": iso_metrics.get("tenancy"),
+        "resident_tenants": resident,
+        "slo": slo,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default=None,
@@ -825,6 +1216,13 @@ def main(argv=None) -> int:
                          "behind the failover router, one killed "
                          "mid-run in closed-loop AND overload phases; "
                          "writes BENCH_serve_fleet.json")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="multi-tenant autoscaler bench: N tenants "
+                         "(>= 3) on in-process fleets; runs a "
+                         "static-vs-autoscaled overload A/B, a "
+                         "zero-drop scale-down trickle, and a "
+                         "hot-tenant isolation burst; writes "
+                         "BENCH_serve_tenancy.json")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="overload arrival rate in req/s (0 = auto: 2x a "
                          "measured closed-loop capacity probe)")
@@ -842,10 +1240,34 @@ def main(argv=None) -> int:
                          "or BENCH_serve_overload.json with --overload)")
     args = ap.parse_args(argv)
     out_path = args.out or (
-        "BENCH_serve_fleet.json" if args.fleet > 0
+        "BENCH_serve_tenancy.json" if args.tenants > 0
+        else "BENCH_serve_fleet.json" if args.fleet > 0
         else "BENCH_serve_quant.json" if args.quant_ab
         else "BENCH_serve_overload.json" if args.overload
         else "BENCH_serve.json")
+
+    if args.tenants > 0:
+        result = run_tenancy_bench(
+            args.tenants, args.duration, args.nodes,
+            input_dim=args.input_dim,
+            chaos_predict_ms=(args.chaos_predict_ms
+                              if args.chaos_predict_ms != 25.0 else 40.0))
+        atomic_write_json(out_path, result)
+        print(json.dumps(result, indent=2))
+        print(f"\nwrote {out_path}")
+        slo = result["slo"]
+        st, au = result["static"], result["autoscaled"]
+        iso = result["isolation"]
+        hot = iso["hot_tenant"]
+        print(f"SLO {'PASS' if slo['ok'] else 'FAIL'}: shed rate static "
+              f"{st['shed_rate']:.1%} -> autoscaled {au['shed_rate']:.1%} "
+              f"({len(result['scale_events'])} scale events, trickle "
+              f"non-200 {result['trickle']['non_200']}), hot tenant "
+              f"{hot} shed {iso['per_tenant'][hot]['shed_429']} while "
+              f"others' worst p99 "
+              f"{max(v['p99_ms'] for k, v in iso['per_tenant'].items() if k != hot):.0f} ms "
+              f"vs bound {slo['p99_bound_ms']:.0f} ms")
+        return 0 if slo["ok"] else 1
 
     if args.fleet > 0:
         result = run_fleet_bench(args.fleet, args.duration, args.nodes,
