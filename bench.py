@@ -896,6 +896,112 @@ def _child(platform: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --dense: acceptance bound over the dense ladder + per-arch sweep
+# ---------------------------------------------------------------------------
+
+# A mainline rung of the dense ladder below this MFU means the run was
+# NOT compute-dense — it silently regressed to a stream/dispatch-bound
+# program (ROADMAP item 2's gap).  5% is deliberately far under the
+# measured rungs (~8-19% on the v5e ladder): the bound catches falling
+# OFF a fused path, not ordinary round-over-round noise.
+DENSE_MFU_FLOOR = 5.0
+# archs whose interaction block has its own fused Pallas path at the
+# sweep's mainline widths (SchNet CFConv pipeline, GATv2 attention,
+# EGNN EGCL block) — the set --dense holds to the fused-dispatch bound.
+# The other stacks ride the generic gather/scatter kernels and are
+# covered by the MFU floor alone.
+MAINLINE_FUSED_ARCHS = ("SchNet", "GAT", "EGNN")
+
+
+def dense_gate(evidence):
+    """Pure acceptance bound over a bench evidence dict (the
+    ``BENCH_evidence.json`` a bench run writes): every dense-ladder rung
+    must clear :data:`DENSE_MFU_FLOOR`, and every
+    :data:`MAINLINE_FUSED_ARCHS` row of the per-arch sweep must report
+    ``aggr_backend == "fused"`` — the trace-time dispatch tally
+    (telemetry/pipeline.py), so an arch that silently fell back to the
+    composed scatter ops FAILS instead of shipping a slow number.
+
+    Returns ``(ok, failures, table)``; pure (no jax, no device) so the
+    tier-1 suite can pin the verdict on synthetic evidence, and
+    tools/teleview.py can render the same bound as WARNINGs."""
+    failures = []
+    table = []
+    for name, row in sorted((evidence.get("dense") or {}).items()):
+        if "error" in row:
+            failures.append(f"dense rung {name}: {row['error']}")
+            continue
+        mfu = row.get("mfu_pct")
+        table.append({"kind": "dense", "name": name, "mfu_pct": mfu,
+                      "graphs_per_sec": row.get("graphs_per_sec")})
+        if mfu is None:
+            failures.append(
+                f"dense rung {name}: no mfu_pct (roofline failed)")
+        elif mfu < DENSE_MFU_FLOOR:
+            failures.append(
+                f"dense rung {name}: {mfu}% MFU < {DENSE_MFU_FLOOR}% "
+                "floor — the run is not compute-dense")
+    for arch, row in sorted((evidence.get("archs") or {}).items()):
+        mainline = arch.split("-")[0] in MAINLINE_FUSED_ARCHS
+        if "error" in row:
+            if mainline:
+                failures.append(f"arch {arch}: {row['error']}")
+            continue
+        backend = row.get("aggr_backend")
+        table.append({"kind": "arch", "name": arch,
+                      "graphs_per_sec": row.get("graphs_per_sec"),
+                      "aggr_backend": backend})
+        if mainline and backend != "fused":
+            failures.append(
+                f"arch {arch}: aggr_backend={backend} — silently fell "
+                "off its fused path")
+    if not table:
+        failures.append("no dense/archs evidence (run bench's dense and "
+                        "archs phases first)")
+    return not failures, failures, table
+
+
+def _dense_main(argv) -> int:
+    """``python bench.py --dense``: evaluate :func:`dense_gate` over the
+    last bench run's evidence file, print the per-rung/per-arch table,
+    and exit 1 on any violated bound (CI-pluggable acceptance check)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --dense")
+    ap.add_argument("--evidence", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_evidence.json"),
+        help="evidence JSON from a prior bench run")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.evidence):
+        print(f"bench --dense: no evidence at {args.evidence} — run "
+              "`python bench.py` (dense,archs phases) first",
+              file=sys.stderr)
+        return 2
+    with open(args.evidence) as f:
+        evidence = json.load(f)
+    ok, failures, table = dense_gate(evidence)
+    for row in table:
+        if row["kind"] == "dense":
+            print(f"bench --dense: rung {row['name']}: "
+                  f"{row['mfu_pct']}% MFU, "
+                  f"{row['graphs_per_sec']} g/s", file=sys.stderr)
+        else:
+            print(f"bench --dense: arch {row['name']}: "
+                  f"{row['graphs_per_sec']} g/s "
+                  f"aggr={row['aggr_backend']}", file=sys.stderr)
+    for fmsg in failures:
+        print(f"bench --dense: FAIL {fmsg}", file=sys.stderr)
+    print(json.dumps({
+        "dense_gate": "PASS" if ok else "FAIL",
+        "mfu_floor": DENSE_MFU_FLOOR,
+        "mainline_fused_archs": list(MAINLINE_FUSED_ARCHS),
+        "failures": failures,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
 # --zero: ZeRO sharded-training ladder (bytes per device + throughput)
 # ---------------------------------------------------------------------------
 
@@ -1376,5 +1482,7 @@ if __name__ == "__main__":
         sys.exit(_zero_main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--giant":
         sys.exit(_giant_main(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--dense":
+        sys.exit(_dense_main(sys.argv[2:]))
     else:
         main()

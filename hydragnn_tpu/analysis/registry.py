@@ -116,6 +116,10 @@ _KNOB_LIST = [
     _k("HYDRAGNN_BN_MOMENTUM", "", "model default",
        "hydragnn_tpu/models/layers.py",
        "BatchNorm momentum override"),
+    _k("HYDRAGNN_TRAIN_DTYPE", "Training.train_dtype_policy", "f32",
+       "hydragnn_tpu/train/trainer.py",
+       "train-step compute dtype: f32 | bf16 (f32 master state; "
+       "step-0 golden gate, loud f32 fallback)"),
     # -- parallel / distributed ------------------------------------------
     _k("HYDRAGNN_MASTER_ADDR", "", "127.0.0.1",
        "hydragnn_tpu/parallel/mesh.py",
@@ -151,6 +155,10 @@ _KNOB_LIST = [
     _k("HYDRAGNN_GAT_FUSED", "", "auto",
        "hydragnn_tpu/models/gat.py",
        "GAT fused edge-attention gate"),
+    _k("HYDRAGNN_EGCL_FUSED", "", "auto",
+       "hydragnn_tpu/models/egnn.py",
+       "EGNN fused EGCL interaction-block gate (1/0 forces, subject "
+       "to the kernel's structural width limits)"),
     _k("HYDRAGNN_DN_TRI_OFF", "", "0",
        "hydragnn_tpu/models/dimenet.py",
        "disable the DimeNet fused-triplet kernel"),
@@ -395,6 +403,12 @@ _HEALTH_LIST = [
        "guard monitor hit N consecutive bad steps and raised"),
     _h("graph_shard_fallback", "hydragnn_tpu/train/trainer.py",
        "graph sharding requested but the run fell back to plain DP"),
+    _h("egcl_fallback", "hydragnn_tpu/train/trainer.py",
+       "EGNN fell off the fused EGCL path (structural limit or env "
+       "override) and composed the XLA route instead"),
+    _h("train_dtype_reject", "hydragnn_tpu/train/trainer.py",
+       "bf16 train policy requested but rejected (golden-gate drift, "
+       "graph sharding, or empty loader) — run fell back to f32"),
     # serving lifecycle (docs/TELEMETRY.md "Serving events")
     _h("request_enqueued", "hydragnn_tpu/serve/batcher.py",
        "request accepted into the bounded queue"),

@@ -211,6 +211,15 @@ def finalize(
     from hydragnn_tpu.parallel.zero import check_zero_stage
 
     training["zero_stage"] = check_zero_stage(training.get("zero_stage", 0))
+    # training dtype policy (docs/PERF.md PR-15): default "f32" written
+    # back like the other Training defaults, and VALIDATED on every
+    # construction path — a typo'd policy must fail here, not silently
+    # train f32 while the operator believes bf16 is on.  The
+    # HYDRAGNN_TRAIN_DTYPE env knob overlays at trainer build time.
+    from hydragnn_tpu.quant import check_train_policy
+
+    training["train_dtype_policy"] = check_train_policy(
+        training.get("train_dtype_policy", "f32"))
     # graph sharding backend/knobs (docs/SCALING.md §6): defaults written
     # back like the other Training defaults, and VALIDATED on every
     # construction path — a typo'd backend must fail here, not silently

@@ -7,15 +7,69 @@ node MLP on [h, sum of incident messages].  The coordinate branch runs on
 all but the last layer (reference EGCLStack.py:36-46); aggregation happens
 at the edge *source* as in the reference (EGCLStack.py:194,210).
 No BatchNorm feature layers (reference uses Identity; EGCLStack.py:41).
+
+The whole interaction block (gather -> edge MLP -> coord gate -> both
+scatters) dispatches to ONE Pallas pass (ops/egcl_mp.py) when the batch
+carries the sender-sort marker and the widths fit the kernel's tile
+limits; the composed XLA path below is the bit-tested fallback.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax.numpy as jnp
 import flax.linen as nn
 
 from hydragnn_tpu.graph import segment
 from hydragnn_tpu.models.base import Base
+from hydragnn_tpu.models.schnet import _DenseParams
+from hydragnn_tpu.telemetry import pipeline
+
+
+def _edge_geometry(pos, src, dst):
+    """The ONE per-edge geometry definition shared by the composed path
+    and the fused kernel: normalized difference vector and squared
+    distance.  eps inside the sqrt: padding self-edges have radial == 0
+    exactly, where sqrt's gradient is inf — this path must stay
+    differentiable for the energy-gradient force loss (jax.grad wrt
+    pos)."""
+    diff = pos[src] - pos[dst]
+    radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
+    diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)  # norm_diff=True
+    return diff, radial
+
+
+def _egcl_pipeline_enabled(features: int, hidden: int, geo_dim: int) -> bool:
+    """Fused EGCL interaction-block gate (ops/egcl_mp.py): structural
+    tile limits only — unlike SchNet's cfconv there is NO width floor,
+    because the win here is eliminating the [E, *] streams (concat, two
+    MLP activations, gate, translations) plus BOTH scatter passes, which
+    dominates even at EGNN's mainline hidden width 64 where the step is
+    gather/scatter-bound rather than matmul-bound.  Env override
+    HYDRAGNN_EGCL_FUSED=1/0 forces it either way (subject to the
+    structural limits — the kernel cannot run beyond them)."""
+    from hydragnn_tpu.ops.egcl_mp import (
+        EGCL_F_LIMIT, EGCL_GEO_LIMIT, EGCL_H_LIMIT)
+
+    if features > EGCL_F_LIMIT or hidden > EGCL_H_LIMIT \
+            or geo_dim > EGCL_GEO_LIMIT:
+        return False
+    v = os.environ.get("HYDRAGNN_EGCL_FUSED")
+    if v is not None:
+        return v.strip().lower() not in ("0", "false", "off", "no", "")
+    return True
+
+
+def _egcl_fused_wanted() -> bool:
+    """Did the operator ask for the fused data layout?  Either knob
+    counts: the global aggregation backend or the EGCL-specific force."""
+    if os.environ.get("HYDRAGNN_AGGR_BACKEND", "").strip().lower() \
+            == "fused":
+        return True
+    v = os.environ.get("HYDRAGNN_EGCL_FUSED")
+    return v is not None and v.strip().lower() not in (
+        "0", "false", "off", "no", "")
 
 
 class EGCL(nn.Module):
@@ -29,46 +83,82 @@ class EGCL(nn.Module):
         n = x.shape[0]
         src, dst = g.senders, g.receivers
 
-        diff = pos[src] - pos[dst]
-        radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
-        # eps inside the sqrt: padding self-edges have radial == 0 exactly,
-        # where sqrt's gradient is inf — this path must stay differentiable
-        # for the energy-gradient force loss (jax.grad wrt pos).
-        diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)  # norm_diff=True
+        # shared per-edge geometry, computed ONCE (the coord branch used
+        # to recompute diff/radial on the fallback route)
+        diff, radial = _edge_geometry(pos, src, dst)
+        use_ea = bool(self.edge_dim) and g.edge_attr is not None
+        geo_dim = 4 + (g.edge_attr.shape[-1] if use_ea else 0)
 
-        # gathers whose backward rides the dense sorted scatter
-        # (marker-gated; measured +9% end-to-end on the v5e sweep)
-        parts = [segment.gather_sender(x, g),
-                 segment.gather_receiver_sorted(x, g), radial]
-        if self.edge_dim and g.edge_attr is not None:
-            parts.append(g.edge_attr)
-        m = jnp.concatenate(parts, axis=-1)
-        m = nn.Dense(self.hidden_dim, name="edge_mlp_0")(m)
-        m = nn.relu(m)
-        m = nn.Dense(self.hidden_dim, name="edge_mlp_1")(m)
-        m = nn.relu(m)
-        m = m * g.edge_mask[:, None]
-
+        # edge/coord MLP params are declared matmul-free so the fused
+        # block can consume them raw; the composed path applies them
+        # exactly as the nn.Dense layers they replace (identical
+        # names/inits — checkpoints are path-independent)
+        in_dim = 2 * x.shape[-1] + geo_dim - 3
+        k0, b0 = _DenseParams(in_dim, self.hidden_dim,
+                              name="edge_mlp_0")()
+        k1, b1 = _DenseParams(self.hidden_dim, self.hidden_dim,
+                              name="edge_mlp_1")()
+        kc0 = bc0 = kc1 = None
         if self.equivariant:
-            c = nn.Dense(self.hidden_dim, name="coord_mlp_0")(m)
-            c = nn.relu(c)
-            c = nn.Dense(
-                1,
-                use_bias=False,
+            kc0, bc0 = _DenseParams(self.hidden_dim, self.hidden_dim,
+                                    name="coord_mlp_0")()
+            kc1, _ = _DenseParams(
+                self.hidden_dim, 1, use_bias=False,
                 kernel_init=nn.initializers.variance_scaling(
-                    0.001, "fan_avg", "uniform"
-                ),
-                name="coord_mlp_1",
-            )(c)
-            c = jnp.tanh(c)  # tanh=True in reference E_GCL
-            trans = jnp.clip(diff * c, -100.0, 100.0)
-            # sender-side aggregation: the XLA masked segment ops beat
-            # the sender-permuted dense kernel here (measured 43.9k vs
-            # 37.5k graphs/s on the v5e sweep config — the [E] perm
-            # gather outweighs the scatter win at EGNN's message width)
-            pos = pos + segment.segment_mean(trans, src, n, g.edge_mask)
+                    0.001, "fan_avg", "uniform"),
+                name="coord_mlp_1")()
 
-        agg = segment.segment_sum(m, src, n, g.edge_mask)
+        perm = g.extras.get("edge_perm_sender") if g.extras else None
+        fused = (perm is not None
+                 and _egcl_pipeline_enabled(x.shape[-1], self.hidden_dim,
+                                            geo_dim))
+        segment._count("egcl", fused)
+        if not fused and _egcl_fused_wanted():
+            # models hold no MetricsLogger — record the reason here (trace
+            # time, deduped) for the trainer to surface as an
+            # `egcl_fallback` health event after the first epoch
+            pipeline.record_fallback(
+                "egcl",
+                reason="no_sender_perm" if perm is None else "width_gate",
+                features=int(x.shape[-1]), hidden=int(self.hidden_dim),
+                geo_dim=int(geo_dim))
+
+        if fused:
+            from hydragnn_tpu.ops.egcl_mp import egcl_block
+
+            geo = jnp.concatenate(
+                [diff, radial] + ([g.edge_attr] if use_ea else []),
+                axis=-1)
+            em = g.edge_mask.astype(jnp.int32)
+            agg, psum = egcl_block(
+                self.equivariant, x, geo, em, k0, b0, k1, b1,
+                kc0, bc0, kc1, src, dst, perm)
+            if self.equivariant:
+                cnt = segment.segment_count(src, n, g.edge_mask)
+                pos = pos + segment._mean_divide(psum[:, :3], cnt)
+        else:
+            # gathers whose backward rides the dense sorted scatter
+            # (marker-gated; measured +9% end-to-end on the v5e sweep)
+            parts = [segment.gather_sender(x, g),
+                     segment.gather_receiver_sorted(x, g), radial]
+            if use_ea:
+                parts.append(g.edge_attr)
+            m = jnp.concatenate(parts, axis=-1)
+            m = nn.relu(m @ k0 + b0)
+            m = nn.relu(m @ k1 + b1)
+            m = m * g.edge_mask[:, None]
+
+            if self.equivariant:
+                c = nn.relu(m @ kc0 + bc0)
+                c = jnp.tanh(c @ kc1)  # tanh=True in reference E_GCL
+                trans = jnp.clip(diff * c, -100.0, 100.0)
+                # sender-side aggregation matching the reference; the
+                # fused path scatters the same translation sum in-kernel
+                pos = pos + segment.segment_mean(trans, src, n,
+                                                 g.edge_mask)
+
+            agg = segment.segment_sum(m, src, n, g.edge_mask)
+
         h = jnp.concatenate([x, agg], axis=-1)
         h = nn.Dense(self.hidden_dim, name="node_mlp_0")(h)
         h = nn.relu(h)

@@ -36,17 +36,20 @@ def gaussian_smearing(dist, radius, num_gaussians):
 class _DenseParams(nn.Module):
     """Parameters of an ``nn.Dense`` WITHOUT its matmul: same names
     (kernel/bias), same default inits, same param tree — so the fused
-    edge-pipeline path below (and DimeNet's fused triplet path) and the
-    composed paths share checkpoints."""
+    edge-pipeline path below (and DimeNet's fused triplet path, and
+    EGNN's fused interaction block) and the composed paths share
+    checkpoints.  ``kernel_init`` overrides for layers whose nn.Dense
+    twin uses a non-default init (EGNN's coord gate)."""
 
     in_dim: int
     features: int
     use_bias: bool = True
+    kernel_init: object = None
 
     @nn.compact
     def __call__(self):
-        k = self.param("kernel", nn.linear.default_kernel_init,
-                       (self.in_dim, self.features))
+        init = self.kernel_init or nn.linear.default_kernel_init
+        k = self.param("kernel", init, (self.in_dim, self.features))
         if not self.use_bias:
             return k, None
         b = self.param("bias", nn.initializers.zeros_init(),

@@ -351,6 +351,7 @@ def make_dp_train_step(
     steps: int = 1,
     telemetry_metrics: bool = False,
     nonfinite_guard: bool = False,
+    dtype_policy: str = "f32",
 ):
     """jit'd DP train step over stacked batches [D, ...].
 
@@ -383,6 +384,11 @@ def make_dp_train_step(
     so a non-finite shard on any device poisons the replicated check and
     every replica skips the same update — replicas can never diverge on a
     bad batch.  Default OFF: traces the exact pre-guard program.
+
+    ``dtype_policy="bf16"`` runs each replica's forward/backward in bf16
+    with f32 master params and optimizer state (trainer._loss_and_metrics);
+    the gradient pmean and the update stay f32.  Default "f32" traces the
+    exact pre-policy program.
     """
     energy_head, forces_head = _force_head_indices(output_names)
     axes = _dp_axes(axis)
@@ -413,7 +419,8 @@ def make_dp_train_step(
         def loss_fn(params):
             return _loss_and_metrics(
                 model, cfg, params, state.batch_stats, g, True,
-                energy_head, forces_head, dropout_rng)
+                energy_head, forces_head, dropout_rng,
+                dtype_policy=dtype_policy)
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params_full)

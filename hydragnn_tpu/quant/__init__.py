@@ -11,11 +11,25 @@ lazily (PEP 562) from :mod:`hydragnn_tpu.quant.policy`.
 
 POLICIES = ("f32", "bf16", "int8")
 
+# training-time dtype policies (Training.train_dtype_policy +
+# HYDRAGNN_TRAIN_DTYPE): narrower than the inference set — int8 weights
+# cannot carry an optimizer update, so training is f32 or
+# bf16-with-f32-accumulation only (docs/PERF.md PR-15)
+TRAIN_POLICIES = ("f32", "bf16")
+
 
 def check_policy(policy: str) -> str:
     if policy not in POLICIES:
         raise ValueError(
             f"unknown quant policy {policy!r} (choose from {POLICIES})")
+    return policy
+
+
+def check_train_policy(policy: str) -> str:
+    if policy not in TRAIN_POLICIES:
+        raise ValueError(
+            f"unknown train dtype policy {policy!r} "
+            f"(choose from {TRAIN_POLICIES})")
     return policy
 
 
@@ -31,7 +45,8 @@ _EXPORTS = (
     "wrap_eval_step",
 )
 
-__all__ = sorted(_EXPORTS + ("POLICIES", "check_policy"))
+__all__ = sorted(_EXPORTS + ("POLICIES", "TRAIN_POLICIES", "check_policy",
+                             "check_train_policy"))
 
 
 def __getattr__(name: str):

@@ -86,6 +86,29 @@ def dispatch_delta(before: Dict[str, int],
             if v - before.get(k, 0) > 0}
 
 
+_fallbacks: list = []
+
+
+def record_fallback(op: str, **fields) -> None:
+    """Record one fell-off-the-fast-path event from trace-time code that
+    has no MetricsLogger in reach (model dispatch sites run inside the
+    first jit trace).  Deduped on (op, reason); a consumer with a logger
+    drains via :func:`pop_fallbacks` and emits the health event."""
+    with _lock:
+        key = (op, fields.get("reason"))
+        if any((f[0], f[1].get("reason")) == key for f in _fallbacks):
+            return
+        _fallbacks.append((op, dict(fields)))
+
+
+def pop_fallbacks(op: str) -> list:
+    """Drain (and return) the recorded fallback payloads for ``op``."""
+    with _lock:
+        out = [f[1] for f in _fallbacks if f[0] == op]
+        _fallbacks[:] = [f for f in _fallbacks if f[0] != op]
+    return out
+
+
 def dispatch_summary(counts: Dict[str, int]) -> str:
     """Compact human layout of a tally (or a delta of two snapshots):
     ``fused`` / ``scatter`` / ``mixed(fused=N,scatter=M)`` / ``none``."""

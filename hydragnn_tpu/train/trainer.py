@@ -100,22 +100,30 @@ def _loss_and_metrics(
     energy_head: int = -1,
     forces_head: int = -1,
     dropout_rng: Optional[jax.Array] = None,
+    dtype_policy: str = "f32",
 ):
     """Forward + weighted loss (+ self-consistency term); returns
     (loss, (per_head, new_batch_stats, outputs)).
 
     Mixed precision (``Architecture.mixed_precision`` -> cfg.compute_dtype
-    "bfloat16"): params and node/edge FEATURES are cast to bf16 at THIS
-    boundary — one choke point instead of threading dtype through every
-    layer.  Deliberately kept f32: positions (bf16's 8-bit mantissa would
-    quantize interatomic distances by ~0.1 A at catalyst-cell coordinate
-    magnitudes, corrupting RBFs and the dE/dpos force term), the running
-    batch statistics (an EMA accumulated through bf16 loses late-training
-    drifts), the loss, and the gradients (transpose of the cast accumulates
-    in f32).  Anything the f32 geometry touches promotes back to f32;
-    the feature stack stays bf16."""
-    compute_dtype = (jnp.bfloat16 if getattr(cfg, "compute_dtype", "float32")
-                     == "bfloat16" else None)
+    "bfloat16", or the training policy ``dtype_policy="bf16"`` from
+    ``Training.train_dtype_policy`` / HYDRAGNN_TRAIN_DTYPE — see
+    docs/PERF.md PR-15): params and node/edge FEATURES are cast to bf16
+    at THIS boundary — one choke point instead of threading dtype through
+    every layer.  Deliberately kept f32: positions (bf16's 8-bit mantissa
+    would quantize interatomic distances by ~0.1 A at catalyst-cell
+    coordinate magnitudes, corrupting RBFs and the dE/dpos force term),
+    the running batch statistics (an EMA accumulated through bf16 loses
+    late-training drifts), the loss, and the gradients (transpose of the
+    cast accumulates in f32).  Anything the f32 geometry touches promotes
+    back to f32; the feature stack stays bf16.  Under the training policy
+    the MASTER params (state.params), the optimizer state, and the loss /
+    gradient accumulators all stay f32 — only this forward/backward
+    computes in bf16.  ``dtype_policy`` is a Python-level branch: the
+    default "f32" leaves the traced program byte-identical to a
+    pre-policy build."""
+    compute_dtype = (jnp.bfloat16 if (getattr(cfg, "compute_dtype", "float32")
+                     == "bfloat16" or dtype_policy == "bf16") else None)
 
     def _cast(tree, dtype):
         return jax.tree.map(
@@ -214,6 +222,7 @@ def make_train_step(
     output_names: Optional[Sequence[str]] = None,
     telemetry_metrics: bool = False,
     nonfinite_guard: bool = False,
+    dtype_policy: str = "f32",
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, Dict[str, jax.Array]]]:
     """``telemetry_metrics=True`` adds the in-jit norm/count extension; the
     trainer passes the MetricsLogger's enable state.  Default OFF so direct
@@ -224,7 +233,12 @@ def make_train_step(
     for NaN/Inf inside the jit and suppresses the whole update (old params,
     old opt state, old batch stats) on a bad step, adding a ``skipped``
     metric.  Default OFF: the guard-off program is byte-identical to a
-    pre-guard build."""
+    pre-guard build.
+
+    ``dtype_policy="bf16"`` runs the forward/backward in bf16 with f32
+    master params, optimizer state, and accumulators (see
+    _loss_and_metrics); the default "f32" is byte-identical to a
+    pre-policy build."""
     energy_head, forces_head = _force_head_indices(output_names)
 
     def train_step(state: TrainState, g: GraphBatch):
@@ -233,7 +247,8 @@ def make_train_step(
         def loss_fn(params):
             return _loss_and_metrics(
                 model, cfg, params, state.batch_stats, g, True,
-                energy_head, forces_head, dropout_rng)
+                energy_head, forces_head, dropout_rng,
+                dtype_policy=dtype_policy)
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -380,6 +395,7 @@ def make_scan_train_step(
     steps: int = 1,
     telemetry_metrics: bool = False,
     nonfinite_guard: bool = False,
+    dtype_policy: str = "f32",
 ):
     """K sequential train steps inside one executable via ``lax.scan``.
 
@@ -395,7 +411,8 @@ def make_scan_train_step(
 
     base = make_train_step(model, cfg, opt_spec, output_names,
                            telemetry_metrics=telemetry_metrics,
-                           nonfinite_guard=nonfinite_guard)
+                           nonfinite_guard=nonfinite_guard,
+                           dtype_policy=dtype_policy)
 
     def scan_step(state: TrainState, g: GraphBatch):
         state, ms = lax.scan(base, state, g, length=steps)
@@ -654,6 +671,46 @@ def _epoch_metrics(acc):
     return float(total) / n, np.asarray(tasks) / n
 
 
+# bf16-train acceptance bound: relative drift of the step-0 loss and global
+# gradient norm vs the f32 step on the SAME (state, batch).  5% is loose
+# against bf16's ~0.4% unit roundoff because the drift compounds through
+# the conv stack and the backward chain; a model that exceeds it at step 0
+# (e.g. a loss balanced on cancellation) would not train faithfully in
+# bf16, so the policy falls back to f32.  Module-level so tests can
+# monkeypatch the bound to force both verdicts.
+_TRAIN_DTYPE_TOL = 0.05
+
+
+def _train_dtype_gate(model, cfg, state, opt_spec, output_names, batch):
+    """Golden-replay probe for ``Training.train_dtype_policy="bf16"``:
+    run ONE f32 train step and ONE bf16-policy train step on the same
+    (state, first batch) — un-donated local jits, so neither touches the
+    run's real state — and compare loss + grad-norm relative drift
+    against :data:`_TRAIN_DTYPE_TOL`.  Returns (ok, drift_stats).
+
+    Mirrors serving's golden-batch replay (quant/policy.py): the operator
+    asked for a numerics change, so the change must prove itself against
+    the f32 reference on real data before the run commits to it.  Costs
+    two extra step compilations at step 0; the f32 probe's trace is the
+    same program the fallback path would jit anyway."""
+    f32_step = jax.jit(make_train_step(model, cfg, opt_spec, output_names,
+                                       telemetry_metrics=True))
+    bf_step = jax.jit(make_train_step(model, cfg, opt_spec, output_names,
+                                      telemetry_metrics=True,
+                                      dtype_policy="bf16"))
+    _, m32 = jax.device_get(f32_step(state, batch))
+    _, mbf = jax.device_get(bf_step(state, batch))
+    ok, stats = True, {}
+    for k in ("loss", "grad_norm"):
+        ref, got = float(m32[k]), float(mbf[k])
+        drift = abs(got - ref) / max(abs(ref), 1e-12)
+        stats[k] = drift
+        # `not <=` (rather than `>`): a NaN drift must reject too
+        if not drift <= _TRAIN_DTYPE_TOL:
+            ok = False
+    return ok, stats
+
+
 def train_validate_test(
     model: Base,
     cfg: ModelConfig,
@@ -805,6 +862,66 @@ def train_validate_test(
             window=int(stream_base.window), order=str(stream_base.order),
             batch_size=int(stream_base.batch_size),
             tail=bool(stream_base.tail_dir))
+    # -- training dtype policy (docs/PERF.md PR-15) -------------------------
+    # bf16 forward/backward with f32 master params/optimizer/accumulators.
+    # Resolved BEFORE the step builders (a trace-time choice, like ZeRO and
+    # graph sharding) and gated by a step-0 golden replay: an operator who
+    # requested bf16 believes the numerics hold, so a drifting model must
+    # fall back LOUDLY to f32 — bit-identical to an unrequested run.
+    from hydragnn_tpu.quant import check_train_policy
+
+    train_dtype = check_train_policy(
+        str(training.get("train_dtype_policy", "f32") or "f32"))
+    env_td = os.environ.get("HYDRAGNN_TRAIN_DTYPE", "").strip().lower()
+    if env_td:
+        train_dtype = check_train_policy(env_td)
+    train_dtype_requested = train_dtype
+    if train_dtype == "bf16":
+        import warnings
+
+        resumed_td = (resume_meta or {}).get("pipeline", {}).get(
+            "train_dtype")
+        if resumed_td is not None:
+            # crash/resume bit-parity: the preempted run's accept/reject
+            # verdict is part of its traced program — reuse it verbatim
+            # instead of re-probing (a probe on a different first batch
+            # could flip the decision mid-run)
+            train_dtype = check_train_policy(str(resumed_td))
+        elif graph_shard != "off":
+            warnings.warn(
+                "train_dtype_policy=bf16 requested with graph sharding — "
+                "the halo/gspmd steps are not policy-threaded; training "
+                "f32", stacklevel=2)
+            telemetry.health("train_dtype_reject", requested="bf16",
+                             reason="graph_shard")
+            train_dtype = "f32"
+        else:
+            probe = next(iter(train_loader), None)
+            if probe is None:
+                warnings.warn(
+                    "train_dtype_policy=bf16 requested but the train "
+                    "loader is empty — the acceptance probe cannot run; "
+                    "training f32", stacklevel=2)
+                telemetry.health("train_dtype_reject", requested="bf16",
+                                 reason="empty_loader")
+                train_dtype = "f32"
+            else:
+                td_ok, td_drift = _train_dtype_gate(
+                    model, cfg, state, opt_spec, output_names, probe)
+                if not td_ok:
+                    warnings.warn(
+                        "train_dtype_policy=bf16 REJECTED by the step-0 "
+                        f"golden replay (relative drift {td_drift} vs "
+                        f"bound {_TRAIN_DTYPE_TOL}) — training f32",
+                        stacklevel=2)
+                    telemetry.health(
+                        "train_dtype_reject", requested="bf16",
+                        reason="golden_gate",
+                        drift_loss=float(td_drift.get("loss", 0.0)),
+                        drift_grad_norm=float(
+                            td_drift.get("grad_norm", 0.0)),
+                        tol=float(_TRAIN_DTYPE_TOL))
+                    train_dtype = "f32"
     if use_mesh_dp:
         from hydragnn_tpu.parallel.mesh import (
             DeviceStackLoader,
@@ -982,7 +1099,8 @@ def train_validate_test(
                 model, cfg, opt_spec, mesh, output_names, axis=dp_axes,
                 zero_specs=zero_sh, steps=steps_per_dispatch,
                 telemetry_metrics=telemetry.enabled,
-                nonfinite_guard=res_cfg.nonfinite_guard)
+                nonfinite_guard=res_cfg.nonfinite_guard,
+                dtype_policy=train_dtype)
             eval_step = make_dp_eval_step(model, cfg, mesh, axis=dp_axes,
                                           zero=zero_sh)
             _align_bucket_group(
@@ -1099,7 +1217,8 @@ def train_validate_test(
                 make_scan_train_step(model, cfg, opt_spec, output_names,
                                      steps_per_dispatch,
                                      telemetry_metrics=telemetry.enabled,
-                                     nonfinite_guard=res_cfg.nonfinite_guard),
+                                     nonfinite_guard=res_cfg.nonfinite_guard,
+                                     dtype_policy=train_dtype),
                 donate_argnums=0)
             _align_bucket_group(train_loader, steps_per_dispatch)
             train_loader = DeviceStackLoader(
@@ -1108,7 +1227,8 @@ def train_validate_test(
             train_step = jax.jit(
                 make_train_step(model, cfg, opt_spec, output_names,
                                 telemetry_metrics=telemetry.enabled,
-                                nonfinite_guard=res_cfg.nonfinite_guard),
+                                nonfinite_guard=res_cfg.nonfinite_guard,
+                                dtype_policy=train_dtype),
                 donate_argnums=0)
         if env_flag("HYDRAGNN_DEVICE_PREFETCH"):
             # async H2D of upcoming (stacked) batches — AFTER stacking, so
@@ -1199,6 +1319,8 @@ def train_validate_test(
                      "resident": bool(resident_on),
                      "zero_stage": zero_stage,
                      "graph_shard": graph_shard,
+                     "train_dtype": train_dtype,
+                     "train_dtype_requested": train_dtype_requested,
                      "auto_selected":
                          "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
@@ -1269,6 +1391,10 @@ def train_validate_test(
                          # stack, so graph_shard must match
                          "zero_stage": zero_stage,
                          "graph_shard": graph_shard,
+                         # accept/reject verdict, not the request: a
+                         # resumed run reuses it verbatim (no re-probe) so
+                         # the continuation traces the SAME program
+                         "train_dtype": train_dtype,
                          "n_local_devices": n_local_devices},
             "world_size": world_size,
         }
@@ -1315,6 +1441,15 @@ def train_validate_test(
                 guard=guard_monitor, preempt=preempt, chaos=chaos,
                 skip_first=sf, consumed_base=ff_base)
             tr.stop("train")
+            if epoch == start_epoch:
+                # model dispatch sites recorded any fell-off-the-fast-path
+                # reasons at trace time (telemetry/pipeline.py); the first
+                # epoch's dispatch is done, so surface them as health
+                # events an operator (and teleview) will actually see
+                from hydragnn_tpu.telemetry import pipeline as _pipe
+
+                for fb in _pipe.pop_fallbacks("egcl"):
+                    telemetry.health("egcl_fallback", **fb)
             if preempt is not None and preempt.stop_requested:
                 # preemption agreed mid-epoch: bundle the exact position
                 # (epoch + items consumed) and stop; `continue` resumes here

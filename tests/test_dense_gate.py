@@ -1,0 +1,124 @@
+"""bench.py --dense acceptance bound (docs/PERF.md PR-15): every dense
+rung must clear the MFU floor and every mainline fused arch must report
+fused dispatch — pure verdict logic pinned here on synthetic evidence,
+plus the CLI exit code and teleview's WARNING rendering of the same
+bound."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _good_evidence():
+    return {
+        "dense": {
+            "SchNet-h256-bf16-b512": {"mfu_pct": 8.5,
+                                      "graphs_per_sec": 24000.0},
+            "SchNet-h1024-bf16-b2048-tight": {"mfu_pct": 19.0,
+                                              "graphs_per_sec": 9000.0},
+        },
+        "archs": {
+            "SchNet": {"graphs_per_sec": 60000, "aggr_backend": "fused"},
+            "GAT": {"graphs_per_sec": 50000, "aggr_backend": "fused"},
+            "EGNN": {"graphs_per_sec": 40000, "aggr_backend": "fused"},
+            # non-mainline stacks ride the generic kernels — a scatter
+            # tally there is NOT a gate failure
+            "SAGE": {"graphs_per_sec": 70000, "aggr_backend": "scatter"},
+        },
+    }
+
+
+def test_gate_passes_good_evidence():
+    ok, failures, table = bench.dense_gate(_good_evidence())
+    assert ok and not failures
+    assert {r["name"] for r in table if r["kind"] == "arch"} == {
+        "SchNet", "GAT", "EGNN", "SAGE"}
+
+
+def test_gate_fails_low_mfu_rung():
+    ev = _good_evidence()
+    ev["dense"]["SchNet-h256-bf16-b512"]["mfu_pct"] = (
+        bench.DENSE_MFU_FLOOR - 0.1)
+    ok, failures, _ = bench.dense_gate(ev)
+    assert not ok
+    assert any("MFU" in f and "h256" in f for f in failures)
+
+
+def test_gate_fails_mainline_arch_off_fused_path():
+    for bad in ("scatter", "mixed(fused=3,scatter=1)", "none", None):
+        ev = _good_evidence()
+        ev["archs"]["EGNN"]["aggr_backend"] = bad
+        ok, failures, _ = bench.dense_gate(ev)
+        assert not ok, bad
+        assert any("EGNN" in f and "fused path" in f for f in failures)
+
+
+def test_gate_fails_errored_mainline_and_empty_evidence():
+    ev = _good_evidence()
+    ev["archs"]["GAT"] = {"error": "RESOURCE_EXHAUSTED"}
+    ok, failures, _ = bench.dense_gate(ev)
+    assert not ok and any("GAT" in f for f in failures)
+    ok, failures, _ = bench.dense_gate({})
+    assert not ok and any("no dense/archs evidence" in f for f in failures)
+
+
+def test_dense_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_good_evidence()))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--dense",
+         "--evidence", str(good)],
+        capture_output=True, text=True, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1])[
+        "dense_gate"] == "PASS"
+
+    ev = _good_evidence()
+    ev["archs"]["SchNet"]["aggr_backend"] = "scatter"
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(ev))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--dense",
+         "--evidence", str(bad)],
+        capture_output=True, text=True, cwd=_ROOT)
+    assert r.returncode == 1
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["dense_gate"] == "FAIL" and line["failures"]
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--dense",
+         "--evidence", str(tmp_path / "missing.json")],
+        capture_output=True, text=True, cwd=_ROOT)
+    assert r.returncode == 2
+
+
+def test_teleview_renders_gate_as_warning(tmp_path):
+    events = tmp_path / "events.jsonl"
+    events.write_text(json.dumps({"event": "epoch", "epoch": 0,
+                                  "train_loss": 1.0}) + "\n")
+    ev = _good_evidence()
+    ev["archs"]["EGNN"]["aggr_backend"] = "scatter"
+    bpath = tmp_path / "BENCH_evidence.json"
+    bpath.write_text(json.dumps(ev))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "teleview.py"),
+         str(events), "--bench", str(bpath)],
+        capture_output=True, text=True, cwd=_ROOT)
+    # teleview NARRATES the bound (exit 0) where bench --dense enforces it
+    assert r.returncode == 0, r.stderr
+    assert "WARNING" in r.stdout and "EGNN" in r.stdout
+
+    bpath.write_text(json.dumps(_good_evidence()))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "teleview.py"),
+         str(events), "--bench", str(bpath)],
+        capture_output=True, text=True, cwd=_ROOT)
+    assert r.returncode == 0
+    assert "PASS every bound held" in r.stdout

@@ -1,0 +1,345 @@
+"""Parity tests for the fused EGCL interaction block (ops/egcl_mp.py):
+forward, all gradients, masked edges / empty segments, the coordinate
+branch on and off, and the model-level EGNN wiring vs the composed path —
+interpret mode on CPU."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.ops.egcl_mp import egcl_block
+
+F, H = 16, 24  # distinct feature/hidden widths catch f/h transpositions
+
+
+def _batch(n_graphs=6, nodes=9, seed=0, isolate=False):
+    rng = np.random.RandomState(seed)
+    samples = []
+    for i in range(n_graphs):
+        pos = rng.rand(nodes, 3).astype(np.float32) * 2.2
+        if isolate and i == 0:
+            # empty segments: park two nodes far outside every cutoff so
+            # they have NO incident edges (their agg/psum rows must read 0)
+            pos[-2:] += 50.0
+        samples.append(GraphSample(
+            x=rng.rand(nodes, 2).astype(np.float32), pos=pos,
+            edge_index=radius_graph(pos, 1.4, 8),
+            graph_y=rng.rand(1).astype(np.float32)))
+    pad = PadSpec.for_batch(n_graphs, nodes,
+                            max(s.num_edges for s in samples))
+    prev = os.environ.get("HYDRAGNN_AGGR_BACKEND")
+    os.environ["HYDRAGNN_AGGR_BACKEND"] = "fused"
+    try:
+        return collate(samples, pad, [HeadSpec("e", "graph", 1)])
+    finally:
+        if prev is None:
+            os.environ.pop("HYDRAGNN_AGGR_BACKEND", None)
+        else:
+            os.environ["HYDRAGNN_AGGR_BACKEND"] = prev
+
+
+def _inputs(g, seed=1, edge_attr_dim=0):
+    """Random op inputs; geo is [diff(3), radial(1), edge_attr(A)] with
+    |diff| < 1 like the real normalized difference."""
+    rng = np.random.RandomState(seed)
+    n = g.x.shape[0]
+    e = g.senders.shape[0]
+    x = jnp.asarray(rng.randn(n, F), jnp.float32)
+    gd = 4 + edge_attr_dim
+    geo = jnp.asarray(rng.rand(e, gd) * 0.8, jnp.float32)
+    w0 = jnp.asarray(rng.randn(2 * F + 1 + edge_attr_dim, H) * 0.3,
+                     jnp.float32)
+    b0 = jnp.asarray(rng.randn(H) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.randn(H, H) * 0.3, jnp.float32)
+    b1 = jnp.asarray(rng.randn(H) * 0.1, jnp.float32)
+    wc0 = jnp.asarray(rng.randn(H, H) * 0.3, jnp.float32)
+    bc0 = jnp.asarray(rng.randn(H) * 0.1, jnp.float32)
+    wc1 = jnp.asarray(rng.randn(H, 1) * 0.5, jnp.float32)
+    return x, geo, w0, b0, w1, b1, wc0, bc0, wc1
+
+
+def _composed(x, geo, mask, w0, b0, w1, b1, wc0, bc0, wc1,
+              senders, receivers, n, equivariant):
+    """The composed-path math (models/egnn.py fallback route), on raw
+    weights."""
+    diff, feat = geo[:, :3], geo[:, 3:]
+    m = jnp.concatenate([x[senders], x[receivers], feat], axis=-1)
+    m = jax.nn.relu(m @ w0 + b0)
+    m = jax.nn.relu(m @ w1 + b1)
+    m = m * mask[:, None]
+    agg = jax.ops.segment_sum(m, senders, num_segments=n)
+    if not equivariant:
+        return agg, None
+    c = jax.nn.relu(m @ wc0 + bc0)
+    c = jnp.tanh(c @ wc1)
+    trans = jnp.clip(diff * c, -100.0, 100.0) * mask[:, None]
+    psum = jax.ops.segment_sum(trans, senders, num_segments=n)
+    return agg, psum
+
+
+def _run_fused(g, args, equivariant):
+    x, geo = args[0], args[1]
+    em = jnp.asarray(g.edge_mask).astype(jnp.int32)
+    perm = jnp.asarray(g.extras["edge_perm_sender"])
+    if equivariant:
+        return egcl_block(True, x, geo, em, *args[2:],
+                          g.senders, g.receivers, perm)
+    return egcl_block(False, x, geo, em, *args[2:6], None, None, None,
+                      g.senders, g.receivers, perm)
+
+
+def test_forward_matches_composed():
+    g = _batch()
+    args = _inputs(g)
+    mask = jnp.asarray(g.edge_mask)
+    agg, psum = _run_fused(g, args, True)
+    ref_agg, ref_psum = _composed(args[0], args[1], mask, *args[2:],
+                                  g.senders, g.receivers, args[0].shape[0],
+                                  True)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(ref_agg),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(psum[:, :3]),
+                               np.asarray(ref_psum), rtol=1e-5, atol=1e-5)
+
+
+def test_forward_non_equivariant():
+    """Last-layer EGCL: no coordinate branch, message sum only."""
+    g = _batch(seed=2)
+    args = _inputs(g, seed=3)
+    mask = jnp.asarray(g.edge_mask)
+    agg, psum = _run_fused(g, args, False)
+    assert psum is None
+    ref_agg, _ = _composed(args[0], args[1], mask, *args[2:],
+                           g.senders, g.receivers, args[0].shape[0], False)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(ref_agg),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_empty_segments():
+    """Nodes with no incident edges (isolated + padding slots) read
+    exactly zero in both outputs."""
+    g = _batch(seed=4, isolate=True)
+    args = _inputs(g, seed=5)
+    mask = jnp.asarray(g.edge_mask)
+    agg, psum = _run_fused(g, args, True)
+    ref_agg, ref_psum = _composed(args[0], args[1], mask, *args[2:],
+                                  g.senders, g.receivers, args[0].shape[0],
+                                  True)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(ref_agg),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(psum[:, :3]),
+                               np.asarray(ref_psum), rtol=1e-5, atol=1e-5)
+    # the isolated nodes really have no edges (the scenario is live)
+    deg = np.zeros(args[0].shape[0])
+    np.add.at(deg, np.asarray(g.senders)[np.asarray(mask) > 0], 1.0)
+    assert (deg == 0).any()
+    assert np.all(np.asarray(agg)[deg == 0] == 0.0)
+
+
+def _grad_parity(g, seed, equivariant, edge_attr_dim=0,
+                 rtol=3e-4, atol=3e-4):
+    args = _inputs(g, seed=seed, edge_attr_dim=edge_attr_dim)
+    mask = jnp.asarray(g.edge_mask)
+    n = args[0].shape[0]
+    rng = np.random.RandomState(seed + 70)
+    wa = jnp.asarray(rng.randn(n, H), jnp.float32)
+    wp = jnp.asarray(rng.randn(n, 3), jnp.float32)
+    nargs = len(args) if equivariant else 7
+
+    def loss_fused(a):
+        agg, psum = _run_fused(g, a, equivariant)
+        out = jnp.sum(agg * wa)
+        if equivariant:
+            out = out + jnp.sum(psum[:, :3] * wp)
+        return out
+
+    def loss_ref(a):
+        full = tuple(a) + tuple(args[len(a):])
+        agg, psum = _composed(full[0], full[1], mask, *full[2:],
+                              g.senders, g.receivers, n, equivariant)
+        out = jnp.sum(agg * wa)
+        if equivariant:
+            out = out + jnp.sum(psum * wp)
+        return out
+
+    gf = jax.grad(loss_fused)(args[:nargs])
+    gr = jax.grad(loss_ref)(args[:nargs])
+    emask = np.asarray(g.edge_mask)
+    names = ("x", "geo", "w0", "b0", "w1", "b1", "wc0", "bc0", "wc1")
+    for name, a, b in zip(names, gf, gr):
+        a, b = np.asarray(a), np.asarray(b)
+        if name == "geo":
+            # contract: masked edges get EXACTLY zero dgeo (their blocks
+            # are schedule-skipped; uninitialized rows are where-selected)
+            assert np.all(a[emask == 0] == 0.0)
+            a, b = a[emask == 1], b[emask == 1]
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                   err_msg=name)
+
+
+def test_gradients_match_composed():
+    _grad_parity(_batch(seed=3), seed=6, equivariant=True)
+
+
+def test_gradients_non_equivariant():
+    _grad_parity(_batch(seed=7), seed=8, equivariant=False)
+
+
+def test_gradients_with_edge_attr():
+    """edge_attr lanes ride the geo stream; their grads must chain too."""
+    _grad_parity(_batch(seed=9), seed=10, equivariant=True,
+                 edge_attr_dim=5)
+
+
+def test_model_level_fused_equals_composed(monkeypatch):
+    """EGNN with the fused block forced on vs off: same params (the
+    _DenseParams tree matches the composed path's), same forward, same
+    param grads — through BOTH the message and coordinate branches (two
+    conv layers: the first is equivariant, so updated positions feed the
+    second layer's geometry)."""
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+
+    g = _batch(n_graphs=4, seed=5)  # fewer edge blocks: interpret mode
+    cfg = ModelConfig(
+        model_type="EGNN", input_dim=2, hidden_dim=F, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        equivariance=True, radius=1.4, max_neighbours=8)
+    model = create_model(cfg)
+    monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "1")
+    variables = model.init({"params": jax.random.PRNGKey(0)}, g,
+                           train=False)
+
+    def loss(params, fused):
+        monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "1" if fused else "0")
+        out = model.apply({"params": params}, g, train=False)
+        return sum(jnp.sum(o * o) for o in out)
+
+    lf = loss(variables["params"], True)
+    lg = loss(variables["params"], False)
+    np.testing.assert_allclose(float(lf), float(lg), rtol=2e-5)
+
+    gf = jax.grad(lambda p: loss(p, True))(variables["params"])
+    gp = jax.grad(lambda p: loss(p, False))(variables["params"])
+    flat_f = jax.tree_util.tree_leaves_with_path(gf)
+    flat_p = dict(jax.tree_util.tree_leaves_with_path(gp))
+    assert flat_f  # same tree structure both ways
+    for path, leaf in flat_f:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_p[path]), rtol=5e-4,
+            atol=5e-4, err_msg=str(path))
+
+
+def test_pipeline_gate_defaults(monkeypatch):
+    from hydragnn_tpu.models.egnn import _egcl_pipeline_enabled
+
+    # judge the defaults with the env override ABSENT — a developer's
+    # ambient HYDRAGNN_EGCL_FUSED would flip the first assert
+    monkeypatch.delenv("HYDRAGNN_EGCL_FUSED", raising=False)
+    assert _egcl_pipeline_enabled(64, 64, 4)     # mainline: default ON
+    assert not _egcl_pipeline_enabled(256, 64, 4)   # features > tile
+    assert not _egcl_pipeline_enabled(64, 256, 4)   # hidden > tile
+    assert not _egcl_pipeline_enabled(64, 64, 200)  # geo payload > lanes
+    monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "0")
+    assert not _egcl_pipeline_enabled(64, 64, 4)    # forced off
+    monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "1")
+    assert _egcl_pipeline_enabled(128, 128, 4)      # forced on
+
+
+def test_dispatch_tally_counts_egcl(monkeypatch):
+    """The egcl dispatch site tallies fused vs scatter — that tally is
+    what makes EGNN visible to bench's per-arch aggr_backend column."""
+    from hydragnn_tpu.telemetry import pipeline as tp
+    from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+    from hydragnn_tpu.models.create import create_model
+
+    g = _batch(seed=11)
+    cfg = ModelConfig(
+        model_type="EGNN", input_dim=2, hidden_dim=F, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2,
+        equivariance=True, radius=1.4, max_neighbours=8)
+    model = create_model(cfg)
+    monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "1")
+    before = tp.dispatch_snapshot()
+    variables = model.init({"params": jax.random.PRNGKey(0)}, g,
+                           train=False)
+    model.apply({"params": variables["params"]}, g, train=False)
+    delta = tp.dispatch_delta(before, tp.dispatch_snapshot())
+    assert delta.get("egcl:fused", 0) > 0
+    monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "0")
+    before = tp.dispatch_snapshot()
+    model.apply({"params": variables["params"]}, g, train=False)
+    delta = tp.dispatch_delta(before, tp.dispatch_snapshot())
+    assert delta.get("egcl:scatter", 0) > 0
+    # forcing fused requested-but-denied records the fallback reason
+    tp.pop_fallbacks("egcl")
+    monkeypatch.setenv("HYDRAGNN_EGCL_FUSED", "1")
+    monkeypatch.setattr("hydragnn_tpu.ops.egcl_mp.EGCL_H_LIMIT", 1)
+    model.apply({"params": variables["params"]}, g, train=False)
+    fbs = tp.pop_fallbacks("egcl")
+    assert fbs and fbs[0]["reason"] == "width_gate"
+
+
+def test_bf16_forward_within_tolerance():
+    """bf16 node features ride bf16 windows in VMEM; result must stay
+    within bf16 tolerance of the f32 composed path."""
+    g = _batch(seed=6)
+    args = _inputs(g, seed=12)
+    mask = jnp.asarray(g.edge_mask)
+    bf_args = (args[0].astype(jnp.bfloat16),) + args[1:]
+    agg, psum = _run_fused(g, bf_args, True)
+    assert agg.dtype == jnp.bfloat16
+    ref_agg, ref_psum = _composed(args[0], args[1], mask, *args[2:],
+                                  g.senders, g.receivers, args[0].shape[0],
+                                  True)
+    for out, ref in ((agg, ref_agg), (psum[:, :3], ref_psum)):
+        ref = np.asarray(ref, np.float32)
+        scale = np.abs(ref).max() + 1e-6
+        err = np.abs(np.asarray(out, np.float32) - ref).max() / scale
+        assert err < 0.03, err
+
+
+def test_bf16_gradients_within_tolerance():
+    """bf16 operands through the fused backward (weight grads included)
+    stay within bf16 drift of the f32 composed reference."""
+    g = _batch(seed=13)
+    args = _inputs(g, seed=14)
+    mask = jnp.asarray(g.edge_mask)
+    n = args[0].shape[0]
+    rng = np.random.RandomState(15)
+    wa = jnp.asarray(rng.randn(n, H), jnp.float32)
+
+    def loss_fused(a):
+        bf = (a[0].astype(jnp.bfloat16),) + tuple(a[1:])
+        agg, psum = _run_fused(g, bf, True)
+        return (jnp.sum(agg.astype(jnp.float32) * wa)
+                + jnp.sum(psum[:, :3]))
+
+    def loss_ref(a):
+        agg, psum = _composed(a[0], a[1], mask, *a[2:],
+                              g.senders, g.receivers, n, True)
+        return jnp.sum(agg * wa) + jnp.sum(psum)
+
+    gf = jax.grad(loss_fused)(args)
+    gr = jax.grad(loss_ref)(args)
+    emask = np.asarray(g.edge_mask).astype(bool)
+    names = ("x", "geo", "w0", "b0", "w1", "b1", "wc0", "bc0", "wc1")
+    for name, a, b in zip(names, gf, gr):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        if name == "geo":
+            a, b = a[emask], b[emask]
+        scale = np.abs(b).max() + 1e-6
+        err = np.abs(a - b).max() / scale
+        # deeper chain than scf's two matmuls (edge MLP + coord gate +
+        # tanh, 4 bf16 matmul layers each way) — drift bound scales with
+        # depth; observed ~0.067 max on x grads.  geo's diff lanes carry
+        # the gate value c itself (ddiff = c * dpsum), whose relative
+        # error is the whole chain's accumulated drift: widest bound.
+        assert err < (0.20 if name == "geo" else 0.10), (name, err)

@@ -229,7 +229,8 @@ class _Loaders:
 
 
 def _run(loaders, tmp_path, name, num_epoch=3, use_mesh_dp=False,
-         resume_meta=None, state=None, training_extra=None, lr=0.01):
+         resume_meta=None, state=None, training_extra=None, lr=0.01,
+         telemetry=None):
     cfg, model = _model()
     opt = select_optimizer({"type": "AdamW", "learning_rate": lr})
     train_l, val_l, test_l = loaders()
@@ -240,7 +241,7 @@ def _run(loaders, tmp_path, name, num_epoch=3, use_mesh_dp=False,
         model, cfg, state, opt, train_l, val_l, test_l,
         {"Training": training, "Variables_of_interest": {"output_names": ["e"]}},
         log_name=name, logs_dir=str(tmp_path), use_mesh_dp=use_mesh_dp,
-        resume_meta=resume_meta)
+        resume_meta=resume_meta, telemetry=telemetry)
 
 
 def _fresh_skeleton(loaders, lr=0.01):

@@ -1,0 +1,223 @@
+"""bf16/f32-accum training dtype policy (docs/PERF.md PR-15):
+``Training.train_dtype_policy`` / HYDRAGNN_TRAIN_DTYPE run the train-step
+forward/backward in bf16 with f32 master params, optimizer state and
+accumulators — gated by a step-0 golden-replay probe that falls back
+LOUDLY to f32, with the verdict persisted in the resume bundle so a
+preempted run replays the same program (crash/resume bit-parity)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import HeadSpec, PadSpec, collate
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.parallel.mesh import stack_batches
+from hydragnn_tpu.resilience import load_resume_bundle, resume_dir
+from hydragnn_tpu.telemetry import MetricsLogger
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.trainer import (
+    create_train_state,
+    make_scan_train_step,
+    make_train_step,
+)
+
+from test_resilience import (  # reuse the deterministic-loader harness
+    _Loaders,
+    _batch,
+    _fresh_skeleton,
+    _leaves_equal,
+    _model,
+    _run,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_TRAIN_DTYPE", raising=False)
+
+
+# ---------------------------------------------------------------------------
+# default OFF => byte-identical HLO on all three step paths
+# ---------------------------------------------------------------------------
+
+
+def test_policy_off_unchanged_hlo_local_and_scan():
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    b = _batch()
+    s0 = create_train_state(model, b, opt)
+
+    base = jax.jit(make_train_step(model, cfg, opt)).lower(s0, b).as_text()
+    off = jax.jit(make_train_step(model, cfg, opt, dtype_policy="f32")
+                  ).lower(s0, b).as_text()
+    on = jax.jit(make_train_step(model, cfg, opt, dtype_policy="bf16")
+                 ).lower(s0, b).as_text()
+    assert off == base  # explicit "f32" is the default — same program
+    assert on != base and "bf16" in on
+    assert "bf16" not in base
+
+    sb = stack_batches([_batch(seed=1), _batch(seed=2)])
+    sbase = jax.jit(make_scan_train_step(model, cfg, opt, None, 2)
+                    ).lower(s0, sb).as_text()
+    soff = jax.jit(make_scan_train_step(model, cfg, opt, None, 2,
+                                        dtype_policy="f32")
+                   ).lower(s0, sb).as_text()
+    son = jax.jit(make_scan_train_step(model, cfg, opt, None, 2,
+                                       dtype_policy="bf16")
+                  ).lower(s0, sb).as_text()
+    assert soff == sbase
+    assert son != sbase and "bf16" in son
+
+
+def test_policy_off_unchanged_hlo_mesh_dp():
+    from hydragnn_tpu.parallel.mesh import (
+        make_dp_train_step,
+        make_mesh,
+        replicate_state,
+    )
+
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    mesh = make_mesh()
+    n_dev = len(jax.devices())
+    batches = stack_batches([_batch(seed=i) for i in range(n_dev)])
+    s0 = replicate_state(
+        create_train_state(model, _batch(), opt), mesh)
+
+    base = make_dp_train_step(model, cfg, opt, mesh).lower(
+        s0, batches).as_text()
+    off = make_dp_train_step(model, cfg, opt, mesh, dtype_policy="f32"
+                             ).lower(s0, batches).as_text()
+    on = make_dp_train_step(model, cfg, opt, mesh, dtype_policy="bf16"
+                            ).lower(s0, batches).as_text()
+    assert off == base
+    assert on != base and "bf16" in on
+
+
+def test_bf16_policy_keeps_master_state_f32():
+    """The policy changes COMPUTE dtype only: updated params, optimizer
+    state and batch stats come back f32 (master copies), and the loss
+    tracks the f32 step within bf16 tolerance."""
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    b = _batch()
+    s0 = create_train_state(model, b, opt)
+
+    sf, mf = jax.jit(make_train_step(model, cfg, opt))(s0, b)
+    sb, mb = jax.jit(make_train_step(model, cfg, opt, dtype_policy="bf16")
+                     )(s0, b)
+    for leaf in jax.tree.leaves((sb.params, sb.opt_state, sb.batch_stats)):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    ref = float(mf["loss"])
+    assert abs(float(mb["loss"]) - ref) < 0.05 * (abs(ref) + 1e-3)
+
+
+# ---------------------------------------------------------------------------
+# trainer-level gate: accept, reject-with-bit-identical-fallback, env knob
+# ---------------------------------------------------------------------------
+
+
+def test_gate_accepts_bf16_policy_via_env(tmp_path, monkeypatch):
+    """One run covers both accept paths: the env knob overlays the
+    config default, and the golden gate passes on the toy model (the
+    config-route accept is exercised by the resume-parity test below)."""
+    monkeypatch.setenv("HYDRAGNN_TRAIN_DTYPE", "bf16")
+    loaders = _Loaders(n_train=16)
+    _, hist = _run(loaders, tmp_path, "bf16_on", num_epoch=1)
+    assert hist["pipeline"]["train_dtype"] == "bf16"
+    assert hist["pipeline"]["train_dtype_requested"] == "bf16"
+    assert np.isfinite(hist["train"][0])
+
+
+def test_gate_reject_falls_back_bit_identical(tmp_path, monkeypatch):
+    """A rejected bf16 request must train EXACTLY as an unrequested run:
+    same f32 program, bit-identical params — plus a loud
+    `train_dtype_reject` health event."""
+    import hydragnn_tpu.train.trainer as trainer_mod
+
+    loaders = _Loaders(n_train=16)
+    state_ref, hist_ref = _run(loaders, tmp_path, "f32_ref", num_epoch=1)
+    assert hist_ref["pipeline"]["train_dtype"] == "f32"
+
+    # an impossible bound rejects every model (drift >= 0 > -1 fails)
+    monkeypatch.setattr(trainer_mod, "_TRAIN_DTYPE_TOL", -1.0)
+    tele = MetricsLogger.disabled()
+    with pytest.warns(UserWarning, match="REJECTED"):
+        state_rej, hist_rej = _run(
+            loaders, tmp_path, "bf16_rejected", num_epoch=1,
+            training_extra={"train_dtype_policy": "bf16"},
+            telemetry=tele)
+    assert hist_rej["pipeline"]["train_dtype"] == "f32"
+    assert hist_rej["pipeline"]["train_dtype_requested"] == "bf16"
+    assert tele.health_counts.get("train_dtype_reject") == 1
+    assert _leaves_equal(state_rej.params, state_ref.params)
+    assert _leaves_equal(state_rej.opt_state, state_ref.opt_state)
+
+
+def test_config_validates_train_dtype_policy():
+    from hydragnn_tpu.quant import check_train_policy
+
+    assert check_train_policy("f32") == "f32"
+    assert check_train_policy("bf16") == "bf16"
+    with pytest.raises(ValueError, match="train dtype policy"):
+        check_train_policy("int8")  # inference-only policy
+    with pytest.raises(ValueError, match="train dtype policy"):
+        check_train_policy("bfloat16")  # the knob vocabulary is bf16
+
+    here = os.path.join(os.path.dirname(__file__), "inputs", "ci.json")
+    from hydragnn_tpu.config.config import DatasetStats, finalize
+
+    config = json.load(open(here))
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+    stats = DatasetStats(num_nodes_sample=8, graph_size_variable=True)
+    out = finalize(config, stats)
+    # default written back (same contract as zero_stage)
+    assert out["NeuralNetwork"]["Training"]["train_dtype_policy"] == "f32"
+    config["NeuralNetwork"]["Training"]["train_dtype_policy"] = "fp16"
+    with pytest.raises(ValueError, match="train dtype policy"):
+        finalize(config, stats)
+
+
+# ---------------------------------------------------------------------------
+# crash/resume bit-parity under the policy
+# ---------------------------------------------------------------------------
+
+
+def test_crash_and_resume_bit_parity_bf16(tmp_path, monkeypatch):
+    """The accept verdict rides the resume bundle: the resumed run reuses
+    it (no re-probe) and continues the SAME bf16 program — final params
+    bit-identical to the uninterrupted bf16 run."""
+    monkeypatch.delenv("HYDRAGNN_CHAOS_PREEMPT_STEP", raising=False)
+    loaders = _Loaders(n_train=24, batch_size=8)  # 3 steps/epoch
+    extra = {"train_dtype_policy": "bf16"}
+
+    state_a, hist_a = _run(loaders, tmp_path, "bf16_full", num_epoch=2,
+                           training_extra=extra)
+    assert "preempted" not in hist_a
+    assert hist_a["pipeline"]["train_dtype"] == "bf16"
+
+    monkeypatch.setenv("HYDRAGNN_CHAOS_PREEMPT_STEP", "4")  # mid-epoch 2
+    _, hist_b = _run(loaders, tmp_path, "bf16_cut", num_epoch=2,
+                     training_extra=extra)
+    assert hist_b.get("preempted") is True
+    monkeypatch.delenv("HYDRAGNN_CHAOS_PREEMPT_STEP")
+
+    rdir = resume_dir(str(tmp_path), "bf16_cut")
+    bundle = load_resume_bundle(_fresh_skeleton(loaders), rdir)
+    assert bundle is not None
+    state_r, meta = bundle
+    assert meta["pipeline"]["train_dtype"] == "bf16"
+    state_c, hist_c = _run(loaders, tmp_path, "bf16_cut", num_epoch=2,
+                           training_extra=extra,
+                           resume_meta=meta, state=state_r)
+    assert "preempted" not in hist_c
+    assert hist_c["pipeline"]["train_dtype"] == "bf16"
+
+    assert _leaves_equal(state_c.params, state_a.params)
+    assert _leaves_equal(state_c.opt_state, state_a.opt_state)

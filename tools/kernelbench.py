@@ -11,7 +11,7 @@ graph/segment.py choose between:
   dense     sorted dense-schedule scatter (ops/fused_mp.segment_sum_dense)
   poly      fused multi-moment pass (ops/poly_mp.segment_poly_dense)
 
-Three moment sets:
+Four moment sets:
 
   sum       plain segment sum — every backend
   pna       the PNA aggregator set (sum + sum-of-squares + max/min +
@@ -25,6 +25,13 @@ Three moment sets:
             end-to-end serving noise.  Runs on every backend (no Pallas);
             NOTE on CPU XLA emulates bf16, so the low-precision rows
             lose there — the HBM/MXU win is TPU-only.
+  egcl      the EGNN interaction block (ops/egcl_mp.py, docs/PERF.md
+            PR-15): composed XLA chain (2 gathers -> 2-layer edge MLP ->
+            tanh coordinate gate -> TWO segment scatters) vs the ONE
+            fused Pallas pass, each as f32 and bf16 — the number behind
+            the EGNN mainline-MFU claim.  The fused rows are Pallas
+            (skipped off-TPU without --force-pallas); bf16 carries the
+            same CPU-emulation caveat as matmul.
 
 Methodology matches bench.py: each measurement jits a fori_loop of
 ``--inner`` serially-dependent applications (the loop carry feeds a hair of
@@ -164,6 +171,70 @@ def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas,
                                      ).astype(jnp.float32),
         }
 
+    if moments == "egcl":
+        # EGNN interaction block: composed vs the one fused pass, f32 and
+        # bf16.  Weights and edge structure are built EAGERLY like matmul.
+        # The timed input is the NODE feature table (first n rows of the
+        # [E, F] problem data — E > N at every sweep shape); senders are
+        # drawn inside the receiver's 128-node block, the collate
+        # invariant (graphs never straddle a node block) the dense
+        # schedule's 3-block gather windows rely on, and padding edges
+        # park on node N-1 tail-sorted in BOTH orderings.
+        from hydragnn_tpu.ops.egcl_mp import egcl_block
+
+        rng = np.random.RandomState(13)
+        e = receivers.shape[0]
+        s_np = ((receivers // 128) * 128
+                + rng.randint(0, 128, e)).astype(np.int32)
+        s_np = np.minimum(s_np, n - 1)
+        s_np[mask == 0] = n - 1  # padding edges: max sender id + stable
+        perm = jnp.asarray(np.argsort(s_np, kind="stable")  # sort => tail
+                           .astype(np.int32))
+        s = jnp.asarray(s_np)
+        em = jnp.asarray((mask > 0).astype(np.int32))
+        geo = jnp.asarray(np.concatenate(
+            [rng.randn(e, 3).astype(np.float32) * 0.4,
+             rng.rand(e, 1).astype(np.float32)], axis=1))
+        w0 = jnp.asarray(rng.randn(2 * feat + 1, feat)
+                         .astype(np.float32) * 0.1)
+        b0 = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+        w1 = jnp.asarray(rng.randn(feat, feat).astype(np.float32) * 0.1)
+        b1 = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+        wc0 = jnp.asarray(rng.randn(feat, feat).astype(np.float32) * 0.1)
+        bc0 = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+        wc1 = jnp.asarray(rng.randn(feat, 1).astype(np.float32) * 0.3)
+        diff, radial = geo[:, :3], geo[:, 3:]
+
+        def composed(d, dt):
+            x = d[:n].astype(dt)
+            msg = jnp.concatenate(
+                [x[s], x[r], radial.astype(dt)], axis=-1)
+            msg = jax.nn.relu(msg @ w0.astype(dt) + b0.astype(dt))
+            msg = jax.nn.relu(msg @ w1.astype(dt) + b1.astype(dt))
+            msg = msg * m[:, None].astype(dt)
+            agg = jax.ops.segment_sum(msg, s, num_segments=n)
+            c = jax.nn.relu(msg @ wc0.astype(dt) + bc0.astype(dt))
+            c = jnp.tanh(c @ wc1.astype(dt))
+            trans = jnp.clip(diff.astype(dt) * c, -100.0, 100.0)
+            psum = jax.ops.segment_sum(trans * m[:, None].astype(dt),
+                                       s, num_segments=n)
+            return agg.astype(jnp.float32), psum.astype(jnp.float32)
+
+        def fused(d, dt):
+            agg, psum = egcl_block(
+                True, d[:n].astype(dt), geo, em, w0, b0, w1, b1,
+                wc0, bc0, wc1, s, r, perm)
+            return agg.astype(jnp.float32), psum
+
+        out = {
+            "composed-f32": lambda d: composed(d, jnp.float32),
+            "composed-bf16": lambda d: composed(d, jnp.bfloat16),
+        }
+        if run_pallas:
+            out["fused-f32"] = lambda d: fused(d, jnp.float32)
+            out["fused-bf16"] = lambda d: fused(d, jnp.bfloat16)
+        return out
+
     if moments == "sum":
         out = {
             "scatter": lambda d: jax.ops.segment_sum(
@@ -217,8 +288,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--shapes", default="small,flagship",
                     help=f"comma list from {sorted(SHAPES)}")
-    ap.add_argument("--moments", default="sum,pna,matmul",
-                    help="comma list from sum,pna,matmul")
+    ap.add_argument("--moments", default="sum,pna,matmul,egcl",
+                    help="comma list from sum,pna,matmul,egcl")
     ap.add_argument("--inner", type=int, default=20,
                     help="op applications per compiled loop (default 20)")
     ap.add_argument("--repeats", type=int, default=3,

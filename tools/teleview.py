@@ -419,6 +419,10 @@ def main(argv=None) -> int:
                     help="epoch rows only")
     ap.add_argument("--json", action="store_true",
                     help="re-emit selected records as JSONL")
+    ap.add_argument("--bench", default=None,
+                    help="BENCH_evidence.json from a bench run: render "
+                         "the --dense acceptance bound (MFU floor + "
+                         "fused-dispatch check) as WARNINGs")
     args = ap.parse_args(argv)
 
     path = find_events(args.path)
@@ -507,6 +511,30 @@ def main(argv=None) -> int:
         for name, s in sorted(timers.items()):
             print(f"  timer {name}: {s.get('total_s', 0.0):.3f}s "
                   f"over {int(s.get('count', 0))} calls")
+    if args.bench:
+        # the SAME bound `bench.py --dense` exits 1 on, rendered as
+        # WARNINGs (teleview never fails a pipeline — it narrates one)
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench as _bench
+
+        with open(args.bench) as f:
+            ev = json.load(f)
+        ok, failures, table = _bench.dense_gate(ev)
+        print(f"\ndense gate ({args.bench}): "
+              f"MFU floor {_bench.DENSE_MFU_FLOOR}%, fused dispatch on "
+              + "/".join(_bench.MAINLINE_FUSED_ARCHS))
+        for row in table:
+            if row["kind"] == "dense":
+                print(f"  rung {row['name']}: {row['mfu_pct']}% MFU  "
+                      f"{row['graphs_per_sec']} g/s")
+            else:
+                print(f"  arch {row['name']}: {row['graphs_per_sec']} g/s"
+                      f"  aggr={row['aggr_backend']}")
+        for fmsg in failures:
+            print(f"  WARNING {fmsg}")
+        if ok:
+            print("  PASS every bound held")
     return 0
 
 
