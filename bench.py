@@ -1170,6 +1170,115 @@ def _zero_main(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --comms: comm-vs-compute split of the sharded train steps
+# ---------------------------------------------------------------------------
+
+
+def _comms_main(argv) -> int:
+    """``python bench.py --comms``: per-step comm-vs-compute attribution
+    for the mesh DP / ZeRO-1 / ZeRO-2 train steps on the current mesh
+    (forced 8-device host mesh off-TPU), via the telemetry A/B probe
+    (hydragnn_tpu/telemetry/comms.py): the annotated full step is timed
+    against a collective-only shard_map replay of its pmean/all_gather
+    volume.  comm_pct rows are an upper bound on the collective's
+    critical-path share (overlap is not subtracted); on CPU the absolute
+    times are best-effort — the DELIVERABLE off-TPU is that the split is
+    measured and lands in the manifest/bench evidence at all.  Writes
+    BENCH_comms.json and prints one compact JSON line."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --comms")
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="graphs per DEVICE per step")
+    ap.add_argument("--iters", type=int, default=3,
+                    help="timed iterations per program")
+    ap.add_argument("--modes", default="dp,zero1,zero2",
+                    help="comma subset of dp,zero1,zero2")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_comms.json"))
+    args = ap.parse_args(argv)
+
+    # the probe needs collectives to exist: force a virtual 8-device host
+    # mesh unless the env already decided
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+    import jax
+
+    from hydragnn_tpu.parallel.mesh import (
+        make_mesh,
+        replicate_state,
+        stack_batches,
+    )
+    from hydragnn_tpu.parallel.zero import zero_shard_state
+    from hydragnn_tpu.telemetry.comms import dp_comms_probe
+
+    devs = jax.devices()
+    n_dev = len(devs)
+    mesh = make_mesh()
+    dtype = "bfloat16" if devs[0].platform == "tpu" else "float32"
+    print(f"bench --comms: platform={devs[0].platform} devices={n_dev} "
+          f"dtype={dtype}", file=sys.stderr)
+
+    state, batch, _step, cfg, _s, _h = _build(
+        hidden=args.hidden, dtype=dtype, batch_size=args.batch,
+        tight_edges=True)
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.train.optimizer import select_optimizer
+
+    model = create_model(cfg)
+    opt_spec = select_optimizer(BENCH_OPTIMIZER)
+    state = jax.device_get(state)  # host copy: each mode re-places it
+    stacked = jax.device_get(stack_batches([batch] * n_dev))
+
+    rows = {}
+    compact = {}
+    for mode in [m.strip() for m in args.modes.split(",") if m.strip()]:
+        if mode == "dp":
+            st, zs = replicate_state(state, mesh), None
+        elif mode in ("zero1", "zero2"):
+            st, zs = zero_shard_state(state, mesh,
+                                      stage=1 if mode == "zero1" else 2)
+        else:
+            print(f"bench --comms: unknown mode {mode!r} skipped",
+                  file=sys.stderr)
+            continue
+        split = dp_comms_probe(model, cfg, opt_spec, mesh, st, stacked,
+                               zero_specs=zs, iters=args.iters)
+        rows[mode] = split
+        compact[mode] = {"step_ms": split["step_ms"],
+                         "comm_ms": split["comm_ms"],
+                         "comm_pct": split["comm_pct"]}
+        print(f"bench --comms: {mode}: step {split['step_ms']:.2f} ms, "
+              f"comm {split['comm_ms']:.2f} ms ({split['comm_pct']}%)",
+              file=sys.stderr)
+        _release_device()  # mode boundary: drop all live device arrays
+
+    result = {
+        "metric": "comm_vs_compute_split",
+        "unit": "ms/step",
+        "platform": devs[0].platform,
+        "devices": n_dev,
+        "hidden": args.hidden,
+        "batch_per_device": args.batch,
+        "dtype": dtype,
+        "modes": rows,
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, args.out)
+    print(json.dumps({"metric": "comm_vs_compute_split", "devices": n_dev,
+                      "modes": compact,
+                      "evidence": os.path.basename(args.out)}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --giant: halo graph-sharding ladder (one giant graph across the mesh)
 # ---------------------------------------------------------------------------
 
@@ -1480,6 +1589,8 @@ if __name__ == "__main__":
         _child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
     elif len(sys.argv) > 1 and sys.argv[1] == "--zero":
         sys.exit(_zero_main(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--comms":
+        sys.exit(_comms_main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--giant":
         sys.exit(_giant_main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--dense":

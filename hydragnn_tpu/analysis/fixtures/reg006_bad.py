@@ -1,0 +1,10 @@
+"""Fixture: span names the registry has never heard of (REG006)."""
+
+
+class Traced:
+    def flush(self, tr, t0, t1, which):
+        tr.record_interval("serve.totally_undeclared", t0, t1)
+        with tr.span("another.rogue_span"):
+            pass
+        # dynamic name: the registry rule cannot see it at all
+        tr.record_interval(which, t0, t1)

@@ -1,0 +1,19 @@
+"""Fixture: declared span names only; unrelated ``.span()`` spellings
+(re.Match.span) stay out of the rule's reach (REG006 quiet)."""
+
+import re
+
+
+class Traced:
+    def flush(self, tr, t0, t1):
+        tr.record_interval("serve.flush", t0, t1, n=3)
+        with tr.span("serve.predict"):
+            pass
+
+    def comm(self, comm_region, probe):
+        with comm_region("comm.dp_psum", probe):
+            pass
+
+    def offsets(self, text):
+        m = re.match(r"\d+", text)
+        return m.span(0) if m else None

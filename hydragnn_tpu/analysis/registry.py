@@ -11,10 +11,16 @@ docs against:
 - every literal ``MetricsLogger.health(kind=...)`` emitted by the
   package must name a kind declared in :data:`HEALTH_KINDS` (REG003),
   and every declared kind must be emitted somewhere and documented in
-  docs/TELEMETRY.md (REG004).
+  docs/TELEMETRY.md (REG004);
+- every literal span name passed to the trace API (``span``,
+  ``record_interval``, ``comm_region`` calls) must name a span declared
+  in :data:`SPAN_NAMES` (REG006) — the flight recorder's waterfall and
+  percentile views group by these names, so an undeclared ad-hoc name is
+  a span nobody's dashboards will ever aggregate.
 
-Adding a knob or a health kind therefore means: declare it here, use
-it, document it — the lint gate fails on any one of the three missing.
+Adding a knob, health kind, or span therefore means: declare it here,
+use it, document it — the lint gate fails on any one of the three
+missing.
 """
 
 from __future__ import annotations
@@ -190,6 +196,45 @@ _KNOB_LIST = [
     _k("HYDRAGNN_PEAK_FLOPS", "", "197e12 (v5e bf16)",
        "hydragnn_tpu/telemetry/flops.py",
        "MFU peak-flops basis override"),
+    _k("HYDRAGNN_TRACE", "Telemetry.trace", "0",
+       "hydragnn_tpu/telemetry/trace.py",
+       "flight recorder: record request/train-phase spans (JSONL "
+       "event=span; adds one device sync per traced train step)"),
+    _k("HYDRAGNN_TRACE_RING", "Telemetry.trace_ring", "512",
+       "hydragnn_tpu/telemetry/trace.py",
+       "in-memory span ring capacity (JSONL stream is unbounded)"),
+    _k("HYDRAGNN_COMMS_PROBE", "", "0",
+       "hydragnn_tpu/telemetry/comms.py",
+       "A/B comm-vs-compute probe at train start (mesh DP path); split "
+       "lands in the manifest `comms` block"),
+    _k("HYDRAGNN_SLO_P99_MS", "", "0 (off)",
+       "hydragnn_tpu/telemetry/slo.py",
+       "serving SLO: p99 latency target the burn-rate monitor checks"),
+    _k("HYDRAGNN_SLO_SHED_BUDGET", "", "0.05",
+       "hydragnn_tpu/telemetry/slo.py",
+       "serving SLO: tolerated shed/error ratio (fraction of requests)"),
+    _k("HYDRAGNN_SLO_WINDOW_S", "", "60",
+       "hydragnn_tpu/telemetry/slo.py",
+       "burn-rate monitor sliding-window length"),
+    _k("HYDRAGNN_SLO_BURN", "", "2.0",
+       "hydragnn_tpu/telemetry/slo.py",
+       "burn-rate multiple of the shed budget that fires `slo_burn`"),
+    # -- profiler (utils/profile.py env overlay) --------------------------
+    _k("HYDRAGNN_PROFILE", "Profile.enable", "0",
+       "hydragnn_tpu/utils/profile.py",
+       "capture a jax.profiler device trace on the step schedule"),
+    _k("HYDRAGNN_PROFILE_WAIT", "Profile.wait", "5",
+       "hydragnn_tpu/utils/profile.py",
+       "profiler schedule: steps to skip before warmup"),
+    _k("HYDRAGNN_PROFILE_WARMUP", "Profile.warmup", "3",
+       "hydragnn_tpu/utils/profile.py",
+       "profiler schedule: warmup steps before the trace starts"),
+    _k("HYDRAGNN_PROFILE_ACTIVE", "Profile.active", "3",
+       "hydragnn_tpu/utils/profile.py",
+       "profiler schedule: traced steps"),
+    _k("HYDRAGNN_PROFILE_DIR", "Profile.trace_dir",
+       "logs/<run>/trace", "hydragnn_tpu/utils/profile.py",
+       "device-trace output directory"),
     # -- resilience (Training section) -----------------------------------
     _k("HYDRAGNN_NONFINITE_GUARD", "Training.nonfinite_guard", "0",
        "hydragnn_tpu/resilience/config.py",
@@ -495,9 +540,56 @@ _HEALTH_LIST = [
        "tail-mode store picked up newly sealed segments between epochs"),
     _h("stream_torn_segment", "hydragnn_tpu/data/stream/ingest.py",
        "ingest segment failed its manifest size check and was skipped"),
+    # SLO monitoring (docs/TELEMETRY.md "Tracing")
+    _h("slo_burn", "hydragnn_tpu/telemetry/slo.py",
+       "burn-rate monitor: serving latency/shed budget burning faster "
+       "than the configured multiple (edge-triggered per excursion)"),
 ]
 
 HEALTH_KINDS: Dict[str, HealthKind] = {h.name: h for h in _HEALTH_LIST}
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanName:
+    name: str
+    module: str  # recording module (repo-relative)
+    desc: str
+
+
+def _s(name, module, desc):
+    return SpanName(name=name, module=module, desc=desc)
+
+
+_SPAN_LIST = [
+    # serving request path (docs/TELEMETRY.md "Tracing")
+    _s("serve.request", "hydragnn_tpu/serve/server.py",
+       "one HTTP request, admission to reply (router or single server)"),
+    _s("serve.queue_wait", "hydragnn_tpu/serve/batcher.py",
+       "enqueue -> flush pickup for one traced request"),
+    _s("serve.flush", "hydragnn_tpu/serve/batcher.py",
+       "one micro-batch flush; links the trace_ids it carried"),
+    _s("serve.pad", "hydragnn_tpu/serve/engine.py",
+       "bucket collation/padding inside a flush"),
+    _s("serve.predict", "hydragnn_tpu/serve/engine.py",
+       "device execution inside a flush (blocked-on-ready)"),
+    # train-step phases (trace mode only)
+    _s("train.data_wait", "hydragnn_tpu/train/trainer.py",
+       "blocking loader next() before a train dispatch"),
+    _s("train.h2d", "hydragnn_tpu/train/trainer.py",
+       "jit arg ingest: synchronous host->device batch transfer"),
+    _s("train.step", "hydragnn_tpu/train/trainer.py",
+       "on-device step execution (compute + collectives; split via the "
+       "comms probe)"),
+    # collective regions (HLO metadata names under comm_probe=True)
+    _s("comm.dp_psum", "hydragnn_tpu/parallel/mesh.py",
+       "gradient/metric psum-pmean over the DP axes"),
+    _s("comm.zero_all_gather", "hydragnn_tpu/parallel/mesh.py",
+       "ZeRO stage-2 param all_gather before the forward"),
+    _s("comm.halo_exchange", "hydragnn_tpu/parallel/mesh.py",
+       "halo-row exchange assembling the extended graph shard"),
+]
+
+SPAN_NAMES: Dict[str, SpanName] = {s.name: s for s in _SPAN_LIST}
 
 
 KNOB_DOC_HEADER = """\

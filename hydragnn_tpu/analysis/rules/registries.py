@@ -17,7 +17,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..astutil import const_str
 from ..core import Finding, Rule, Severity, register
-from ..registry import HEALTH_KINDS, KNOBS, emit_knob_docs
+from ..registry import HEALTH_KINDS, KNOBS, SPAN_NAMES, emit_knob_docs
 
 _KNOB_RE = re.compile(r"HYDRAGNN_[A-Z0-9_]+")
 
@@ -216,6 +216,58 @@ class HealthKindDrift(Rule):
                     reg_ctx, reg_line(kind),
                     f"declared health kind `{kind}` is not documented "
                     f"in docs/TELEMETRY.md"))
+        return out
+
+
+# trace-API entry points whose first positional arg is a span name.
+# ``span`` is deliberately held to a literal-only check (re.Match.span(1)
+# and other unrelated ``.span()`` spellings must not trip the rule);
+# ``record_interval``/``comm_region`` are unambiguous and also fail on
+# dynamic names the registry cannot see.
+_SPAN_CALL_NAMES = ("span", "record_interval", "comm_region")
+_SPAN_STRICT_NAMES = ("record_interval", "comm_region")
+
+
+def _iter_span_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name in _SPAN_CALL_NAMES and node.args:
+            yield name, node
+
+
+@register
+class UndeclaredSpanName(Rule):
+    id = "REG006"
+    name = "undeclared-span-name"
+    severity = Severity.ERROR
+    doc = ("every span-name literal passed to the trace API (span/"
+           "record_interval/comm_region) must be declared in the "
+           "span-name registry (analysis/registry.py)")
+
+    def check_file(self, ctx) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fname, call in _iter_span_calls(ctx.tree):
+            s = const_str(call.args[0])
+            if s is None:
+                if fname in _SPAN_STRICT_NAMES:
+                    out.append(self.finding(
+                        ctx, call,
+                        f"{fname}() called with a non-literal span name "
+                        f"— the registry rule cannot see it; pass a "
+                        f"literal declared in SPAN_NAMES or suppress "
+                        f"with a reason"))
+                continue
+            if s not in SPAN_NAMES:
+                out.append(self.finding(
+                    ctx, call,
+                    f"span name `{s}` is not declared in the span-name "
+                    f"registry (hydragnn_tpu/analysis/registry.py) — "
+                    f"declare it (name/module/desc) and document it in "
+                    f"docs/TELEMETRY.md"))
         return out
 
 

@@ -20,7 +20,7 @@ from .project import Project, parse_file
 
 # rules whose fixtures are ordinary per-file checks
 PER_FILE_RULES = ("TRC001", "TRC002", "TRC003", "TRC004", "LCK001",
-                  "REG001", "REG003", "ROB001", "ROB002")
+                  "REG001", "REG003", "REG006", "ROB001", "ROB002")
 # project-scope rules exercised by special-case harnesses below
 PROJECT_RULES = ("REG002", "REG004", "REG005")
 

@@ -56,6 +56,8 @@ def _telemetry_defaults() -> Dict[str, Any]:
         "ring": d.ring,
         "sync_steps": int(d.sync_steps),
         "mfu": int(d.mfu),
+        "trace": int(d.trace),
+        "trace_ring": d.trace_ring,
     }
 
 

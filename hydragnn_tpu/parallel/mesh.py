@@ -19,6 +19,7 @@ Multi-host bootstrap: :func:`setup_distributed` wraps
 
 from __future__ import annotations
 
+import contextlib
 import os
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -339,6 +340,23 @@ def _zero_state_specs(zero_sh, zero_specs, zero_stage2: bool) -> TrainState:
         opt_state=opt_spec_tree)
 
 
+def comm_region(name: str, probe: bool = False):
+    """Collective-attribution region (docs/TELEMETRY.md "Tracing").
+
+    Default OFF returns a plain ``nullcontext`` — the traced program is
+    byte-identical to the pre-tracing one (asserted like the PR-15 dtype
+    default-off purity).  With ``probe=True`` the region becomes a
+    ``jax.named_scope``, so every op it encloses carries the ``comm.*``
+    name in lowered HLO metadata and device profiles — the handle the
+    comms A/B probe (telemetry/comms.py) and xprof use to attribute
+    collective time.  Declared names: ``comm.dp_psum``,
+    ``comm.zero_all_gather``, ``comm.halo_exchange``
+    (analysis/registry.py SPAN_NAMES, lint REG006)."""
+    if not probe:
+        return contextlib.nullcontext()
+    return jax.named_scope(name)
+
+
 def make_dp_train_step(
     model: Base,
     cfg: ModelConfig,
@@ -352,6 +370,7 @@ def make_dp_train_step(
     telemetry_metrics: bool = False,
     nonfinite_guard: bool = False,
     dtype_policy: str = "f32",
+    comm_probe: bool = False,
 ):
     """jit'd DP train step over stacked batches [D, ...].
 
@@ -389,6 +408,11 @@ def make_dp_train_step(
     with f32 master params and optimizer state (trainer._loss_and_metrics);
     the gradient pmean and the update stay f32.  Default "f32" traces the
     exact pre-policy program.
+
+    ``comm_probe`` wraps the collective sites (ZeRO all_gather, gradient
+    pmean + metric psums) in named ``comm.*`` regions for comm-vs-compute
+    attribution (telemetry/comms.py).  Default OFF traces the exact
+    pre-probe program.
     """
     energy_head, forces_head = _force_head_indices(output_names)
     axes = _dp_axes(axis)
@@ -411,8 +435,9 @@ def make_dp_train_step(
             # at-rest copy stays 1/N)
             from hydragnn_tpu.parallel import zero
 
-            params_full = zero.unshard_tree_dims(
-                state.params, zero_sh.param_dims, zero_axis)
+            with comm_region("comm.zero_all_gather", comm_probe):
+                params_full = zero.unshard_tree_dims(
+                    state.params, zero_sh.param_dims, zero_axis)
         else:
             params_full = state.params
 
@@ -424,16 +449,18 @@ def make_dp_train_step(
 
         (loss, (per_head, new_stats, _)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params_full)
-        # gradient pmean across devices = DDP all-reduce parity (over a
-        # multi-slice mesh XLA reduces hierarchically: ICI first, then DCN)
-        grads = jax.lax.pmean(grads, axes)
-        new_stats = jax.lax.pmean(new_stats, axes)
-        ng_local = g.n_real_graphs
-        num_graphs = jax.lax.psum(ng_local, axes)
-        denom = jnp.maximum(num_graphs, 1.0)
-        loss = jax.lax.psum(loss * ng_local, axes) / denom
-        per_head = [jax.lax.psum(p * ng_local, axes) / denom
-                    for p in per_head]
+        with comm_region("comm.dp_psum", comm_probe):
+            # gradient pmean across devices = DDP all-reduce parity (over
+            # a multi-slice mesh XLA reduces hierarchically: ICI first,
+            # then DCN)
+            grads = jax.lax.pmean(grads, axes)
+            new_stats = jax.lax.pmean(new_stats, axes)
+            ng_local = g.n_real_graphs
+            num_graphs = jax.lax.psum(ng_local, axes)
+            denom = jnp.maximum(num_graphs, 1.0)
+            loss = jax.lax.psum(loss * ng_local, axes) / denom
+            per_head = [jax.lax.psum(p * ng_local, axes) / denom
+                        for p in per_head]
 
         new_params, new_opt_state, updates = _apply_sharded_update(
             state, grads, params_full, opt_spec, cfg, zero_specs,
@@ -578,6 +605,7 @@ def make_halo_train_step(
     zero_axis: Optional[str] = None,
     telemetry_metrics: bool = False,
     nonfinite_guard: bool = False,
+    comm_probe: bool = False,
 ):
     """jit'd train step over a halo-sharded GIANT graph: the input is a
     stacked :class:`~hydragnn_tpu.graph.partition.HaloBatch` [D, ...] —
@@ -631,14 +659,16 @@ def make_halo_train_step(
         if zero_stage2:
             from hydragnn_tpu.parallel import zero
 
-            params_full = zero.unshard_tree_dims(
-                state.params, zero_sh.param_dims, zero_axis)
+            with comm_region("comm.zero_all_gather", comm_probe):
+                params_full = zero.unshard_tree_dims(
+                    state.params, zero_sh.param_dims, zero_axis)
         else:
             params_full = state.params
 
         def loss_fn(params):
             with halo_context(axes[0]):
-                g_ext = assemble_extended(hb, axes[0])
+                with comm_region("comm.halo_exchange", comm_probe):
+                    g_ext = assemble_extended(hb, axes[0])
                 return _loss_and_metrics(
                     model, cfg, params, state.batch_stats, g_ext, True,
                     energy_head, forces_head, dropout_rng)
@@ -659,8 +689,9 @@ def make_halo_train_step(
         # convention; the parity tests pin this leaf-for-leaf.
         cal = jax.grad(lambda s: jax.lax.psum(s, axes[0]))(
             jnp.asarray(1.0, jnp.float32))
-        grads = jax.lax.psum(
-            jax.tree.map(lambda g: g / cal, grads), axes)
+        with comm_region("comm.dp_psum", comm_probe):
+            grads = jax.lax.psum(
+                jax.tree.map(lambda g: g / cal, grads), axes)
         num_graphs = hb.n_real_graphs  # graph arrays replicated per shard
         new_params, new_opt_state, updates = _apply_sharded_update(
             state, grads, params_full, opt_spec, cfg, zero_specs,

@@ -130,14 +130,15 @@ class _WatchdogWorker:
 
 
 class _Request:
-    __slots__ = ("sample", "future", "t_enq", "deadline")
+    __slots__ = ("sample", "future", "t_enq", "deadline", "trace")
 
     def __init__(self, sample: GraphSample,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, trace=None):
         self.sample = sample
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter time, or None
+        self.trace = trace  # telemetry.trace.SpanContext, or None
 
 
 class MicroBatcher:
@@ -227,7 +228,7 @@ class MicroBatcher:
         return max(1.0, est if est is not None else 1.0)
 
     def submit(self, sample: GraphSample,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None, trace=None) -> Future:
         """Enqueue one request; the returned future resolves to the
         engine's per-sample result dict ``{head_name: array}``.
 
@@ -236,6 +237,10 @@ class MicroBatcher:
         default of 0 means no deadline.  A request whose deadline the
         current backlog provably exceeds is shed HERE — before it ever
         occupies a queue slot (``RequestShedError`` -> 429).
+
+        ``trace`` carries the request's :class:`~hydragnn_tpu.telemetry
+        .trace.SpanContext` so the flush that serves it can link its
+        trace and attribute its queue wait (default None: untraced).
         """
         if self._closed.is_set():
             raise BatcherClosedError("batcher is shut down")
@@ -273,7 +278,7 @@ class MicroBatcher:
                     f"queue drain estimate {est * 1e3:.0f} ms exceeds the "
                     f"request deadline {float(deadline_s) * 1e3:.0f} ms",
                     retry_after_s=max(1.0, est))
-        req = _Request(sample, deadline=deadline)
+        req = _Request(sample, deadline=deadline, trace=trace)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -586,6 +591,39 @@ class MicroBatcher:
         if reason == "deadline":
             self.telemetry.health("deadline_flush", n=len(group),
                                   wait_ms=round(wait_ms, 3))
+        tr = getattr(self.telemetry, "spans", None)
+        if tr is not None:
+            # flight recorder (docs/TELEMETRY.md "Tracing"): one flush
+            # span linking the N request traces it served, with
+            # bucket-pad / compiled-predict children reconstructed from
+            # the engine's phase clock and one queue-wait child per
+            # traced request (parented to the flush, on the REQUEST's
+            # trace so the client id resolves the whole story).  Gated on
+            # the recorder existing — the default-off flush path above is
+            # untouched.
+            t1 = time.perf_counter()
+            flush_sp = tr.record_interval(
+                "serve.flush", t0, t1,
+                links=[r.trace.trace_id for r in group
+                       if r.trace is not None],
+                n=len(group), reason=reason, bucket=bucket_key,
+                fill_pct=round(fill_pct, 2))
+            phases = getattr(self.engine, "last_phase_t", None)
+            if phases is not None:
+                pad0, pad1, exe0, exe1 = phases
+                tr.record_interval("serve.pad", pad0, pad1,
+                                   trace_id=flush_sp["trace_id"],
+                                   parent_id=flush_sp["span_id"],
+                                   bucket=bucket_key)
+                tr.record_interval("serve.predict", exe0, exe1,
+                                   trace_id=flush_sp["trace_id"],
+                                   parent_id=flush_sp["span_id"],
+                                   bucket=bucket_key, n=len(group))
+            for r in group:
+                if r.trace is not None:
+                    tr.record_interval("serve.queue_wait", r.t_enq, t0,
+                                       trace_id=r.trace.trace_id,
+                                       parent_id=flush_sp["span_id"])
 
     def _fail(self, item) -> None:
         if isinstance(item, _Request) and not item.future.done():

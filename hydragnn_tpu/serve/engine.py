@@ -736,13 +736,34 @@ class InferenceEngine:
         node heads ``[total_real_nodes, dim]``.  Row order matches
         ``run_prediction``'s masked concatenation exactly (the parity
         contract)."""
+        tracing = getattr(self.telemetry, "spans", None) is not None
         spec = self.select_bucket(samples)
-        batch = self._collate(samples, spec)
-        exe = self._executable(spec, batch=batch)
-        # snapshot: a hot reload swapping self.state mid-call must not
-        # hand this flush two different param trees
-        state = self.state
-        m = exe(state, batch)
+        if tracing:
+            # phase clock for the flight recorder: collate (bucket-pad)
+            # vs compiled-predict boundaries, read back by the batcher as
+            # serve.pad / serve.predict child spans.  The block inside
+            # the exe window moves the device sync that np.asarray below
+            # would pay anyway, so the phase covers real compute.
+            # Default-off keeps this path free of even perf_counter calls.
+            import jax
+
+            t_pad0 = time.perf_counter()
+            batch = self._collate(samples, spec)
+            t_pad1 = time.perf_counter()
+            exe = self._executable(spec, batch=batch)
+            state = self.state
+            t_exe0 = time.perf_counter()
+            m = exe(state, batch)
+            jax.block_until_ready(m["outputs"])
+            self.last_phase_t = (t_pad0, t_pad1, t_exe0,
+                                 time.perf_counter())
+        else:
+            batch = self._collate(samples, spec)
+            exe = self._executable(spec, batch=batch)
+            # snapshot: a hot reload swapping self.state mid-call must
+            # not hand this flush two different param trees
+            state = self.state
+            m = exe(state, batch)
         outputs = m["outputs"]
         n_graphs = len(samples)
         n_nodes = sum(s.num_nodes for s in samples)

@@ -115,6 +115,10 @@ class PredictRequest:
     body: Optional[bytes] = None  # raw JSON body (subprocess replicas)
     num_nodes: int = 0
     tenant: str = DEFAULT_TENANT
+    # trace identity (telemetry.trace.SpanContext): set once at the
+    # router edge and carried across failover retries, so the SAME
+    # trace_id reaches whichever replica finally answers
+    trace: Any = None
 
 
 def free_port() -> int:
@@ -406,7 +410,7 @@ class InProcessReplica:
         """One attempt on THIS replica; shed/breaker/timeout/dead errors
         propagate for the router to map or fail over."""
         fut = self._tenant_batcher(req.tenant).submit(
-            req.sample, deadline_s=deadline_s)
+            req.sample, deadline_s=deadline_s, trace=req.trace)
         if deadline_s is None:
             wait = 30.0
         else:
@@ -620,6 +624,11 @@ class SubprocessReplica:
         budget rides the ``X-Timeout-Ms`` header, which wins over any
         (stale) ``timeout_ms`` field in the forwarded body."""
         headers = {"Content-Type": "application/json"}
+        if req.trace is not None:
+            # the trace identity crosses the process boundary as the
+            # X-Request-Id header — the child adopts it, so its JSONL
+            # spans carry the router's trace_id (one id, whole story)
+            headers["X-Request-Id"] = req.trace.trace_id
         wait = 30.0
         if deadline_s is not None:
             headers["X-Timeout-Ms"] = str(max(0.0, deadline_s * 1e3))

@@ -64,6 +64,7 @@ from hydragnn_tpu.serve.server import (
     reload_request_denied,
     sample_from_json,
 )
+from hydragnn_tpu.telemetry.trace import extract_trace_context
 
 
 class FleetSaturatedError(RequestShedError):
@@ -378,17 +379,37 @@ class FleetRouter:
                     self._reply(404, {"error": f"unknown path {self.path}"})
                     return
                 t0 = time.perf_counter()
+                # mint/adopt the trace identity at the FLEET EDGE — the
+                # SAME SpanContext rides the PredictRequest across every
+                # failover retry, so one trace_id tells the whole story
+                # even when the answer came from the third replica tried
+                ctx = extract_trace_context(self.headers)
+                code, payload, hdrs = self._predict_answer(t0, ctx)
+                payload["trace_id"] = ctx.trace_id
+                hdrs = dict(hdrs or {})
+                hdrs["X-Request-Id"] = ctx.trace_id
+                tr = getattr(router.telemetry, "spans", None)
+                if tr is not None:
+                    tr.record_interval(
+                        "serve.request", t0, time.perf_counter(),
+                        trace_id=ctx.trace_id, parent_id=ctx.parent_id,
+                        status=code)
+                self._reply(code, payload, headers=hdrs)
+
+            def _predict_answer(self, t0, ctx):
+                """The /predict dispatch as (code, payload, headers) —
+                one exit point so EVERY answer (200 and every
+                shed/saturated/timeout error) carries the trace id."""
                 try:
                     obj = self._read_json()
                     deadline_s = extract_deadline_s(self.headers, obj)
                     req = router.build_request(obj)
+                    req.trace = ctx
                 except _BodyTooLarge as e:
-                    self._reply(413, {"error": str(e)})
-                    return
+                    return 413, {"error": str(e)}, None
                 except (ValueError, TypeError, IndexError, KeyError,
                         json.JSONDecodeError) as e:
-                    self._reply(400, {"error": str(e)})
-                    return
+                    return 400, {"error": str(e)}, None
                 if deadline_s is None \
                         and router.serving.request_deadline_ms > 0:
                     # apply the server default AT THE ROUTER: failover
@@ -397,46 +418,38 @@ class FleetRouter:
                 try:
                     out = router.route_predict(req, deadline_s)
                 except UnknownTenantError as e:
-                    self._reply(404, {"error": str(e)})
-                    return
+                    return 404, {"error": str(e)}, None
                 except FleetEmptyError as e:
-                    self._reply(503, {"error": str(e), "fleet": "empty"},
-                                headers=self._retry_after(e.retry_after_s))
-                    return
+                    return 503, {"error": str(e), "fleet": "empty"}, \
+                        self._retry_after(e.retry_after_s)
                 except FleetSaturatedError as e:
-                    self._reply(429, {"error": str(e)},
-                                headers=self._retry_after(e.retry_after_s))
-                    return
+                    return 429, {"error": str(e)}, \
+                        self._retry_after(e.retry_after_s)
                 except RequestShedError as e:
-                    self._reply(429, {"error": str(e)},
-                                headers=self._retry_after(e.retry_after_s))
-                    return
+                    return 429, {"error": str(e)}, \
+                        self._retry_after(e.retry_after_s)
                 except BreakerOpenError as e:
-                    self._reply(503, {"error": str(e), "breaker": "open"},
-                                headers=self._retry_after(e.retry_after_s))
-                    return
+                    return 503, {"error": str(e), "breaker": "open"}, \
+                        self._retry_after(e.retry_after_s)
                 except PredictTimeoutError as e:
-                    self._reply(504, {"error": str(e)})
-                    return
+                    return 504, {"error": str(e)}, None
                 except Exception as e:  # noqa: BLE001
                     from hydragnn_tpu.serve.engine import \
                         BucketOverflowError
 
                     if isinstance(e, BucketOverflowError):
-                        self._reply(413, {"error": str(e)})
-                    elif isinstance(e, (ValueError, FileNotFoundError)):
-                        self._reply(400, {"error": str(e)})
-                    elif isinstance(e, TimeoutError):
-                        self._reply(504, {"error": "request timed out"})
-                    else:
-                        self._reply(500, {"error": repr(e)})
-                    return
-                self._reply(200, {
+                        return 413, {"error": str(e)}, None
+                    if isinstance(e, (ValueError, FileNotFoundError)):
+                        return 400, {"error": str(e)}, None
+                    if isinstance(e, TimeoutError):
+                        return 504, {"error": "request timed out"}, None
+                    return 500, {"error": repr(e)}, None
+                return 200, {
                     **out,
                     "num_nodes": int(req.num_nodes),
                     "latency_ms": round(
                         (time.perf_counter() - t0) * 1e3, 3),
-                })
+                }, None
 
             def _do_reload(self) -> None:
                 try:
@@ -610,4 +623,10 @@ class FleetRouter:
                 "hot": sorted(getattr(self.fleet, "hot_tenants", ())),
             },
             "health_events": self.telemetry.health_counts,
+            # span-latency breakdown at the fleet edge (request-level
+            # percentiles when the flight recorder is on; {} otherwise —
+            # same always-present contract as the single server)
+            "spans": (self.telemetry.spans.percentiles()
+                      if getattr(self.telemetry, "spans", None)
+                      is not None else {}),
         }

@@ -86,6 +86,7 @@ from hydragnn_tpu.serve.engine import (
     InferenceEngine,
     ReloadValidationError,
 )
+from hydragnn_tpu.telemetry.trace import extract_trace_context
 
 
 # hard ceiling on request bodies, checked BEFORE reading the stream: a
@@ -361,8 +362,39 @@ class InferenceServer:
                     self._reply(404, {"error": f"unknown path {self.path}"})
                     return
                 t0 = time.perf_counter()
+                # trace identity is adopted/minted from the HEADERS before
+                # the body is even read, so a 400/413 answer still quotes
+                # the id the client sent (docs/TELEMETRY.md "Tracing")
+                ctx = extract_trace_context(self.headers)
+                code, payload, hdrs = self._predict_answer(t0, ctx)
+                payload["trace_id"] = ctx.trace_id
+                hdrs = dict(hdrs or {})
+                hdrs["X-Request-Id"] = ctx.trace_id
+                tr = getattr(server.engine.telemetry, "spans", None)
+                if tr is not None:
+                    # the request span covers the request's whole server
+                    # residency: parse + queue wait + flush + reply
+                    # formation; its trace links to the flush span that
+                    # served it via the flush's ``links`` list
+                    tr.record_interval(
+                        "serve.request", t0, time.perf_counter(),
+                        trace_id=ctx.trace_id, parent_id=ctx.parent_id,
+                        status=code)
+                self._reply(code, payload, headers=hdrs)
+
+            def _predict_answer(self, t0, ctx):
+                """The /predict state machine as (code, payload, headers)
+                — one exit point so the trace id and request span reach
+                EVERY answer, shed/timeout/breaker errors included."""
                 try:
                     obj = self._read_json()
+                    if ctx.minted and isinstance(obj, dict) \
+                            and obj.get("trace_id"):
+                        # body-field spelling (no header): adopt in place
+                        body_ctx = extract_trace_context(
+                            self.headers, obj)
+                        ctx.trace_id = body_ctx.trace_id
+                        ctx.minted = body_ctx.minted
                     model = obj.get("model") if isinstance(obj, dict) \
                         else None
                     if model is not None and model != DEFAULT_TENANT:
@@ -370,11 +402,10 @@ class InferenceServer:
                         # replica): tenancy lives in the in-process
                         # fleet; an unknown model is a 404, not a 400 —
                         # the router maps it to UnknownTenantError
-                        self._reply(404, {
+                        return 404, {
                             "error": f"unknown model {model!r}: this "
                                      "server hosts a single model "
-                                     f"({DEFAULT_TENANT!r})"})
-                        return
+                                     f"({DEFAULT_TENANT!r})"}, None
                     deadline_s = extract_deadline_s(self.headers, obj)
                     sample = sample_from_json(
                         obj, server.engine.cfg,
@@ -383,57 +414,48 @@ class InferenceServer:
                         build_max_neighbours=(
                             server.serving.edge_build_max_neighbours))
                 except _BodyTooLarge as e:
-                    self._reply(413, {
+                    return 413, {
                         "error": f"request body {e.n} bytes exceeds the "
-                                 f"{MAX_REQUEST_BYTES}-byte limit"})
-                    return
+                                 f"{MAX_REQUEST_BYTES}-byte limit"}, None
                 except (ValueError, TypeError, IndexError, KeyError,
                         json.JSONDecodeError) as e:
                     # malformed payloads must answer 400, never escape
                     # into the stdlib handler (dropped connection)
-                    self._reply(400, {"error": str(e)})
-                    return
+                    return 400, {"error": str(e)}, None
                 try:
                     fut = server.batcher.submit(sample,
-                                                deadline_s=deadline_s)
+                                                deadline_s=deadline_s,
+                                                trace=ctx)
                     res = fut.result(timeout=server._wait_s(deadline_s))
                 except BucketOverflowError as e:
-                    self._reply(413, {"error": str(e)})
-                    return
+                    return 413, {"error": str(e)}, None
                 except BreakerOpenError as e:
                     # breaker open: fail fast, tell the client when the
                     # half-open probe will be admitted
-                    self._reply(503, {"error": str(e), "breaker": "open"},
-                                headers=self._retry_after(e.retry_after_s))
-                    return
+                    return 503, {"error": str(e), "breaker": "open"}, \
+                        self._retry_after(e.retry_after_s)
                 except RequestShedError as e:
                     # shed (admission control or expired-in-queue):
                     # 429 + Retry-After from the measured drain rate
-                    self._reply(429, {"error": str(e)},
-                                headers=self._retry_after(e.retry_after_s))
-                    return
+                    return 429, {"error": str(e)}, \
+                        self._retry_after(e.retry_after_s)
                 except QueueFullError as e:
-                    self._reply(503, {"error": str(e)},
-                                headers=self._retry_after(
-                                    server.batcher.retry_after_s()))
-                    return
+                    return 503, {"error": str(e)}, self._retry_after(
+                        server.batcher.retry_after_s())
                 except BatcherClosedError as e:
-                    self._reply(503, {"error": str(e)})
-                    return
+                    return 503, {"error": str(e)}, None
                 except PredictTimeoutError as e:
-                    self._reply(504, {"error": str(e)})
-                    return
+                    return 504, {"error": str(e)}, None
                 except (_FutureTimeout, TimeoutError):
-                    self._reply(504, {"error": "request timed out"})
-                    return
+                    return 504, {"error": "request timed out"}, None
                 except Exception as e:  # noqa: BLE001 — engine failure
-                    self._reply(500, {"error": repr(e)})
-                    return
-                self._reply(200, {
+                    return 500, {"error": repr(e)}, None
+                return 200, {
                     "heads": _result_to_json(res),
                     "num_nodes": int(sample.num_nodes),
-                    "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
-                })
+                    "latency_ms": round((time.perf_counter() - t0) * 1e3,
+                                        3),
+                }, None
 
             def _do_rollback(self) -> None:
                 """Restore the retained pre-reload state (the manual
@@ -646,4 +668,10 @@ class InferenceServer:
                 "quant_policy": cache["quant"]["active"],
             },
             "health_events": self.engine.telemetry.health_counts,
+            # span-latency breakdown (queue-wait vs pad vs predict
+            # percentiles) when the flight recorder is on — {} otherwise,
+            # so scrapers can treat the key as always-present
+            "spans": (self.engine.telemetry.spans.percentiles()
+                      if getattr(self.engine.telemetry, "spans", None)
+                      is not None else {}),
         }

@@ -35,3 +35,9 @@ from hydragnn_tpu.telemetry.sinks import (  # noqa: F401
     TensorBoardSink,
     build_sinks,
 )
+from hydragnn_tpu.telemetry.trace import (  # noqa: F401
+    SpanContext,
+    SpanRecorder,
+    chrome_trace,
+    extract_trace_context,
+)

@@ -56,6 +56,8 @@ class TelemetryConfig:
     (comma list: jsonl,csv,stdout), HYDRAGNN_TELEMETRY_DIR,
     HYDRAGNN_TELEMETRY_HEARTBEAT (stdout cadence, steps),
     HYDRAGNN_TELEMETRY_SYNC (block per step for true step times),
+    HYDRAGNN_TRACE (span flight recorder, docs/TELEMETRY.md "Tracing"),
+    HYDRAGNN_TRACE_RING (span ring/reservoir capacity),
     HYDRAGNN_PEAK_FLOPS (MFU peak basis override, see telemetry/flops.py).
     """
 
@@ -66,6 +68,8 @@ class TelemetryConfig:
     ring: int = 256
     sync_steps: bool = False
     mfu: bool = True
+    trace: bool = False
+    trace_ring: int = 512
 
     @staticmethod
     def from_section(section: Optional[Dict[str, Any]]) -> "TelemetryConfig":
@@ -82,6 +86,8 @@ class TelemetryConfig:
             ring=int(s.get("ring", d.ring)),
             sync_steps=bool(int(s.get("sync_steps", d.sync_steps))),
             mfu=bool(int(s.get("mfu", d.mfu))),
+            trace=bool(int(s.get("trace", d.trace))),
+            trace_ring=int(s.get("trace_ring", d.trace_ring)),
         )
         # env overrides (the smoke-run contract: HYDRAGNN_TELEMETRY=1 turns
         # the subsystem on with no config edit)
@@ -96,6 +102,10 @@ class TelemetryConfig:
             cfg.heartbeat = env_int("HYDRAGNN_TELEMETRY_HEARTBEAT", 50)
         if "HYDRAGNN_TELEMETRY_SYNC" in os.environ:
             cfg.sync_steps = env_flag("HYDRAGNN_TELEMETRY_SYNC")
+        if "HYDRAGNN_TRACE" in os.environ:
+            cfg.trace = env_flag("HYDRAGNN_TRACE")
+        if "HYDRAGNN_TRACE_RING" in os.environ:
+            cfg.trace_ring = env_int("HYDRAGNN_TRACE_RING", 512)
         return cfg
 
 
@@ -221,6 +231,18 @@ class MetricsLogger:
         # parameter/opt-state sharding layout (log_sharding) — folded into
         # the end-of-run manifest
         self._sharding: Optional[Dict[str, Any]] = None
+        # comm-vs-compute split (log_comms, the A/B probe verdict) —
+        # folded into the manifest's ``comms`` block
+        self._comms: Optional[Dict[str, Any]] = None
+        # span flight recorder (telemetry/trace.py) — None when tracing is
+        # off, so every call site's default-off path is a plain None check
+        # (no recorder object, no span allocation: hot-path purity)
+        self.spans = None
+        if self.enabled and self.cfg.trace:
+            from hydragnn_tpu.telemetry.trace import SpanRecorder
+
+            self.spans = SpanRecorder(ring=self.cfg.trace_ring,
+                                      emit=self._emit_span)
         if self.enabled and self.rank == 0:
             self.sinks = build_sinks(
                 self.cfg.sinks, self.out_dir, self.run_id,
@@ -409,6 +431,21 @@ class MetricsLogger:
                 "rank": self.rank,
                 "t": time.time(),
                 **self._sharding,
+            })
+
+    def log_comms(self, split: Dict[str, Any]) -> None:
+        """Record the comm-vs-compute split the opt-in A/B probe measured
+        (telemetry/comms.py): per mesh path, full-step ms vs collective-only
+        ms and the derived comm %.  Stored always (manifest ``comms``
+        block), emitted as a ``comms`` event when the subsystem is on."""
+        self._comms = dict(split)
+        if self.enabled:
+            self._emit({
+                "event": "comms",
+                "run_id": self.run_id,
+                "rank": self.rank,
+                "t": time.time(),
+                **self._comms,
             })
 
     def resume_counts(self, global_step: int) -> None:
@@ -617,6 +654,10 @@ class MetricsLogger:
                 rec["health"] = dict(self._health_counts)
             if self._sharding is not None:
                 rec["sharding"] = dict(self._sharding)
+            if self._comms is not None:
+                rec["comms"] = dict(self._comms)
+            if self.spans is not None:
+                rec["spans"] = self.spans.summary()
             # fused-vs-fallback dispatch tally (this run's delta over the
             # process-cumulative trace-time counts): a run that silently
             # fell off the fast path shows ``<op>:scatter`` entries here
@@ -644,6 +685,16 @@ class MetricsLogger:
     def _emit(self, record: Dict[str, Any]) -> None:
         for s in self.sinks:
             s.emit(record)
+
+    def _emit_span(self, record: Dict[str, Any]) -> None:
+        """SpanRecorder's emit hook: stamp run identity and ride the
+        health lock — span records come from concurrent serve handler
+        threads and share the JSONL sink's text stream."""
+        record.setdefault("run_id", self.run_id)
+        record.setdefault("rank", self.rank)
+        record.setdefault("t", time.time())
+        with self._health_lock:
+            self._emit(record)
 
     @property
     def jsonl_path(self) -> str:

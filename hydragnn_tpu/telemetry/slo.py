@@ -1,0 +1,202 @@
+"""SLO burn-rate monitor: the alerting layer between "health events
+exist" and "someone notices" (docs/TELEMETRY.md "Tracing").
+
+The serve SLO has two budgets:
+
+  - **latency**: p99 of *accepted* requests stays under ``p99_ms``;
+  - **shed budget**: the fraction of answers that are sheds (429/503/504)
+    stays under ``shed_budget`` (error budget in the SRE sense).
+
+:class:`BurnRateMonitor` tails a stream of telemetry records — serve
+``step`` records and shed-family ``health`` events, either live (the
+server feeds :meth:`observe` in-process) or offline (``tail_jsonl`` over
+``events.jsonl``) — over a sliding window of ``window_s`` seconds, and
+raises a ``slo_burn`` health event when a budget burns faster than
+``burn`` times its allowance (burn-rate alerting: a 2x burn exhausts a
+period's budget in half the period — page before it's gone, not after).
+Firing is edge-triggered with hysteresis: one event per excursion, re-armed
+only after a compliant check, so a sustained burn does not flood the
+health stream it is trying to protect.
+
+The clock is injectable (``now=``) so tests replay a synthetic burn
+without sleeping; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from hydragnn_tpu.telemetry.trace import quantile
+
+__all__ = ["SloConfig", "BurnRateMonitor", "tail_jsonl"]
+
+# health kinds that consume shed budget (server/router error answers);
+# request_enqueued marks an accepted arrival so the ratio has a
+# denominator even when no serve step has flushed yet
+SHED_KINDS = (
+    "request_shed",
+    "deadline_expired",
+    "queue_full",
+    "predict_timeout",
+    "breaker_open",
+    "fleet_saturated",
+    "fleet_no_replicas",
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class SloConfig:
+    """Budgets + window; env knobs win over constructor values so an
+    operator can re-tune a running deployment's alerting without a config
+    push (same overlay convention as TelemetryConfig)."""
+
+    p99_ms: float = 0.0  # 0 = latency budget unset (ratio-only)
+    shed_budget: float = 0.05  # tolerated shed fraction of answers
+    window_s: float = 60.0
+    burn: float = 2.0  # fire when consumption >= burn x allowance
+
+    def __post_init__(self):
+        if "HYDRAGNN_SLO_P99_MS" in os.environ:
+            self.p99_ms = _env_float("HYDRAGNN_SLO_P99_MS", self.p99_ms)
+        if "HYDRAGNN_SLO_SHED_BUDGET" in os.environ:
+            self.shed_budget = _env_float(
+                "HYDRAGNN_SLO_SHED_BUDGET", self.shed_budget)
+        if "HYDRAGNN_SLO_WINDOW_S" in os.environ:
+            self.window_s = _env_float(
+                "HYDRAGNN_SLO_WINDOW_S", self.window_s)
+        if "HYDRAGNN_SLO_BURN" in os.environ:
+            self.burn = _env_float("HYDRAGNN_SLO_BURN", self.burn)
+
+
+@dataclass
+class _Window:
+    # (t, value) samples; pruned to the sliding window on every check
+    accepted_ms: List[Tuple[float, float]] = field(default_factory=list)
+    accepted: List[float] = field(default_factory=list)
+    shed: List[float] = field(default_factory=list)
+
+
+class BurnRateMonitor:
+    """Single-threaded monitor: callers serialize observe()/check()
+    themselves (the server calls both from its /metrics handler; the
+    offline tail is one loop)."""
+
+    def __init__(self, cfg: Optional[SloConfig] = None,
+                 telemetry=None):
+        self.cfg = cfg or SloConfig()
+        self._telemetry = telemetry  # anything with .health(kind, **fields)
+        self._win = _Window()
+        self._armed = True  # hysteresis: re-armed by a compliant check
+        self.fired = 0  # lifetime slo_burn count (tests + /metrics)
+        self._clock = 0.0  # last observed/checked time
+
+    # -- feeding -----------------------------------------------------------
+
+    def observe(self, record: Dict[str, Any],
+                now: Optional[float] = None) -> None:
+        """Consume one telemetry record (serve step / health event)."""
+        t = self._tick(now)
+        ev = record.get("event")
+        if ev == "step" and record.get("source") == "serve":
+            # one flushed micro-batch: num_graphs accepted answers at
+            # predict_ms + their queue wait (the client-visible latency
+            # proxy the p99 budget is written against)
+            n = max(1, int(record.get("num_graphs", 1)))
+            ms = float(record.get("predict_ms", 0.0)) + float(
+                record.get("wait_ms", 0.0))
+            self._win.accepted_ms.append((t, ms))
+            self._win.accepted.extend([t] * n)
+        elif ev == "span" and record.get("name") == "serve.request":
+            # per-request spans give the true per-request p99 when
+            # tracing is on (finer than the per-flush proxy)
+            self._win.accepted_ms.append(
+                (t, float(record.get("dur_ms", 0.0))))
+            self._win.accepted.append(t)
+        elif ev == "health" and record.get("kind") in SHED_KINDS:
+            self._win.shed.append(t)
+
+    # -- checking ----------------------------------------------------------
+
+    def check(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Prune the window, evaluate both budgets; returns the violation
+        dict (and emits ``slo_burn``) on a fresh excursion, else None."""
+        t = self._tick(now)
+        cut = t - self.cfg.window_s
+        w = self._win
+        w.accepted_ms = [(ts, v) for ts, v in w.accepted_ms if ts >= cut]
+        w.accepted = [ts for ts in w.accepted if ts >= cut]
+        w.shed = [ts for ts in w.shed if ts >= cut]
+
+        lat = sorted(v for _, v in w.accepted_ms)
+        p99 = quantile(lat, 0.99)
+        answers = len(w.accepted) + len(w.shed)
+        shed_ratio = (len(w.shed) / answers) if answers else 0.0
+
+        violation = None
+        if self.cfg.p99_ms > 0 and lat and p99 > self.cfg.p99_ms:
+            violation = {"budget": "latency_p99", "p99_ms": round(p99, 3),
+                         "target_ms": self.cfg.p99_ms}
+        shed_allow = self.cfg.shed_budget * self.cfg.burn
+        if answers and shed_ratio > shed_allow:
+            violation = {"budget": "shed_ratio",
+                         "shed_ratio": round(shed_ratio, 4),
+                         "allowed": round(shed_allow, 4),
+                         **({} if violation is None else
+                            {"also": violation["budget"]})}
+        if violation is None:
+            self._armed = True  # compliant window re-arms the edge trigger
+            return None
+        if not self._armed:
+            return None  # still inside the same excursion — stay quiet
+        self._armed = False
+        self.fired += 1
+        violation.update(window_s=self.cfg.window_s,
+                         accepted=len(w.accepted), shed=len(w.shed))
+        if self._telemetry is not None:
+            self._telemetry.health("slo_burn", **violation)
+        return violation
+
+    def _tick(self, now: Optional[float]) -> float:
+        if now is None:
+            import time
+
+            now = time.monotonic()
+        self._clock = max(self._clock, float(now))
+        return self._clock
+
+
+def tail_jsonl(path: str, cfg: Optional[SloConfig] = None,
+               telemetry=None
+               ) -> Tuple[BurnRateMonitor, List[Dict[str, Any]]]:
+    """Offline pass over an ``events.jsonl``: replay every record through
+    a monitor (record index as the clock when no wall time is stamped)
+    and return (monitor, violations) — the ``teleview --trace`` hook and
+    the post-hoc "did this bench burn its budget?" answer."""
+    mon = BurnRateMonitor(cfg, telemetry=telemetry)
+    violations = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            t = float(rec.get("t", i))
+            mon.observe(rec, now=t)
+            v = mon.check(now=t)
+            if v is not None:
+                violations.append(v)
+    return mon, violations

@@ -583,6 +583,43 @@ def load_state(state: TrainState, log_name: str, path: str = "./logs/") -> Train
 # ---------------------------------------------------------------------------
 
 
+def _traced_loader(loader, tr):
+    """Yield ``loader``'s batches, recording each blocking ``next()`` as a
+    ``train.data_wait`` span.  Only wrapped in when tracing is on — the
+    default epoch loop iterates the raw loader untouched."""
+    it = iter(loader)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            g = next(it)
+        except StopIteration:
+            return
+        tr.record_interval("train.data_wait", t0, time.perf_counter())
+        yield g
+
+
+def _traced_step(step_fn, tr):
+    """Trace-mode train-step wrapper: splits each dispatch into an
+    arg-ingest span (``train.h2d`` — the jit call's synchronous host->
+    device transfer of the batch) and an on-device span (``train.step`` —
+    compute + collectives; split the two with the ``comms`` probe's
+    comm_pct).  The completion block is ONE added device sync per step:
+    the flight recorder trades the zero-sync telemetry discipline for
+    phase attribution, which is why tracing is opt-in."""
+
+    def stepped(state, g):
+        t0 = time.perf_counter()
+        state, metrics = step_fn(state, g)
+        t1 = time.perf_counter()
+        jax.block_until_ready(metrics["loss"])
+        t2 = time.perf_counter()
+        tr.record_interval("train.h2d", t0, t1)
+        tr.record_interval("train.step", t1, t2)
+        return state, metrics
+
+    return stepped
+
+
 def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
                steps_per_item: int = 1, telemetry=None, guard=None,
                preempt=None, chaos=None, skip_first: int = 0,
@@ -602,6 +639,13 @@ def _run_epoch(step_fn, state, loader, train: bool, profiler=None,
     total = None
     tasks = None
     n = None
+    # flight recorder (opt-in, telemetry.trace): wrap the loader and step
+    # so phase spans are recorded WITHOUT touching the default loop body —
+    # tracing off leaves this function's hot path byte-identical
+    tr = getattr(telemetry, "spans", None) if train else None
+    if tr is not None:
+        loader = _traced_loader(loader, tr)
+        step_fn = _traced_step(step_fn, tr)
     # HYDRAGNN_MAX_NUM_BATCH caps TRAIN STEPS per epoch (reference
     # get_nbatch, train_validate_test.py:40-50 — used for weak-scaling
     # measurement).  With scan chunking each loader item carries
@@ -1115,6 +1159,21 @@ def train_validate_test(
                 # second stack: [K, D, ...] superbatches for the scanned step
                 train_loader = DeviceStackLoader(
                     train_loader, steps_per_dispatch, drop_last=True)
+            if env_flag("HYDRAGNN_COMMS_PROBE") and single_proc:
+                # opt-in comm-vs-compute attribution (docs/TELEMETRY.md
+                # "Tracing"): A/B-time the annotated step vs a
+                # collective-only replay on COPIES of the state, then fold
+                # the split into the manifest `comms` block.  Single
+                # process only — the replay is not a global collective
+                # every rank could join.
+                probe_b = next(iter(train_loader), None)
+                if probe_b is not None:
+                    from hydragnn_tpu.telemetry.comms import dp_comms_probe
+
+                    telemetry.log_comms(dp_comms_probe(
+                        model, cfg, opt_spec, mesh, state, probe_b,
+                        output_names, zero_specs=zero_sh, axis=dp_axes,
+                        steps=steps_per_dispatch))
         # per-device resident bytes under the chosen layout — the manifest
         # `sharding` block, so the ~1/N saving is a measured number; with
         # graph sharding active it also carries the partition stats

@@ -11,12 +11,19 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
+from hydragnn_tpu.utils.env import env_flag, env_int, env_str
+
 
 class Profiler:
     """Step-scheduled profiler: wait -> warmup -> active -> done.
 
     Config keys (reference profile.py:32-43): ``enable`` (int), ``wait``,
-    ``warmup``, ``active``, ``trace_dir``.
+    ``warmup``, ``active``, ``trace_dir``.  Env knobs override the config
+    (env wins, matching every other overlay in the tree), so a device
+    trace can be captured on a deployed config without editing it:
+    ``HYDRAGNN_PROFILE`` (enable), ``HYDRAGNN_PROFILE_WAIT``,
+    ``HYDRAGNN_PROFILE_WARMUP``, ``HYDRAGNN_PROFILE_ACTIVE`` (schedule
+    steps), ``HYDRAGNN_PROFILE_DIR`` (trace output directory).
     """
 
     def __init__(self, config: Optional[Dict[str, Any]] = None,
@@ -28,6 +35,16 @@ class Profiler:
         self.active = int(config.get("active", 3))
         self.trace_dir = config.get(
             "trace_dir", os.path.join(logs_dir, log_name, "trace"))
+        if "HYDRAGNN_PROFILE" in os.environ:
+            self.enabled = env_flag("HYDRAGNN_PROFILE")
+        if "HYDRAGNN_PROFILE_WAIT" in os.environ:
+            self.wait = env_int("HYDRAGNN_PROFILE_WAIT", self.wait)
+        if "HYDRAGNN_PROFILE_WARMUP" in os.environ:
+            self.warmup = env_int("HYDRAGNN_PROFILE_WARMUP", self.warmup)
+        if "HYDRAGNN_PROFILE_ACTIVE" in os.environ:
+            self.active = env_int("HYDRAGNN_PROFILE_ACTIVE", self.active)
+        if "HYDRAGNN_PROFILE_DIR" in os.environ:
+            self.trace_dir = env_str("HYDRAGNN_PROFILE_DIR", self.trace_dir)
         self._step = 0
         self._tracing = False
         self._done = False

@@ -1,0 +1,606 @@
+"""Flight recorder (docs/TELEMETRY.md "Tracing"): trace-identity
+adoption, the bounded lock-guarded span ring, end-to-end serve spans
+(request -> linked flush -> queue-wait/pad/predict children), trace ids
+on shed/timeout answers and across failover, the train-phase wrappers,
+the comm-vs-compute A/B probe, the SLO burn-rate monitor, and the
+PR-15-style default-off purity claims."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graph.batch import GraphSample, HeadSpec, PadSpec, collate
+from hydragnn_tpu.graph.neighborlist import radius_graph
+from hydragnn_tpu.models.base import GraphHeadCfg, ModelConfig
+from hydragnn_tpu.models.create import create_model
+from hydragnn_tpu.serve import (
+    InferenceEngine,
+    InferenceServer,
+    InferenceState,
+    ServingConfig,
+)
+from hydragnn_tpu.telemetry import MetricsLogger, TelemetryConfig
+from hydragnn_tpu.telemetry.slo import BurnRateMonitor, SloConfig, tail_jsonl
+from hydragnn_tpu.telemetry.trace import (
+    SpanRecorder,
+    chrome_trace,
+    extract_trace_context,
+    quantile,
+)
+
+
+def _sample(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    pos = rng.rand(n, 3).astype(np.float32) * 2.0
+    return GraphSample(x=rng.rand(n, 1).astype(np.float32), pos=pos,
+                       edge_index=radius_graph(pos, 1.2, 8))
+
+
+_HEADS = [HeadSpec("energy", "graph", 1)]
+
+
+@pytest.fixture(scope="module")
+def _engine_mod():
+    """ONE tiny SAGE engine for the whole module — each HTTP test
+    reassigns `engine.telemetry` before building its server (the
+    batcher inherits it at construction); the `engine` wrapper
+    restores it after."""
+    import jax
+
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=1, hidden_dim=8, output_dim=(1,),
+        output_type=("graph",), graph_head=GraphHeadCfg(1, 8, 1, (8,)),
+        node_head=None, task_weights=(1.0,), num_conv_layers=2)
+    model = create_model(cfg)
+    pads = [PadSpec.for_batch(2, 16, 64)]
+    example = collate([_sample()], pads[0], _HEADS)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        example, train=False)
+    state = InferenceState(step=0, params=variables["params"],
+                           batch_stats=variables.get("batch_stats", {}))
+    eng = InferenceEngine(cfg, state, _HEADS, pads,
+                          serving=ServingConfig(max_wait_ms=10),
+                          telemetry=None)
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def engine(_engine_mod):
+    prev = _engine_mod.telemetry
+    yield _engine_mod
+    _engine_mod.telemetry = prev
+
+
+def _traced_logger(tmp_path=None, sinks=()):
+    """Enabled MetricsLogger with the flight recorder armed; JSONL sink
+    only when a directory is given (ring-only otherwise)."""
+    return MetricsLogger(
+        TelemetryConfig(enable=True, trace=True, trace_ring=512,
+                        sinks=tuple(sinks)),
+        run_name="trace_test",
+        out_dir=str(tmp_path) if tmp_path is not None else None)
+
+
+def _post(port, obj, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _sample_json(s, **extra):
+    return {"x": s.x.tolist(), "pos": s.pos.tolist(),
+            "edge_index": s.edge_index.tolist(), **extra}
+
+
+# ---------------------------------------------------------------------------
+# Trace identity: adopt-or-mint precedence, malformed values ignored
+# ---------------------------------------------------------------------------
+
+
+def test_extract_trace_context_precedence_and_malformed():
+    tid, pid = "ab" * 16, "cd" * 8
+    # traceparent wins (and carries the parent span id)
+    ctx = extract_trace_context(
+        {"traceparent": f"00-{tid}-{pid}-01", "X-Request-Id": "other"})
+    assert (ctx.trace_id, ctx.parent_id, ctx.minted) == (tid, pid, False)
+    # X-Request-Id next: arbitrary token schemes are adopted verbatim
+    ctx = extract_trace_context({"X-Request-Id": "req_1:retry-2.a"})
+    assert ctx.trace_id == "req_1:retry-2.a" and not ctx.minted
+    # body-field spelling when no header is present
+    ctx = extract_trace_context({}, {"trace_id": "bench-0-7"})
+    assert ctx.trace_id == "bench-0-7" and not ctx.minted
+    # malformed traceparent falls through to X-Request-Id, silently
+    ctx = extract_trace_context(
+        {"traceparent": "00-zznothex-01", "X-Request-Id": "fallback"})
+    assert ctx.trace_id == "fallback" and not ctx.minted
+    # header-splitting / oversize / non-string ids are treated as absent
+    for bad in ("a b", "x\r\nSet-Cookie: no", "q" * 129, ""):
+        ctx = extract_trace_context({"X-Request-Id": bad})
+        assert ctx.minted and len(ctx.trace_id) == 32
+    ctx = extract_trace_context({}, {"trace_id": 123})
+    assert ctx.minted
+    # minted ids are W3C-width and unique
+    a, b = extract_trace_context({}), extract_trace_context({})
+    assert a.trace_id != b.trace_id
+    assert "-01" in a.traceparent() and a.trace_id in a.traceparent()
+
+
+def test_quantile_nearest_rank():
+    assert quantile([], 0.99) == 0.0
+    vals = sorted(float(v) for v in range(1, 101))
+    assert quantile(vals, 0.50) == 51.0
+    assert quantile(vals, 0.99) == 100.0
+    assert quantile([7.0], 0.99) == 7.0
+
+
+# ---------------------------------------------------------------------------
+# SpanRecorder: bounded ring, thread safety, percentiles, chrome export
+# ---------------------------------------------------------------------------
+
+
+def test_span_ring_bounded_overwrites_oldest():
+    rec = SpanRecorder(ring=8)
+    for i in range(50):
+        rec.record_interval("serve.predict", 0.0, 0.001, seq=i)
+    snap = rec.snapshot()
+    assert len(snap) == 8  # bounded, whatever the request count
+    assert [r["seq"] for r in snap] == list(range(42, 50))  # oldest-first
+    pct = rec.percentiles()["serve.predict"]
+    assert pct["count"] == 50  # lifetime count survives the overwrite
+    assert pct["p50_ms"] == pytest.approx(1.0, rel=0.01)
+    # the per-name reservoir is bounded too (no unbounded growth)
+    assert len(rec._durations["serve.predict"]) <= 8
+    assert rec.summary()["recorded"] == 50
+
+
+def test_span_ring_lock_guarded_under_concurrent_writers():
+    emitted = []
+    rec = SpanRecorder(ring=64, emit=emitted.append)
+    n_threads, per_thread = 8, 200
+
+    def writer(wid):
+        for i in range(per_thread):
+            with rec.span("serve.request", trace_id=f"t{wid}-{i}"):
+                pass
+            rec.record_interval("serve.queue_wait", 0.0, 0.0005)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    pct = rec.percentiles()
+    assert pct["serve.request"]["count"] == total
+    assert pct["serve.queue_wait"]["count"] == total
+    assert rec.summary()["recorded"] == 2 * total
+    assert len(rec.snapshot()) == 64
+    assert len(emitted) == 2 * total  # every span reached the JSONL hook
+
+
+def test_span_context_manager_and_chrome_export():
+    rec = SpanRecorder(ring=16)
+    with rec.span("serve.flush", trace_id="tr1", bucket=4):
+        time.sleep(0.002)
+    rec.record_interval("train.step", 1.0, 1.5, trace_id="run",
+                        parent_id="abcd")
+    doc = chrome_trace(rec.snapshot() + [{"event": "step"}])  # non-spans skipped
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    flush = next(e for e in evs if e["name"] == "serve.flush")
+    assert flush["ph"] == "X" and flush["pid"] == "serve"
+    assert flush["dur"] >= 2000  # microseconds
+    assert flush["args"]["bucket"] == 4 and flush["args"]["trace_id"] == "tr1"
+    step = next(e for e in evs if e["name"] == "train.step")
+    assert step["pid"] == "train" and step["dur"] == pytest.approx(5e5)
+    assert step["args"]["parent_id"] == "abcd"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end serve: request span + linked flush + phase children in JSONL
+# ---------------------------------------------------------------------------
+
+
+def test_server_traces_end_to_end(tmp_path, engine):
+    tel = _traced_logger(tmp_path, sinks=("jsonl",))
+    engine.telemetry = tel  # before the server: the batcher inherits it
+    srv = InferenceServer(engine,
+                          serving=ServingConfig(port=0, max_wait_ms=5))
+    srv.start()
+    rids = [f"e2e-{i}" for i in range(4)]
+    try:
+        for rid in rids:
+            code, out, hdrs = _post(
+                srv.port, _sample_json(_sample(5, seed=int(rid[-1]))),
+                headers={"X-Request-Id": rid})
+            assert code == 200
+            assert out["trace_id"] == rid  # body echo
+            assert hdrs.get("X-Request-Id") == rid  # header echo
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            m = json.loads(r.read())
+        # /metrics span-latency breakdown: queue-wait vs predict
+        assert m["spans"]["serve.request"]["count"] >= 4
+        assert m["spans"]["serve.queue_wait"]["count"] >= 4
+        assert m["spans"]["serve.predict"]["p99_ms"] >= 0.0
+    finally:
+        srv.shutdown()
+        tel.finalize()
+    recs = [json.loads(line)
+            for line in open(tel.jsonl_path) if line.strip()]
+    spans = [r for r in recs if r.get("event") == "span"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # one request span per stamped id, status attached
+    req_ids = {s["trace_id"] for s in by_name["serve.request"]}
+    assert set(rids) <= req_ids
+    assert all(s["status"] == 200 and s["dur_ms"] >= 0.0
+               for s in by_name["serve.request"])
+    # every traced request is linked from some flush span, and the flush
+    # has pad/predict children parented to its span_id on its trace
+    linked = {t for s in by_name["serve.flush"] for t in s.get("links", [])}
+    assert set(rids) <= linked
+    for flush in by_name["serve.flush"]:
+        kids = [s for s in spans
+                if s.get("parent_id") == flush["span_id"]]
+        assert {k["name"] for k in kids} >= {"serve.pad", "serve.predict"}
+    # queue-wait rides the REQUEST's trace (client id resolves the story)
+    qw_ids = {s["trace_id"] for s in by_name["serve.queue_wait"]}
+    assert set(rids) <= qw_ids
+    # the manifest carries the span summary block
+    manifest = next(r for r in recs if r.get("event") == "manifest")
+    assert manifest["spans"]["recorded"] >= len(spans)
+    assert "serve.request" in manifest["spans"]["by_name"]
+
+
+def test_shed_and_timeout_answers_carry_trace_id(engine):
+    tel = _traced_logger()
+    engine.telemetry = tel
+    srv = InferenceServer(engine,
+                          serving=ServingConfig(port=0, max_wait_ms=5))
+    srv.start()
+    try:
+        # warm the drain-rate estimate so admission control can shed
+        code, _, _ = _post(srv.port, _sample_json(_sample(5, seed=1)),
+                           headers={"X-Request-Id": "warm-1"})
+        assert code == 200
+        # an impossible deadline -> 429, and the answer must quote the id
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.port,
+                  _sample_json(_sample(5, seed=2), timeout_ms=0.001),
+                  headers={"X-Request-Id": "shed-me"})
+        assert ei.value.code == 429
+        body = json.loads(ei.value.read())
+        assert body["trace_id"] == "shed-me"
+        assert ei.value.headers.get("X-Request-Id") == "shed-me"
+        # malformed body: the id was adopted from the HEADERS before the
+        # body read, so even a 400 quotes it
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict", data=b"not json",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "bad-body"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["trace_id"] == "bad-body"
+        # error request spans land in the ring with their status
+        statuses = {}
+        for s in tel.spans.snapshot():
+            if s["name"] == "serve.request":
+                statuses[s["trace_id"]] = s["status"]
+        assert statuses.get("shed-me") == 429
+        assert statuses.get("bad-body") == 400
+    finally:
+        srv.shutdown()
+
+
+def test_predict_timeout_504_carries_trace_id(engine):
+    from hydragnn_tpu.resilience import ServeChaos
+
+    engine.telemetry = _traced_logger()
+    srv = InferenceServer(
+        engine,
+        serving=ServingConfig(port=0, max_wait_ms=0, predict_timeout_s=0.05,
+                              breaker_threshold=0),  # breaker off: raw 504
+        chaos=ServeChaos(predict_ms=400.0, lat_from=1))
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.port, _sample_json(_sample(5, seed=3)),
+                  headers={"X-Request-Id": "slow-one"})
+        assert ei.value.code == 504
+        assert json.loads(ei.value.read())["trace_id"] == "slow-one"
+        assert ei.value.headers.get("X-Request-Id") == "slow-one"
+    finally:
+        srv.shutdown()
+
+
+def test_trace_id_survives_midflight_failover(engine):
+    """The PR-8 chaos path: replica 0 dies UNDER the request; the router
+    retries on replica 1 and the answer still quotes the client's id —
+    and the fleet-edge request span records the whole story as ONE
+    trace."""
+    from hydragnn_tpu.serve import (
+        FleetRouter,
+        FleetSupervisor,
+        InProcessReplica,
+    )
+    from hydragnn_tpu.serve.fleet import ReplicaDeadError
+
+    eng = engine
+    serving = ServingConfig(port=0, max_wait_ms=2,
+                            request_deadline_ms=10_000.0,
+                            fleet_probe_s=0.03,
+                            fleet_restart_backoff_s=0.05)
+    tel = _traced_logger()
+    replicas = [InProcessReplica(i, eng.fork, serving,
+                                 MetricsLogger.disabled())
+                for i in range(2)]
+    fleet = FleetSupervisor(replicas, serving, telemetry=tel)
+    router = FleetRouter(fleet, serving=serving, cfg=eng.cfg, telemetry=tel)
+    router.start()
+    try:
+        def dead_predict(req, deadline_s):
+            raise ReplicaDeadError("simulated mid-request death")
+
+        fleet.replicas[0].predict = dead_predict
+        for i in range(4):  # whatever po2 picks first, all must fail over
+            rid = f"failover-{i}"
+            code, out, hdrs = _post(
+                router.port, _sample_json(_sample(5, seed=i),
+                                          timeout_ms=10_000),
+                headers={"X-Request-Id": rid})
+            assert code == 200
+            assert out["replica"] == 1
+            assert out["trace_id"] == rid
+            assert hdrs.get("X-Request-Id") == rid
+        assert router.metrics()["router"]["failovers"] >= 1
+        spans = {s["trace_id"]: s for s in tel.spans.snapshot()
+                 if s["name"] == "serve.request"}
+        for i in range(4):
+            assert spans[f"failover-{i}"]["status"] == 200
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Train-step phase attribution
+# ---------------------------------------------------------------------------
+
+
+def test_traced_loader_and_step_record_phases():
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train.trainer import _traced_loader, _traced_step
+
+    rec = SpanRecorder(ring=32)
+    batches = list(range(3))
+    seen = list(_traced_loader(iter(batches), rec))
+    assert seen == batches  # pass-through, order preserved
+
+    def step_fn(state, g):
+        return state + g, {"loss": jnp.float32(g)}
+
+    stepped = _traced_step(step_fn, rec)
+    state = 0
+    for g in seen:
+        state, metrics = stepped(state, g)
+    assert state == 3 and float(metrics["loss"]) == 2.0
+    pct = rec.percentiles()
+    assert pct["train.data_wait"]["count"] == 3
+    assert pct["train.h2d"]["count"] == 3
+    assert pct["train.step"]["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Comm-vs-compute A/B probe (forced 8-device CPU mesh via conftest)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_harness():
+    import jax
+
+    from test_resilience import _batch, _model
+
+    from hydragnn_tpu.parallel.mesh import (
+        make_mesh,
+        replicate_state,
+        stack_batches,
+    )
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.trainer import create_train_state
+
+    cfg, model = _model()
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    mesh = make_mesh()
+    n_dev = len(jax.devices())
+    batches = stack_batches([_batch(seed=i) for i in range(n_dev)])
+    state = replicate_state(create_train_state(model, _batch(), opt), mesh)
+    return cfg, model, opt, mesh, state, batches
+
+
+def test_comm_probe_default_off_hlo_pure(mesh_harness):
+    """PR-15-style purity: default-off lowers the SAME program, and the
+    probe annotation changes compiled-HLO METADATA only — the lowered
+    StableHLO is byte-identical, so the timed program IS the production
+    program."""
+    from hydragnn_tpu.parallel.mesh import make_dp_train_step
+
+    cfg, model, opt, mesh, state, batches = mesh_harness
+    base_l = make_dp_train_step(model, cfg, opt, mesh).lower(state, batches)
+    off_l = make_dp_train_step(model, cfg, opt, mesh, comm_probe=False
+                               ).lower(state, batches)
+    on_l = make_dp_train_step(model, cfg, opt, mesh, comm_probe=True
+                              ).lower(state, batches)
+    base_txt = base_l.as_text()
+    assert off_l.as_text() == base_txt
+    assert on_l.as_text() == base_txt  # annotation is metadata-only
+    assert "comm.dp_psum" not in base_txt
+    # the compiled program carries the region names as op metadata — the
+    # xprof/Perfetto attribution handle
+    compiled_on = on_l.compile().as_text()
+    assert "comm.dp_psum" in compiled_on
+    assert "comm.dp_psum" not in base_l.compile().as_text()
+
+
+def test_dp_comms_probe_reports_split_and_preserves_state(mesh_harness):
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.telemetry.comms import comm_split, dp_comms_probe
+
+    cfg, model, opt, mesh, state, batches = mesh_harness
+    out = dp_comms_probe(model, cfg, opt, mesh, state, batches, iters=1)
+    assert out["path"] == "dp"
+    assert out["n_devices"] == len(jax.devices())
+    assert out["comm_ms"] >= 0.0 and out["compute_ms"] >= 0.0
+    assert out["step_ms"] == pytest.approx(
+        out["comm_ms"] + out["compute_ms"], abs=0.01)
+    assert 0.0 <= out["comm_pct"] <= 100.0
+    assert "comm.dp_psum_ms" in out["parts"]
+    assert "upper bound" in out["method"]
+    # the probe timed COPIES: the caller's state was never donated
+    leaf = jax.tree.leaves(state.params)[0]
+    assert bool(jnp.isfinite(jnp.sum(leaf)))
+
+    # split arithmetic clamps: comm can never exceed the step
+    s = comm_split(2.0, 5.0)
+    assert s == {"step_ms": 2.0, "comm_ms": 2.0, "compute_ms": 0.0,
+                 "comm_pct": 100.0}
+
+
+def test_log_comms_lands_in_manifest(tmp_path):
+    tel = _traced_logger(tmp_path, sinks=("jsonl",))
+    tel.log_comms({"path": "dp", "step_ms": 4.0, "comm_ms": 1.0,
+                   "compute_ms": 3.0, "comm_pct": 25.0})
+    tel.finalize()
+    recs = [json.loads(line)
+            for line in open(tel.jsonl_path) if line.strip()]
+    assert any(r.get("event") == "comms" and r["path"] == "dp"
+               for r in recs)
+    manifest = next(r for r in recs if r.get("event") == "manifest")
+    assert manifest["comms"]["comm_pct"] == 25.0
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor
+# ---------------------------------------------------------------------------
+
+
+class _Tel:
+    def __init__(self):
+        self.events = []
+
+    def health(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+def test_slo_monitor_fires_on_synthetic_burn_edge_triggered():
+    tel = _Tel()
+    mon = BurnRateMonitor(
+        SloConfig(shed_budget=0.05, window_s=60.0, burn=2.0),
+        telemetry=tel)
+    # 10 accepted answers, then a shed storm: 5/15 = 33% >> 2x5% = 10%
+    for i in range(10):
+        mon.observe({"event": "step", "source": "serve", "num_graphs": 1,
+                     "predict_ms": 5.0, "wait_ms": 1.0}, now=float(i))
+    assert mon.check(now=10.0) is None  # compliant so far
+    for i in range(5):
+        mon.observe({"event": "health", "kind": "request_shed"},
+                    now=10.0 + i)
+    v = mon.check(now=15.0)
+    assert v is not None and v["budget"] == "shed_ratio"
+    assert v["shed"] == 5 and v["accepted"] == 10
+    assert [k for k, _ in tel.events] == ["slo_burn"]
+    # edge-triggered: the SAME excursion stays quiet
+    assert mon.check(now=16.0) is None
+    assert mon.fired == 1
+    # a compliant window re-arms (sheds age out), a fresh burn re-fires
+    assert mon.check(now=200.0) is None
+    for i in range(5):
+        mon.observe({"event": "health", "kind": "queue_full"},
+                    now=300.0 + i)
+    mon.observe({"event": "step", "source": "serve", "num_graphs": 1,
+                 "predict_ms": 5.0, "wait_ms": 1.0}, now=305.0)
+    assert mon.check(now=306.0) is not None
+    assert mon.fired == 2
+
+
+def test_slo_monitor_latency_budget_uses_request_spans():
+    tel = _Tel()
+    mon = BurnRateMonitor(
+        SloConfig(p99_ms=100.0, shed_budget=1.0, window_s=60.0),
+        telemetry=tel)
+    for i in range(20):
+        mon.observe({"event": "span", "name": "serve.request",
+                     "dur_ms": 250.0}, now=float(i))
+    v = mon.check(now=21.0)
+    assert v is not None and v["budget"] == "latency_p99"
+    assert v["p99_ms"] == 250.0 and v["target_ms"] == 100.0
+    assert tel.events[0][0] == "slo_burn"
+
+
+def test_slo_monitor_quiet_on_compliant_stream():
+    tel = _Tel()
+    mon = BurnRateMonitor(
+        SloConfig(p99_ms=1000.0, shed_budget=0.05, window_s=60.0),
+        telemetry=tel)
+    for i in range(100):
+        mon.observe({"event": "step", "source": "serve", "num_graphs": 4,
+                     "predict_ms": 3.0, "wait_ms": 2.0}, now=float(i))
+        assert mon.check(now=float(i)) is None
+    # one shed among 400 accepted: well under budget
+    mon.observe({"event": "health", "kind": "request_shed"}, now=100.0)
+    assert mon.check(now=101.0) is None
+    assert mon.fired == 0 and tel.events == []
+
+
+def test_slo_tail_jsonl_offline_replay(tmp_path):
+    burn = tmp_path / "burn.jsonl"
+    with open(burn, "w") as f:
+        for i in range(10):
+            f.write(json.dumps({"event": "step", "source": "serve",
+                                "num_graphs": 1, "predict_ms": 1.0,
+                                "wait_ms": 0.0, "t": float(i)}) + "\n")
+        f.write("not json — skipped, not fatal\n")
+        for i in range(10):
+            f.write(json.dumps({"event": "health", "kind": "queue_full",
+                                "t": 10.0 + i}) + "\n")
+    cfg = SloConfig(shed_budget=0.05, window_s=60.0, burn=2.0)
+    mon, violations = tail_jsonl(str(burn), cfg)
+    assert len(violations) == 1  # edge-triggered: one per excursion
+    assert violations[0]["budget"] == "shed_ratio"
+    assert mon.fired == 1
+
+    quiet = tmp_path / "quiet.jsonl"
+    with open(quiet, "w") as f:
+        for i in range(50):
+            f.write(json.dumps({"event": "step", "source": "serve",
+                                "num_graphs": 2, "predict_ms": 1.0,
+                                "wait_ms": 0.0, "t": float(i)}) + "\n")
+    mon, violations = tail_jsonl(str(quiet), cfg)
+    assert violations == [] and mon.fired == 0
+
+
+def test_slo_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_SLO_P99_MS", "250")
+    monkeypatch.setenv("HYDRAGNN_SLO_SHED_BUDGET", "0.02")
+    monkeypatch.setenv("HYDRAGNN_SLO_WINDOW_S", "30")
+    monkeypatch.setenv("HYDRAGNN_SLO_BURN", "4.0")
+    cfg = SloConfig(p99_ms=1.0, shed_budget=0.5, window_s=5.0, burn=1.0)
+    assert (cfg.p99_ms, cfg.shed_budget, cfg.window_s, cfg.burn) \
+        == (250.0, 0.02, 30.0, 4.0)
+    monkeypatch.setenv("HYDRAGNN_SLO_BURN", "not-a-float")
+    assert SloConfig(burn=3.0).burn == 3.0  # malformed env falls back

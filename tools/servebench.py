@@ -76,11 +76,13 @@ def random_graph(rng: np.random.RandomState, max_nodes: int,
             "edge_index": np.asarray(ei).tolist()}
 
 
-def _post(url: str, obj: Dict[str, Any], timeout: float = 60.0):
+def _post(url: str, obj: Dict[str, Any], timeout: float = 60.0,
+          headers: Dict[str, str] = None):
     body = json.dumps(obj).encode()
-    req = urllib.request.Request(
-        url + "/predict", data=body,
-        headers={"Content-Type": "application/json"})
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url + "/predict", data=body, headers=hdrs)
     return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
 
 
@@ -94,15 +96,19 @@ def run_bench(url: str, concurrency: int, requests_total: int,
     per_worker = max(1, requests_total // max(1, concurrency))
     latencies: List[float] = []
     errors: List[str] = []
+    trace_mismatches = [0]
     lock = threading.Lock()
 
     def worker(wid: int):
         rng = np.random.RandomState(1000 + wid)
-        for _ in range(per_worker):
+        for i in range(per_worker):
             graph = random_graph(rng, max_nodes, input_dim)
+            # per-request trace id: every bench request is findable in the
+            # server's span JSONL / Chrome export by its X-Request-Id
+            rid = f"bench-{wid}-{i}"
             t0 = time.perf_counter()
             try:
-                _post(url, graph)
+                resp = _post(url, graph, headers={"X-Request-Id": rid})
             except Exception as e:  # noqa: BLE001 — tallied, not fatal
                 with lock:
                     errors.append(repr(e))
@@ -110,6 +116,10 @@ def run_bench(url: str, concurrency: int, requests_total: int,
             dt = (time.perf_counter() - t0) * 1e3
             with lock:
                 latencies.append(dt)
+                # servers without the flight recorder omit trace_id — only
+                # an echoed-but-DIFFERENT id is a propagation bug
+                if resp.get("trace_id", rid) != rid:
+                    trace_mismatches[0] += 1
 
     threads = [threading.Thread(target=worker, args=(w,))
                for w in range(concurrency)]
@@ -167,6 +177,16 @@ def run_bench(url: str, concurrency: int, requests_total: int,
         # pytree (engine.quant_stats) — the HBM-per-replica claim is
         # RECORDED per run, not asserted
         "quant": eng.get("quant", {}),
+        # X-Request-Id propagation: every request was stamped; the server
+        # must echo the SAME id back (trace_id in the answer body)
+        "trace": {
+            "request_ids_stamped": len(latencies) + len(errors),
+            "echo_mismatches": trace_mismatches[0],
+        },
+        # span-latency breakdown (queue-wait/pad/predict percentiles) from
+        # /metrics — populated when the server's flight recorder is on,
+        # {} otherwise (same always-present contract as /metrics itself)
+        "spans": metrics.get("spans", {}),
         "slo": {
             "max_wait_ms": max_wait_ms,
             "max_predict_ms": round(max_predict_ms, 3),
@@ -333,7 +353,7 @@ def run_overload(url: str, rate: float, duration_s: float, max_nodes: int,
     return result
 
 
-def _tiny_engine(serving, hidden_dim: int = 8):
+def _tiny_engine(serving, hidden_dim: int = 8, telemetry=None):
     """Fresh-initialized tiny SAGE InferenceEngine for the selftests —
     no checkpoint, no dataset; shared by the single-server selftest,
     the quant A/B, and the fleet bench."""
@@ -364,14 +384,16 @@ def _tiny_engine(serving, hidden_dim: int = 8):
     state = InferenceState(step=0, params=variables["params"],
                            batch_stats=variables.get("batch_stats", {}))
     return InferenceEngine(cfg, state, [HeadSpec("energy", "graph", 1)],
-                           pads, serving=serving)
+                           pads, serving=serving, telemetry=telemetry)
 
 
 def _selftest_server(deadline_ms: float = 10_000.0,
                      chaos_predict_ms: float = 0.0,
                      buckets: Tuple[int, ...] = (1, 4, 16),
                      quant_policy: str = "f32",
-                     hidden_dim: int = 8):
+                     hidden_dim: int = 8,
+                     trace: bool = False,
+                     trace_dir: str = None):
     """Tiny fresh-initialized SAGE model behind a local server on an
     ephemeral port — no checkpoint, no dataset.
 
@@ -385,6 +407,11 @@ def _selftest_server(deadline_ms: float = 10_000.0,
     ``quant_policy``/``hidden_dim`` drive the ``--quant-ab`` A/B: the
     quant runs use a wider model (hidden 64) so the int8 per-channel
     scale overhead is amortized like a real checkpoint's.
+
+    ``trace=True`` arms the flight recorder (telemetry/trace.py): span
+    records stream to a JSONL under ``trace_dir`` (default
+    ``logs/servebench/telemetry``) and /metrics gains the per-span
+    percentile block the bench JSON republishes.
     """
     from hydragnn_tpu.serve import InferenceServer, ServingConfig
 
@@ -392,7 +419,14 @@ def _selftest_server(deadline_ms: float = 10_000.0,
                             max_edges_per_graph=128, max_wait_ms=10.0,
                             port=0, request_deadline_ms=deadline_ms,
                             quant_policy=quant_policy)
-    engine = _tiny_engine(serving, hidden_dim=hidden_dim)
+    tel = None
+    if trace:
+        from hydragnn_tpu.telemetry import MetricsLogger, TelemetryConfig
+
+        tel = MetricsLogger(
+            TelemetryConfig(enable=True, sinks=("jsonl",), trace=True),
+            run_name="servebench", out_dir=trace_dir)
+    engine = _tiny_engine(serving, hidden_dim=hidden_dim, telemetry=tel)
     chaos = None
     if chaos_predict_ms > 0:
         from hydragnn_tpu.resilience import ServeChaos
@@ -1231,6 +1265,12 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=250.0,
                     help="per-request deadline in overload mode "
                          "(default 250)")
+    ap.add_argument("--trace", action="store_true",
+                    help="selftest only: arm the flight recorder on the "
+                         "in-process server — span records stream to a "
+                         "JSONL (view with tools/teleview.py --trace) and "
+                         "the bench JSON carries the span percentile "
+                         "breakdown")
     ap.add_argument("--chaos-predict-ms", type=float, default=25.0,
                     help="selftest overload only: chaos-injected predict "
                          "latency that pulls capacity into the "
@@ -1313,9 +1353,13 @@ def main(argv=None) -> int:
             deadline_ms=args.deadline_ms if args.overload else 10_000.0,
             chaos_predict_ms=args.chaos_predict_ms if args.overload
             else 0.0,
-            buckets=(1, 2, 4) if args.overload else (1, 4, 16))
+            buckets=(1, 2, 4) if args.overload else (1, 4, 16),
+            trace=args.trace)
         url = f"http://127.0.0.1:{server.port}"
         print(f"selftest server on {url}", flush=True)
+        if args.trace:
+            print(f"flight recorder on -> "
+                  f"{server.engine.telemetry.jsonl_path}", flush=True)
     try:
         url = url.rstrip("/")
         if args.overload:
@@ -1338,6 +1382,9 @@ def main(argv=None) -> int:
     finally:
         if server is not None:
             server.shutdown()
+            tel = server.engine.telemetry
+            if getattr(tel, "spans", None) is not None:
+                tel.finalize()  # manifest with the spans summary block
     atomic_write_json(out_path, result)
     print(json.dumps(result, indent=2))
     print(f"\nwrote {out_path}")

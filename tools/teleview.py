@@ -395,6 +395,110 @@ def sharding_section(shardings: List[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile over an ASCENDING list (same definition as
+    hydragnn_tpu/telemetry/trace.py — teleview stays stdlib-only, so the
+    three lines are duplicated rather than importing the jax-adjacent
+    package)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _span_family(name: str) -> str:
+    return name.split(".", 1)[0] if "." in name else name
+
+
+def chrome_trace_doc(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome-trace JSON (chrome://tracing / Perfetto "load trace") from
+    span records: complete events (ph=X, µs), one pid per name family
+    (serve/train/comm), one tid per trace_id so each request reads as a
+    lane.  Mirrors hydragnn_tpu.telemetry.trace.chrome_trace."""
+    tids: Dict[str, int] = {}
+    events = []
+    for r in spans:
+        tid = tids.setdefault(str(r.get("trace_id", "")), len(tids) + 1)
+        args = {k: v for k, v in r.items()
+                if k not in ("event", "name", "t_start_s", "dur_ms",
+                             "run_id", "rank", "t")}
+        events.append({
+            "name": r.get("name", "?"),
+            "cat": _span_family(str(r.get("name", "?"))),
+            "ph": "X",
+            "ts": round(float(r.get("t_start_s", 0.0)) * 1e6, 1),
+            "dur": round(float(r.get("dur_ms", 0.0)) * 1e3, 1),
+            "pid": _span_family(str(r.get("name", "?"))),
+            "tid": tid,
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def trace_section(spans: List[Dict[str, Any]], tail: int = 3) -> str:
+    """Flight-recorder view: per-name duration percentiles, then a text
+    waterfall of the last ``tail`` traces (request span with its linked
+    flush/queue/pad/predict children indented under it) — and the WARN
+    the percentiles exist for: queue-wait p99 above predict p99 means
+    requests spend longer WAITING than computing (the batcher, not the
+    model, is the bottleneck — grow capacity or shrink max_wait_ms)."""
+    by_name: Dict[str, List[float]] = {}
+    for r in spans:
+        by_name.setdefault(str(r.get("name", "?")), []).append(
+            float(r.get("dur_ms", 0.0)))
+    rows = []
+    p99s: Dict[str, float] = {}
+    for name in sorted(by_name):
+        vals = sorted(by_name[name])
+        p99s[name] = _quantile(vals, 0.99)
+        rows.append([name, str(len(vals)),
+                     f"{_quantile(vals, 0.5):.3f}",
+                     f"{_quantile(vals, 0.95):.3f}",
+                     f"{p99s[name]:.3f}", f"{vals[-1]:.3f}"])
+    table = _table(rows, ["span", "count", "p50ms", "p95ms", "p99ms",
+                          "maxms"])
+    lines = ["  " + ln for ln in table.splitlines()]
+
+    qw, pr = p99s.get("serve.queue_wait"), p99s.get("serve.predict")
+    if qw is not None and pr is not None and qw > pr:
+        lines.append(
+            f"  WARNING queue-wait p99 {qw:.3f}ms exceeds predict p99 "
+            f"{pr:.3f}ms — requests wait longer than they compute; the "
+            "batcher is the bottleneck (add replicas, lower max_wait_ms, "
+            "or widen buckets)")
+
+    # waterfall: group by trace_id, children indented under their parent
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    order: List[str] = []
+    for r in spans:
+        t = str(r.get("trace_id", ""))
+        if t not in by_trace:
+            order.append(t)
+        by_trace.setdefault(t, []).append(r)
+    # flush spans live in their own trace and LINK the request traces
+    # they carried — fold linked traces into the flush's waterfall view
+    for t in order[-tail:]:
+        group = sorted(by_trace[t],
+                       key=lambda r: float(r.get("t_start_s", 0.0)))
+        lines.append(f"  trace {t[:16]}…" if len(t) > 16
+                     else f"  trace {t}")
+        ids = {str(r.get("span_id", "")) for r in group}
+        t0 = float(group[0].get("t_start_s", 0.0))
+        for r in group:
+            indent = "    " if str(r.get("parent_id", "")) in ids else "  "
+            off = (float(r.get("t_start_s", 0.0)) - t0) * 1e3
+            extra = ""
+            if r.get("links"):
+                extra = f"  links={len(r['links'])} request(s)"
+            if r.get("status") is not None:
+                extra += f"  status={r['status']}"
+            lines.append(f"  {indent}+{off:8.3f}ms  "
+                         f"{r.get('name', '?'):<18} "
+                         f"{float(r.get('dur_ms', 0.0)):9.3f}ms{extra}")
+    return "\n".join(lines)
+
+
 def epoch_rows(epochs: List[Dict[str, Any]]) -> str:
     rows = []
     for r in epochs:
@@ -423,6 +527,13 @@ def main(argv=None) -> int:
                     help="BENCH_evidence.json from a bench run: render "
                          "the --dense acceptance bound (MFU floor + "
                          "fused-dispatch check) as WARNINGs")
+    ap.add_argument("--trace", action="store_true",
+                    help="flight-recorder view: span percentiles + a "
+                         "waterfall of the last traces (event=span "
+                         "records; enable with HYDRAGNN_TRACE=1)")
+    ap.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="with --trace: also export the spans as a "
+                         "Chrome-trace file (chrome://tracing, Perfetto)")
     args = ap.parse_args(argv)
 
     path = find_events(args.path)
@@ -437,6 +548,33 @@ def main(argv=None) -> int:
     manifests = [r for r in records if r.get("event") == "manifest"]
     health = [r for r in records if r.get("event") == "health"]
     shardings = [r for r in records if r.get("event") == "sharding"]
+    spans = [r for r in records if r.get("event") == "span"]
+
+    if args.trace:
+        if not spans:
+            print(f"{path}: no span records — enable the flight recorder "
+                  "with HYDRAGNN_TRACE=1 (Telemetry.trace)")
+            return 0
+        print(f"{path}: {len(spans)} span record(s)")
+        print(trace_section(spans))
+        comms = next((m.get("comms") for m in reversed(manifests)
+                      if m.get("comms")), None)
+        if comms:
+            print(f"\ncomms (A/B probe, {comms.get('path', '?')} path): "
+                  f"step {comms.get('step_ms', 0)}ms = "
+                  f"compute {comms.get('compute_ms', 0)}ms + "
+                  f"comm {comms.get('comm_ms', 0)}ms "
+                  f"({comms.get('comm_pct', 0)}%)")
+        if args.chrome:
+            doc = chrome_trace_doc(spans)
+            tmp = args.chrome + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, args.chrome)
+            print(f"\nwrote {args.chrome} "
+                  f"({len(doc['traceEvents'])} events) — load in "
+                  "chrome://tracing or https://ui.perfetto.dev")
+        return 0
 
     if args.json:
         sel = epochs if args.epochs else steps[-args.tail:] + epochs
