@@ -165,6 +165,10 @@ _KNOB_LIST = [
        "hydragnn_tpu/models/egnn.py",
        "EGNN fused EGCL interaction-block gate (1/0 forces, subject "
        "to the kernel's structural width limits)"),
+    _k("HYDRAGNN_CGCNN_FUSED", "", "auto",
+       "hydragnn_tpu/models/cgcnn.py",
+       "CGCNN fused gated-sum block gate (1/0 forces, subject to the "
+       "kernel's structural width limits)"),
     _k("HYDRAGNN_DN_TRI_OFF", "", "0",
        "hydragnn_tpu/models/dimenet.py",
        "disable the DimeNet fused-triplet kernel"),
@@ -448,9 +452,13 @@ _HEALTH_LIST = [
        "guard monitor hit N consecutive bad steps and raised"),
     _h("graph_shard_fallback", "hydragnn_tpu/train/trainer.py",
        "graph sharding requested but the run fell back to plain DP"),
+    _h("fused_fallback", "hydragnn_tpu/train/trainer.py",
+       "an arch fell off its fused edge-block path (structural limit, "
+       "missing sender_perm, or env override) and composed the XLA "
+       "route instead — fields carry arch and reason"),
     _h("egcl_fallback", "hydragnn_tpu/train/trainer.py",
-       "EGNN fell off the fused EGCL path (structural limit or env "
-       "override) and composed the XLA route instead"),
+       "legacy alias of fused_fallback, still emitted when the arch is "
+       "EGNN (kept one release for dashboards keyed on the old kind)"),
     _h("train_dtype_reject", "hydragnn_tpu/train/trainer.py",
        "bf16 train policy requested but rejected (golden-gate drift, "
        "graph sharding, or empty loader) — run fell back to f32"),

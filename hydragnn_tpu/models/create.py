@@ -36,8 +36,8 @@ _STACKS = {
 }
 
 # THE canonical arch list: bench.py's per-arch sweep and the fused-vs-
-# scatter parity tests (tests/test_fused_mp.py) both derive from it, so a
-# newly registered stack cannot miss bench or parity coverage.
+# scatter parity tests (tests/test_fused_block.py) both derive from it, so
+# a newly registered stack cannot miss bench or parity coverage.
 ALL_ARCHS = tuple(_STACKS)
 
 

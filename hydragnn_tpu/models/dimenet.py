@@ -113,6 +113,11 @@ class DnTriGate:
             return self.ok
         if measure_span() > 2:
             self.ok = False  # sticky: whole-run fallback from here on
+            # one-shot: surfaces as the unified `fused_fallback` health
+            # event ({arch, reason}) after the first epoch's dispatch
+            from hydragnn_tpu.ops.fused_block import note_fallback
+
+            note_fallback("DimeNet", reason="edge_span")
         return self.ok
 
 
@@ -434,7 +439,7 @@ class _ResidualParams(nn.Module):
 
     @nn.compact
     def __call__(self):
-        from hydragnn_tpu.models.schnet import _DenseParams
+        from hydragnn_tpu.models.layers import DenseParams as _DenseParams
 
         k1, b1 = _DenseParams(self.dim, self.dim, name="lin1")()
         k2, b2 = _DenseParams(self.dim, self.dim, name="lin2")()
@@ -450,6 +455,7 @@ class InteractionPPBlock(nn.Module):
     sorted_hint: bool = False  # idx_ji is nondecreasing (builder order)
     tri_window: int = 0  # >0: fused edge-space kernel window (collate-vouched)
     tri_kernel: bool = False  # fused factored-basis kernel (ops/dn_tri.py)
+    tri_builder: bool = False  # builder-backed wide-dim path (ops/dn_tri.py)
     num_radial: int = 6  # static R for the kernel's lane expansion
 
     @nn.compact
@@ -474,7 +480,7 @@ class InteractionPPBlock(nn.Module):
             # tables.  Matmul-free param declarations keep the tree
             # identical to the nn.Dense layers they replace (checkpoint
             # path-independence, as in models/schnet._DenseParams).
-            from hydragnn_tpu.models.schnet import _DenseParams
+            from hydragnn_tpu.models.layers import DenseParams as _DenseParams
             from hydragnn_tpu.ops.dn_tri import dimenet_triplet_mp
 
             sr = radial.shape[1]
@@ -512,6 +518,24 @@ class InteractionPPBlock(nn.Module):
                 return dimenet_post_mlp(
                     x_kj, x_ji, x_edge, self.num_before_skip,
                     self.num_after_skip, *wb)
+        elif self.tri_builder:
+            # builder-backed fused path where the factored-basis gate
+            # rejects on dims (S*R or the embedding sizes exceed its 64-
+            # lane packing but still fit one 128-lane tile): the chain
+            # fuses lin_sbf1/lin_sbf2 with the gather-multiply-scatter,
+            # so the [T, D] embedding never hits HBM.  Matmul-free param
+            # declarations keep the tree identical to the nn.Dense
+            # layers (checkpoint path-independence).
+            from hydragnn_tpu.models.layers import DenseParams
+            from hydragnn_tpu.ops.dn_tri import dimenet_tri_builder
+
+            k1, _ = DenseParams(sbf.shape[-1], self.basis_emb_size,
+                                use_bias=False, name="lin_sbf1")()
+            k2, _ = DenseParams(self.basis_emb_size, self.int_emb_size,
+                                use_bias=False, name="lin_sbf2")()
+            x_kj = dimenet_tri_builder(
+                x_kj, sbf, triplet_mask.astype(jnp.int32), k1, k2,
+                idx_kj, idx_ji, perm_kj)
         elif self.tri_window:
             sbf_emb = nn.Dense(self.basis_emb_size, use_bias=False, name="lin_sbf1")(sbf)
             sbf_emb = nn.Dense(self.int_emb_size, use_bias=False, name="lin_sbf2")(sbf_emb)
@@ -629,6 +653,24 @@ class DimeNetConv(nn.Module):
             # an explicit HYDRAGNN_DIMENET_FUSED_TRI opt-in wins: the
             # legacy T->E path stays reachable (and testable)
             and tri_w is None)
+        # wide dims beyond the factored kernel's packing fall to the
+        # builder-backed fused path (ops/dn_tri.dimenet_tri_builder) —
+        # same window invariant, full-sbf geometry stream
+        from hydragnn_tpu.ops.dn_tri import TRI_EMB_LIMIT, TRI_SBF_LIMIT
+
+        tri_builder = (
+            not tri_kernel and tri_w is None
+            and ex.get("dn_tri_ok") is not None and perm_kj is not None
+            and sr <= TRI_SBF_LIMIT
+            and self.basis_emb_size <= TRI_EMB_LIMIT
+            and self.int_emb_size <= TRI_EMB_LIMIT)
+        if (ex.get("dn_tri_ok") is not None and perm_kj is not None
+                and not (tri_kernel or tri_builder)):
+            from hydragnn_tpu.ops.fused_block import note_fallback
+
+            note_fallback("DimeNet", reason="width_gate",
+                          sr=int(sr), int_emb=int(self.int_emb_size),
+                          basis_emb=int(self.basis_emb_size))
         radial2 = cbf_exp = None
         if tri_kernel:
             radial2, cbf_exp = spherical_basis_factors(
@@ -677,6 +719,7 @@ class DimeNetConv(nn.Module):
             sorted_hint=sorted_hint,
             tri_window=tri_window,
             tri_kernel=tri_kernel,
+            tri_builder=tri_builder,
             num_radial=self.num_radial,
             name="interaction",
         )(x_edge, rbf, sbf, idx_kj, idx_ji, tmask, perm_kj=perm_kj,

@@ -23,21 +23,8 @@ import flax.linen as nn
 
 from hydragnn_tpu.graph import segment
 from hydragnn_tpu.models.base import Base
-from hydragnn_tpu.models.schnet import _DenseParams
-from hydragnn_tpu.telemetry import pipeline
-
-
-def _edge_geometry(pos, src, dst):
-    """The ONE per-edge geometry definition shared by the composed path
-    and the fused kernel: normalized difference vector and squared
-    distance.  eps inside the sqrt: padding self-edges have radial == 0
-    exactly, where sqrt's gradient is inf — this path must stay
-    differentiable for the energy-gradient force loss (jax.grad wrt
-    pos)."""
-    diff = pos[src] - pos[dst]
-    radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
-    diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)  # norm_diff=True
-    return diff, radial
+from hydragnn_tpu.models.layers import DenseParams, edge_geometry
+from hydragnn_tpu.ops.fused_block import note_fallback
 
 
 def _egcl_pipeline_enabled(features: int, hidden: int, geo_dim: int) -> bool:
@@ -85,7 +72,7 @@ class EGCL(nn.Module):
 
         # shared per-edge geometry, computed ONCE (the coord branch used
         # to recompute diff/radial on the fallback route)
-        diff, radial = _edge_geometry(pos, src, dst)
+        diff, radial = edge_geometry(pos, src, dst)
         use_ea = bool(self.edge_dim) and g.edge_attr is not None
         geo_dim = 4 + (g.edge_attr.shape[-1] if use_ea else 0)
 
@@ -94,15 +81,15 @@ class EGCL(nn.Module):
         # exactly as the nn.Dense layers they replace (identical
         # names/inits — checkpoints are path-independent)
         in_dim = 2 * x.shape[-1] + geo_dim - 3
-        k0, b0 = _DenseParams(in_dim, self.hidden_dim,
-                              name="edge_mlp_0")()
-        k1, b1 = _DenseParams(self.hidden_dim, self.hidden_dim,
-                              name="edge_mlp_1")()
+        k0, b0 = DenseParams(in_dim, self.hidden_dim,
+                             name="edge_mlp_0")()
+        k1, b1 = DenseParams(self.hidden_dim, self.hidden_dim,
+                             name="edge_mlp_1")()
         kc0 = bc0 = kc1 = None
         if self.equivariant:
-            kc0, bc0 = _DenseParams(self.hidden_dim, self.hidden_dim,
-                                    name="coord_mlp_0")()
-            kc1, _ = _DenseParams(
+            kc0, bc0 = DenseParams(self.hidden_dim, self.hidden_dim,
+                                   name="coord_mlp_0")()
+            kc1, _ = DenseParams(
                 self.hidden_dim, 1, use_bias=False,
                 kernel_init=nn.initializers.variance_scaling(
                     0.001, "fan_avg", "uniform"),
@@ -115,10 +102,10 @@ class EGCL(nn.Module):
         segment._count("egcl", fused)
         if not fused and _egcl_fused_wanted():
             # models hold no MetricsLogger — record the reason here (trace
-            # time, deduped) for the trainer to surface as an
-            # `egcl_fallback` health event after the first epoch
-            pipeline.record_fallback(
-                "egcl",
+            # time, deduped) for the trainer to surface as a unified
+            # `fused_fallback` health event after the first epoch
+            note_fallback(
+                "EGNN",
                 reason="no_sender_perm" if perm is None else "width_gate",
                 features=int(x.shape[-1]), hidden=int(self.hidden_dim),
                 geo_dim=int(geo_dim))

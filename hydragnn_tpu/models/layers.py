@@ -193,3 +193,42 @@ class MaskedBatchNorm(nn.Module):
 def shifted_softplus(x):
     """softplus(x) - log(2): SchNet's activation (PyG ShiftedSoftplus)."""
     return jax.nn.softplus(x) - jnp.log(2.0)
+
+
+class DenseParams(nn.Module):
+    """Parameters of an ``nn.Dense`` WITHOUT its matmul: same names
+    (kernel/bias), same default inits, same param tree — so the fused
+    edge-block paths (ops/fused_block.py specs: SchNet's cfconv,
+    DimeNet's triplet interaction, EGNN's interaction block, CGCNN's
+    gated sum) and the composed paths share checkpoints.
+    ``kernel_init`` overrides for layers whose nn.Dense twin uses a
+    non-default init (EGNN's coord gate)."""
+
+    in_dim: int
+    features: int
+    use_bias: bool = True
+    kernel_init: object = None
+
+    @nn.compact
+    def __call__(self):
+        init = self.kernel_init or nn.linear.default_kernel_init
+        k = self.param("kernel", init, (self.in_dim, self.features))
+        if not self.use_bias:
+            return k, None
+        b = self.param("bias", nn.initializers.zeros_init(),
+                       (self.features,))
+        return k, b
+
+
+def edge_geometry(pos, src, dst):
+    """The ONE per-edge geometry definition shared by the composed paths
+    and the fused kernels (EGNN's interaction block, SchNet's coord
+    branch, the builder's geo-lane packing): normalized difference
+    vector and squared distance.  eps inside the sqrt: padding
+    self-edges have radial == 0 exactly, where sqrt's gradient is inf —
+    this path must stay differentiable for the energy-gradient force
+    loss (jax.grad wrt pos)."""
+    diff = pos[src] - pos[dst]
+    radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
+    diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)  # norm_diff=True
+    return diff, radial

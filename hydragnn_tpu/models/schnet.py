@@ -23,7 +23,11 @@ import flax.linen as nn
 
 from hydragnn_tpu.graph import segment
 from hydragnn_tpu.models.base import Base
-from hydragnn_tpu.models.layers import shifted_softplus
+from hydragnn_tpu.models.layers import (
+    DenseParams, edge_geometry, shifted_softplus)
+
+# historical import location (DenseParams now lives in models/layers.py)
+_DenseParams = DenseParams
 
 
 def gaussian_smearing(dist, radius, num_gaussians):
@@ -31,30 +35,6 @@ def gaussian_smearing(dist, radius, num_gaussians):
     offsets = jnp.linspace(0.0, radius, num_gaussians)
     coeff = -0.5 / (offsets[1] - offsets[0]) ** 2
     return jnp.exp(coeff * (dist[:, None] - offsets[None, :]) ** 2)
-
-
-class _DenseParams(nn.Module):
-    """Parameters of an ``nn.Dense`` WITHOUT its matmul: same names
-    (kernel/bias), same default inits, same param tree — so the fused
-    edge-pipeline path below (and DimeNet's fused triplet path, and
-    EGNN's fused interaction block) and the composed paths share
-    checkpoints.  ``kernel_init`` overrides for layers whose nn.Dense
-    twin uses a non-default init (EGNN's coord gate)."""
-
-    in_dim: int
-    features: int
-    use_bias: bool = True
-    kernel_init: object = None
-
-    @nn.compact
-    def __call__(self):
-        init = self.kernel_init or nn.linear.default_kernel_init
-        k = self.param("kernel", init, (self.in_dim, self.features))
-        if not self.use_bias:
-            return k, None
-        b = self.param("bias", nn.initializers.zeros_init(),
-                       (self.features,))
-        return k, b
 
 
 def _scf_pipeline_enabled(num_filters: int, num_gaussians: int) -> bool:
@@ -117,10 +97,10 @@ class SCFConv(nn.Module):
         # pipeline below can consume them raw; the composed path applies
         # them exactly as the nn.Dense layers they replace (identical
         # names/inits — checkpoints are path-independent)
-        k0, b0 = _DenseParams(self.num_gaussians, self.num_filters,
-                              name="filter_0")()
-        k1, b1 = _DenseParams(self.num_filters, self.num_filters,
-                              name="filter_1")()
+        k0, b0 = DenseParams(self.num_gaussians, self.num_filters,
+                             name="filter_0")()
+        k1, b1 = DenseParams(self.num_filters, self.num_filters,
+                             name="filter_1")()
         perm = g.extras.get("edge_perm_sender") if g.extras else None
         fused_pipeline = (
             perm is not None and not self.equivariant
@@ -138,9 +118,7 @@ class SCFConv(nn.Module):
                      name="lin1")(x)
 
         if self.equivariant:
-            diff = pos[src] - pos[dst]
-            radial = jnp.sum(diff * diff, axis=-1, keepdims=True)
-            diff = diff / (jnp.sqrt(radial + 1e-12) + 1.0)
+            diff, _ = edge_geometry(pos, src, dst)
             cmlp = nn.Dense(self.num_filters, name="coord_mlp_0")(filt)
             cmlp = nn.relu(cmlp)
             cmlp = nn.Dense(

@@ -1,0 +1,103 @@
+"""CGCNN gated sum as a thin spec on the fused-block builder
+(:mod:`hydragnn_tpu.ops.fused_block`): both gathers -> gate MLP pair ->
+sigmoid*softplus -> segment sum in ONE Pallas pass, forward AND
+backward — no [E, 2F+A] concat stream, no [E, F] gate/core streams.
+
+  z_e    = [x[recv_e], x[send_e], edge_attr_e]
+  out[n] = sum_{e: recv[e]=n} sigmoid(z_e @ Wf + bf) * softplus(z_e @ Ws + bs)
+
+CGConv aggregates at the edge *receiver*, so the spec's primary side is
+the RECEIVER: collate's nondecreasing receiver order makes the scatter
+(and the x[recv] gather) block-local while the x[send] gather rides the
+±1-block window.  Each concat matmul is split into three partial
+matmuls summed in f32 — same math, different f32 rounding order (the
+parity tests bound the drift with the scf tolerance contract).  The
+biases fold onto the geometry stream's constant bias lane.
+
+Width limits: F <= CGCNN_F_LIMIT (the six [F, F] weight blocks and
+their pass-P grad accumulators are the VMEM ceiling) and
+edge_dim <= 127 (one geometry tile incl. the bias lane).  Callers gate
+on both and fall back to the composed path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.aggregate import _round_up
+from hydragnn_tpu.ops.fused_block import (
+    _GP, EdgeBlockSpec, _dot, build_fused_edge_op)
+
+_EDGE_BLOCK = 256
+CGCNN_F_LIMIT = 256
+CGCNN_GEO_LIMIT = _GP - 1  # edge_attr lanes; lane 127 carries the biases
+
+
+def _chain(w_vals, geo, xp, xo, dt):
+    wfp, wfo, wfg, wsp, wso, wsg = w_vals
+    tf = (_dot(xp, wfp, ((1,), (0,)), dt)
+          + _dot(xo, wfo, ((1,), (0,)), dt)
+          + _dot(geo, wfg, ((1,), (0,)), dt))
+    ts = (_dot(xp, wsp, ((1,), (0,)), dt)
+          + _dot(xo, wso, ((1,), (0,)), dt)
+          + _dot(geo, wsg, ((1,), (0,)), dt))
+    return (jax.nn.sigmoid(tf) * jax.nn.softplus(ts),)
+
+
+@functools.lru_cache(maxsize=None)
+def _cgcnn_op():
+    return build_fused_edge_op(EdgeBlockSpec(
+        name="cgcnn", primary="receiver", gather_primary=True,
+        gather_other=True, num_outputs=1, chain=_chain,
+        edge_block=_EDGE_BLOCK))
+
+
+def _split(k, b, f, a, d, f_pad, d_pad, gpw):
+    """Split a composed-path concat kernel k [2F+A, D] into the three
+    partial kernels the chain consumes (receiver rows, sender rows,
+    edge_attr rows) with b folded onto the geo bias lane."""
+    kp = jnp.zeros((f_pad, d_pad), jnp.float32).at[:f, :d].set(
+        k[:f].astype(jnp.float32))
+    ko = jnp.zeros((f_pad, d_pad), jnp.float32).at[:f, :d].set(
+        k[f:2 * f].astype(jnp.float32))
+    kg = jnp.zeros((gpw, d_pad), jnp.float32)
+    if a:
+        kg = kg.at[:a, :d].set(k[2 * f:].astype(jnp.float32))
+    kg = kg.at[gpw - 1, :d].set(b.astype(jnp.float32))
+    return kp, ko, kg
+
+
+def cgcnn_gated_block(x, edge_attr, em, kf, bf, ks, bs, senders, receivers,
+                      sender_perm):
+    """``out[n] = sum_{e: recv[e]=n} sigmoid(z_e @ kf + bf) *
+    softplus(z_e @ ks + bs)`` with ``z_e = [x[recv_e], x[send_e],
+    edge_attr_e]`` computed in-VMEM.
+
+    Differentiable wrt x, edge_attr and both kernel/bias pairs.
+    Requires the builder's collate invariants plus F <= CGCNN_F_LIMIT
+    and edge_dim <= CGCNN_GEO_LIMIT (callers gate).  ``em`` is the
+    int32 edge-validity mask (1 = real): em == 0 edges are skipped by
+    the block schedule entirely and get EXACTLY ZERO for every output
+    and grad (masked edges tail-sort in both orderings — collate
+    guarantees this)."""
+    n, f = x.shape
+    e = senders.shape[0]
+    d = kf.shape[-1]  # output width (nn.Dense features; may differ from f)
+    a = 0 if edge_attr is None else edge_attr.shape[-1]
+    f_pad = _round_up(max(f, 1), 128)
+    d_pad = _round_up(max(d, 1), 128)
+    gpw = _round_up(a + 1, _GP)
+    geo = (edge_attr if edge_attr is not None
+           else jnp.zeros((e, 0), x.dtype))
+    packs = _split(kf, bf, f, a, d, f_pad, d_pad, gpw) \
+        + _split(ks, bs, f, a, d, f_pad, d_pad, gpw)
+    if x.dtype == jnp.bfloat16:
+        # halves the constant weight blocks' VMEM (the chain's dots
+        # recast operands to the compute dtype either way)
+        packs = tuple(p.astype(jnp.bfloat16) for p in packs)
+    (out,) = _cgcnn_op()(
+        x, geo, em, tuple(packs), senders, receivers, sender_perm)
+    return out[:n, :d].astype(x.dtype)

@@ -48,35 +48,21 @@ num_spherical <= 8, num_radial such that S*R <= 64, int_emb <= 64.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
+from hydragnn_tpu.ops import fused_block as _fb
 from hydragnn_tpu.ops.aggregate import _round_up
-from hydragnn_tpu.ops.fused_mp import _dense_schedule
+from hydragnn_tpu.ops.fused_block import _dense_schedule
+from hydragnn_tpu.ops.fused_block import _window_maps as _win_maps
 
 _EB = 128      # edge block (output rows / window unit)
 _TB = 512      # triplets per grid step
 _SP = 8        # padded angular lane count (num_spherical <= 8)
 _GH = 64       # radial/x2 half-lane width (S*R <= 64, int_emb <= 64)
 _W = 5         # edge-block gather window (graphs span <= 2 blocks)
-
-
-def _win_maps(n_blocks):
-    def tix(s, si, se, *r):
-        return (se[s], 0)
-
-    def xoff(off):
-        def f(s, si, se, *r):
-            return (jnp.clip(si[s] + off, 0, n_blocks - 1), 0)
-        return f
-
-    def const(s, *r):
-        return (0, 0)
-
-    def outx(s, si, se, *r):
-        return (si[s], 0)
-
-    return tix, xoff, const, outx
 
 
 def _expand_matrix(s, r, dt):
@@ -399,3 +385,66 @@ def _tri_vjp_bwd(num_radial, res, dout):
 
 
 dimenet_triplet_mp.defvjp(_tri_vjp_fwd, _tri_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# builder-backed triplet contraction (wide dims)
+# ---------------------------------------------------------------------------
+#
+# The factored-basis kernel above is gated to S <= 8 / S*R <= 64 /
+# int_emb <= 64.  Beyond those (but within one 128-lane tile) the
+# contraction still IS message passing in edge space, so it rides the
+# generic fused-block builder: geo carries the per-triplet sbf stream,
+# the chain fuses lin_sbf1/lin_sbf2, and the gather/scatter pair uses
+# the same 5-block window invariant the collate marker vouches for.
+# Trades the factored kernel's compact [T, S<=8] angular stream for the
+# full [T, S*R] sbf stream — still one pass, no [T, D] embedding
+# materialization.
+
+TRI_SBF_LIMIT = _fb._GP - 1  # S*R lanes (one geo tile incl. bias lane)
+TRI_EMB_LIMIT = 128          # basis_emb / int_emb single tile
+
+
+def _tri_chain(w_vals, geo, xp, xo, dt):
+    k1, k2 = w_vals
+    emb = _fb._dot(_fb._dot(geo, k1, ((1,), (0,)), dt),
+                   k2, ((1,), (0,)), dt)
+    return (xo * emb,)
+
+
+@functools.lru_cache(maxsize=None)
+def _tri_builder_op():
+    return _fb.build_fused_edge_op(_fb.EdgeBlockSpec(
+        name="dn_tri_builder", primary="receiver", gather_primary=False,
+        gather_other=True, num_outputs=1, chain=_tri_chain,
+        window=_W, edge_block=256))
+
+
+def dimenet_tri_builder(x_kj, sbf, tmask, k1, k2, idx_kj, idx_ji, perm_kj):
+    """``out[e'] = sum_{t: ji(t)=e'} x_kj[kj(t)] * ((sbf_t @ k1) @ k2)``
+    in ONE pass, forward and backward (builder two-pass VJP).
+
+    Differentiable wrt x_kj, sbf, k1, k2 (the sbf cotangent chains into
+    angle/distance grads outside).  Requires idx_ji nondecreasing,
+    masked triplets tail-sorted in both orderings (add_dimenet_extras
+    pads the tail), every graph's edge-id span <= 2 edge blocks (the
+    collate marker vouches), S*R <= TRI_SBF_LIMIT and basis/int
+    embedding sizes <= TRI_EMB_LIMIT (callers gate).  ``tmask`` is the
+    int32 triplet-validity mask: masked triplets are schedule-skipped
+    and get exactly zero for every output and grad."""
+    e, d = x_kj.shape
+    s = sbf.shape[-1]
+    b = k1.shape[-1]
+    d_pad = _round_up(max(d, 1), 128)
+    b_pad = _round_up(max(b, 1), 128)
+    gpw = _round_up(s + 1, _fb._GP)
+    k1_p = jnp.zeros((gpw, b_pad), jnp.float32).at[:s, :b].set(
+        k1.astype(jnp.float32))
+    k2_p = jnp.zeros((b_pad, d_pad), jnp.float32).at[:b, :d].set(
+        k2.astype(jnp.float32))
+    if x_kj.dtype == jnp.bfloat16:
+        k1_p = k1_p.astype(jnp.bfloat16)
+        k2_p = k2_p.astype(jnp.bfloat16)
+    (out,) = _tri_builder_op()(
+        x_kj, sbf, tmask, (k1_p, k2_p), idx_kj, idx_ji, perm_kj)
+    return out[:e, :d].astype(x_kj.dtype)

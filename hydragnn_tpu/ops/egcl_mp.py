@@ -1,54 +1,33 @@
-"""Fused EGCL interaction block: gather -> 2-layer edge MLP -> tanh
+"""Fused EGCL interaction block as a thin spec on the fused-block builder
+(:mod:`hydragnn_tpu.ops.fused_block`): gather -> 2-layer edge MLP -> tanh
 coordinate gate -> BOTH scatters (message segment-sum AND coordinate
 translation sum) in ONE Pallas pass, forward and backward — no [E, hidden]
 HBM streams.
 
-Motivation (ROADMAP item 2): EGNN is the second-highest-traffic mainline
-arch in the BENCH_r05 sweep (94.6k g/s) and its composed step materializes
-every per-edge tensor — the [E, 2F+geo] concat, two [E, H] MLP
-activations, the [E, H] coord-MLP activation, the [E, 1] gate and the
-[E, 3] translations — then pays gather/scatter passes over each.  At
-EGNN's narrow hidden width (64) the step is stream-bound, not FLOP-bound,
-so the scf_mp recompute-over-store trade applies even though the matmuls
-are small: keep the entire per-edge pipeline in VMEM and let the extra
-backward re-evaluations ride the idle MXU.
-
-Schedule: fused_mp's dense block schedule, but SENDER-sorted as primary —
 EGNN aggregates BOTH outputs at the edge *source* (reference
-EGCLStack.py:194,210), so the host-precomputed ``edge_perm_sender``
-ordering makes the two scatters block-local one-hot matmuls while the
-single receiver gather rides the ±1-block window (collate invariant:
-graphs never straddle a node block).
+EGCLStack.py:194,210), so the spec's primary side is the SENDER: the
+host-precomputed ``edge_perm_sender`` ordering makes the two scatters
+block-local one-hot matmuls while the single receiver gather rides the
+±1-block window.
 
-  forward (sender-sorted):
-    t0   = x[send] @ W0s + x[recv] @ W0r + geo @ W0g     (split concat; b0
-                                                          on geo's bias lane)
-    m    = relu(relu(t0) @ W1 + b1)
-    agg[send]  += m                                      (one-hot scatter)
-    c    = tanh(relu(m @ Wc0 + bc0) @ Wc1)               (equivariant only)
-    psum[send] += clip(diff * c, ±100)                   (same one-hot)
+  t0   = x[send] @ W0s + x[recv] @ W0r + geo @ W0g     (split concat; b0
+                                                        on geo's bias lane)
+  m    = relu(relu(t0) @ W1 + b1)                      -> agg[send]
+  c    = tanh(relu(m @ Wc0 + bc0) @ Wc1)               (equivariant only)
+  clip(diff * c, ±100)                                 -> psum[send]
 
-  backward pass R (sender-sorted): recomputes the chain per block,
-    accumulates ALL weight grads IN-KERNEL (constant-mapped output blocks),
-    emits the per-edge dgeo stream [E, geo] (diff lanes carry the
-    coordinate-gate grad, radial/edge_attr lanes the MLP input grad — XLA
-    chains them into position grads outside) and scatters the sender-side
-    dx — the scatter target IS the sorted side here, so pass R covers it.
-  backward pass S (natural receiver order): recomputes the chain and
-    scatters the receiver-side dx; sender-side tensors ride the window.
+``geo`` is ``concat([diff_normed (3), radial (1), edge_attr (A)])`` — ONE
+canonical geometry definition shared with the composed path
+(models/layers.edge_geometry).  The concat matmul is split into three
+partial matmuls summed in f32 — same math, different f32 rounding order
+(tests bound the drift with the scf tolerance contract).  The ±100 clamp
+never binds (``|diff_normed| < 1``, ``|tanh| <= 1``) so its vjp mask is
+identically 1 on reachable inputs.
 
-Clip note: ``|diff_normed| < 1`` (norm_diff divides by sqrt(r)+1) and
-``|tanh| <= 1``, so the ±100 clamp NEVER binds and its grad mask is
-identically 1 — the backward drops it (the composed path's VJP is 1
-everywhere reachable too).
-
-Invariants: exactly fused_mp's (nondecreasing receivers, intra-graph
-edges, graphs within one node block, host-precomputed stable sender
-argsort).  Width limits: F <= EGCL_F_LIMIT and H <= EGCL_H_LIMIT (one
-128-lane tile each keeps every weight/accumulator block single-tile) and
-geo payload (3 diff + 1 radial + edge_dim) <= 127 (one pad lane carries
-the folded bias).  Callers gate on all three and fall back to the
-composed path.
+Width limits: F <= EGCL_F_LIMIT and H <= EGCL_H_LIMIT (one 128-lane tile
+each keeps every weight/accumulator block single-tile) and geo payload
+(3 diff + 1 radial + edge_dim) <= 127.  Callers gate on all three and
+fall back to the composed path.
 """
 
 from __future__ import annotations
@@ -59,8 +38,8 @@ import jax
 import jax.numpy as jnp
 
 from hydragnn_tpu.ops.aggregate import _round_up
-from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK, _dense_schedule
-from hydragnn_tpu.ops.scf_mp import _GP, _dot, _gather_window, _window_maps
+from hydragnn_tpu.ops.fused_block import (
+    _GP, EdgeBlockSpec, _dot, build_fused_edge_op)
 
 _EDGE_BLOCK = 256  # F/H capped at one tile => every temporary is [256, 128]
 EGCL_F_LIMIT = 128
@@ -68,72 +47,41 @@ EGCL_H_LIMIT = 128
 EGCL_GEO_LIMIT = _GP - 1  # payload lanes; lane 127 carries the folded b0
 
 
-def _gather_local(idx_ref, blk_ref, i, bn, dt):
-    """Block-local one-hot gather: rows of ``blk_ref`` (node block ``i``)
-    at global ids ``idx``.  Out-of-block ids produce an all-zero one-hot
-    row — gathered value 0, and the same one-hot transposed gates the
-    scatter, so such edges contribute nothing this visit (they are
-    in-block for exactly one visiting node block)."""
-    be = idx_ref.shape[0]
-    loc = idx_ref[:] - i * bn
-    onehot = (loc == jax.lax.broadcasted_iota(
-        jnp.int32, (be, bn), 1)).astype(dt)
-    return _dot(onehot, blk_ref[:], ((1,), (0,)), dt), onehot
+def _make_chain(equivariant: bool):
+    def chain(w_vals, geo, xp, xo, dt):
+        if equivariant:
+            w0s, w0r, w0g, w1, b1, wc0, bc0, wc1 = w_vals
+        else:
+            w0s, w0r, w0g, w1, b1 = w_vals
+        t0 = (_dot(xp, w0s, ((1,), (0,)), dt)
+              + _dot(xo, w0r, ((1,), (0,)), dt)
+              + _dot(geo, w0g, ((1,), (0,)), dt))
+        f1 = jax.nn.relu(t0)
+        m = jax.nn.relu(_dot(f1, w1, ((1,), (0,)), dt) + b1[0:1, :])
+        if not equivariant:
+            return (m,)
+        u0 = _dot(m, wc0, ((1,), (0,)), dt) + bc0[0:1, :]
+        v = jax.nn.relu(u0)
+        cp = _dot(v, wc1, ((1,), (0,)), dt)  # [BE, GP]; col 0 real
+        c = jnp.tanh(cp[:, 0:1])             # [BE, 1]
+        lane = jax.lax.broadcasted_iota(jnp.int32, geo.shape, 1)
+        diffm = jnp.where(lane < 3, geo, 0.0)
+        return (m, jnp.clip(diffm * c, -100.0, 100.0))
+    return chain
 
 
-def _edge_chain(xs, xr, geo_ref, w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref,
-                dt):
-    """Edge-MLP recompute: returns every intermediate the backward needs.
-    The concat matmul of the composed path is split into three partial
-    matmuls summed in f32 — same math, different f32 rounding order
-    (tests bound the drift with the scf tolerance contract)."""
-    t0 = (_dot(xs, w0s_ref[:], ((1,), (0,)), dt)
-          + _dot(xr, w0r_ref[:], ((1,), (0,)), dt)
-          + _dot(geo_ref[:], w0g_ref[:], ((1,), (0,)), dt))
-    f1 = jnp.maximum(t0, 0.0)
-    t1 = _dot(f1, w1_ref[:], ((1,), (0,)), dt) + b1_ref[0:1, :]
-    m = jnp.maximum(t1, 0.0)
-    return t0, f1, t1, m
-
-
-def _coord_chain(m, geo_ref, wc0_ref, bc0_ref, wc1_ref, dt):
-    """Coordinate gate recompute: c = tanh(relu(m@Wc0+bc0) @ Wc1) and the
-    diff lanes of the geo stream (lanes 0..2) isolated for the
-    translation product."""
-    u0 = _dot(m, wc0_ref[:], ((1,), (0,)), dt) + bc0_ref[0:1, :]
-    v = jnp.maximum(u0, 0.0)
-    cp = _dot(v, wc1_ref[:], ((1,), (0,)), dt)  # [BE, 128]; col 0 real
-    c = jnp.tanh(cp[:, 0:1])                    # [BE, 1]
-    lane = jax.lax.broadcasted_iota(jnp.int32, geo_ref.shape, 1)
-    diffm = jnp.where(lane < 3, geo_ref[:].astype(jnp.float32), 0.0)
-    return u0, v, c, diffm
-
-
-def _pack_edges(geo, em, senders, receivers, e_pad, n_pad):
-    """Pad edge arrays; bias lane (_GP - 1) of geo is constant 1.0.
-
-    MASKED edges (em == 0) are parked on the out-of-range sentinel node
-    ``n_pad`` in both id columns, so the dense schedule assigns their
-    edge blocks to NO node block and never visits them (scf_mp's
-    schedule-skip — requires masked edges to tail-sort in both edge
-    orderings, which collate guarantees by parking them on node N-1).
-    Their outputs and grads are therefore exactly zero by construction."""
-    e, gd = geo.shape
-    geo_p = jnp.zeros((e_pad, _GP), jnp.float32)
-    geo_p = geo_p.at[:e, :gd].set(geo.astype(jnp.float32))
-    geo_p = geo_p.at[:, _GP - 1].set(1.0)
-    valid = em != 0
-    send_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
-        jnp.where(valid, senders, n_pad).astype(jnp.int32))
-    recv_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
-        jnp.where(valid, receivers, n_pad).astype(jnp.int32))
-    return geo_p, send_p, recv_p
+@functools.lru_cache(maxsize=None)
+def _egcl_op(equivariant: bool):
+    return build_fused_edge_op(EdgeBlockSpec(
+        name="egcl", primary="sender", gather_primary=True,
+        gather_other=True, num_outputs=2 if equivariant else 1,
+        chain=_make_chain(equivariant), edge_block=_EDGE_BLOCK))
 
 
 def _pack_weights(equivariant, w0, b0, w1, b1, wc0, bc0, wc1,
                   f, f_pad, h_pad, bf16):
     """Split the composed path's concat kernel w0 [2F+1+A, H] into the
-    three partial kernels the kernel consumes (sender rows, receiver
+    three partial kernels the chain consumes (sender rows, receiver
     rows, geometry rows on the geo lane layout) with b0 folded onto the
     geo bias lane; b1/bc0 as [8, H] row-broadcast blocks; wc1 [H, 1] on
     column 0 of a full tile."""
@@ -164,269 +112,9 @@ def _pack_weights(equivariant, w0, b0, w1, b1, wc0, bc0, wc1,
         # after the f32-accumulating dots)
         packs = [p if p.shape[0] == 8 else p.astype(jnp.bfloat16)
                  for p in packs]
-    return packs
+    return tuple(packs)
 
 
-# ---------------------------------------------------------------------------
-# forward (sender-sorted)
-# ---------------------------------------------------------------------------
-
-
-def _fwd_kernel(equivariant, si_ref, se_ref, av_ref, fi_ref,
-                send_ref, recv_ref, geo_ref,
-                w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref, *rest):
-    from jax.experimental import pallas as pl
-
-    if equivariant:
-        (wc0_ref, bc0_ref, wc1_ref, xm1_ref, x0_ref, xp1_ref,
-         agg_ref, psum_ref) = rest
-    else:
-        xm1_ref, x0_ref, xp1_ref, agg_ref = rest
-        psum_ref = None
-
-    s = pl.program_id(0)
-    i = si_ref[s]
-
-    @pl.when(fi_ref[s] == 1)
-    def _init():
-        agg_ref[:] = jnp.zeros_like(agg_ref)
-        if equivariant:
-            psum_ref[:] = jnp.zeros_like(psum_ref)
-
-    @pl.when(av_ref[s] == 1)
-    def _acc():
-        bn = agg_ref.shape[0]
-        dt = w1_ref.dtype
-        xs, onehot_s = _gather_local(send_ref, x0_ref, i, bn, dt)
-        xr, _ = _gather_window(
-            recv_ref, (xm1_ref, x0_ref, xp1_ref), i - 1, bn)
-        _t0, _f1, _t1, m = _edge_chain(
-            xs, xr, geo_ref, w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref, dt)
-        agg_ref[:] += _dot(onehot_s, m, ((0,), (0,)), dt)
-        if equivariant:
-            _u0, _v, c, diffm = _coord_chain(
-                m, geo_ref, wc0_ref, bc0_ref, wc1_ref, dt)
-            trans = jnp.clip(diffm * c, -100.0, 100.0)
-            psum_ref[:] += _dot(onehot_s, trans, ((0,), (0,)), dt)
-
-
-def _fwd_impl(equivariant, x, geo, em, senders, receivers, sender_perm,
-              interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    n, f = x.shape
-    e = geo.shape[0]
-    f_pad = _round_up(max(f, 1), 128)
-    bn, be = _NODE_BLOCK, _EDGE_BLOCK
-    n_pad = _round_up(n, bn)
-    e_pad = _round_up(max(e, 1), be)
-    n_blocks, n_eblocks = n_pad // bn, e_pad // be
-
-    x_p = jnp.zeros((n_pad, f_pad), x.dtype).at[:n, :f].set(x)
-    geo_p, send_p, recv_p = _pack_edges(
-        geo[sender_perm], em[sender_perm], senders[sender_perm],
-        receivers[sender_perm], e_pad, n_pad)
-
-    step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
-        send_p[:, 0], n_blocks, bn, be, n_eblocks)
-    eix, xoff, const, outx = _window_maps(n_blocks)
-
-    def run(packs, h_pad):
-        n_w = len(packs)
-        w_specs = [pl.BlockSpec(p.shape, const) for p in packs]
-        out_specs = [pl.BlockSpec((bn, h_pad), outx)]
-        out_shape = [jax.ShapeDtypeStruct((n_pad, h_pad), jnp.float32)]
-        if equivariant:
-            out_specs.append(pl.BlockSpec((bn, _GP), outx))
-            out_shape.append(
-                jax.ShapeDtypeStruct((n_pad, _GP), jnp.float32))
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
-            grid=(s_max,),
-            in_specs=[
-                pl.BlockSpec((be, 1), eix),
-                pl.BlockSpec((be, 1), eix),
-                pl.BlockSpec((be, _GP), eix),
-                *w_specs[:n_w],
-                pl.BlockSpec((bn, f_pad), xoff(-1)),
-                pl.BlockSpec((bn, f_pad), xoff(0)),
-                pl.BlockSpec((bn, f_pad), xoff(1)),
-            ],
-            out_specs=out_specs if equivariant else out_specs[0],
-        )
-        return pl.pallas_call(
-            functools.partial(_fwd_kernel, equivariant),
-            out_shape=out_shape if equivariant else out_shape[0],
-            grid_spec=grid_spec,
-            interpret=interpret,
-        )(step_i, step_eb, acc_valid, is_first,
-          send_p, recv_p, geo_p, *packs, x_p, x_p, x_p)
-
-    return run, (f_pad, n_pad, n, f)
-
-
-# ---------------------------------------------------------------------------
-# backward pass R: weight grads + dgeo + sender-side dx (sender-sorted)
-# ---------------------------------------------------------------------------
-
-
-def _bwd_r_kernel(equivariant, si_ref, se_ref, av_ref, fi_ref, feb_ref,
-                  send_ref, recv_ref, geo_ref,
-                  w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref, *rest):
-    from jax.experimental import pallas as pl
-
-    if equivariant:
-        (wc0_ref, bc0_ref, wc1_ref,
-         xm1_ref, x0_ref, xp1_ref, ga0_ref, gp0_ref,
-         dw0s_ref, dw0r_ref, dw0g_ref, dw1_ref, db1_ref,
-         dwc0_ref, dbc0_ref, dwc1_ref, dgeo_ref, dx_ref) = rest
-    else:
-        (xm1_ref, x0_ref, xp1_ref, ga0_ref,
-         dw0s_ref, dw0r_ref, dw0g_ref, dw1_ref, db1_ref,
-         dgeo_ref, dx_ref) = rest
-
-    s = pl.program_id(0)
-    i = si_ref[s]
-
-    @pl.when(s == 0)
-    def _init_w():
-        dw0s_ref[:] = jnp.zeros_like(dw0s_ref)
-        dw0r_ref[:] = jnp.zeros_like(dw0r_ref)
-        dw0g_ref[:] = jnp.zeros_like(dw0g_ref)
-        dw1_ref[:] = jnp.zeros_like(dw1_ref)
-        db1_ref[:] = jnp.zeros_like(db1_ref)
-        if equivariant:
-            dwc0_ref[:] = jnp.zeros_like(dwc0_ref)
-            dbc0_ref[:] = jnp.zeros_like(dbc0_ref)
-            dwc1_ref[:] = jnp.zeros_like(dwc1_ref)
-
-    @pl.when(fi_ref[s] == 1)
-    def _init_x():
-        dx_ref[:] = jnp.zeros_like(dx_ref)
-
-    @pl.when(av_ref[s] == 1)
-    def _acc():
-        bn = dx_ref.shape[0]
-        dt = w1_ref.dtype
-        xs, onehot_s = _gather_local(send_ref, x0_ref, i, bn, dt)
-        xr, _ = _gather_window(
-            recv_ref, (xm1_ref, x0_ref, xp1_ref), i - 1, bn)
-        t0, f1, t1, m = _edge_chain(
-            xs, xr, geo_ref, w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref, dt)
-        # cotangent gathers at the SORTED side gate everything: an edge
-        # whose sender is out of this block gets dm = dps = 0, zeroing its
-        # whole grad chain this visit (its in-block visit supplies it)
-        dm = _dot(onehot_s, ga0_ref[:], ((1,), (0,)), dt)
-        if equivariant:
-            u0, v, c, diffm = _coord_chain(
-                m, geo_ref, wc0_ref, bc0_ref, wc1_ref, dt)
-            dps = _dot(onehot_s, gp0_ref[:], ((1,), (0,)), dt)  # [BE, GP]
-            ddiff = dps * c           # lanes >= 3 zero (cotangent padding)
-            dc = jnp.sum(dps * diffm, axis=1, keepdims=True)    # [BE, 1]
-            col = jax.lax.broadcasted_iota(jnp.int32, dps.shape, 1)
-            dcp = jnp.where(col == 0, dc * (1.0 - c * c), 0.0)
-            dwc1_ref[:] += _dot(v, dcp, ((0,), (0,)), dt)
-            dv = _dot(dcp, wc1_ref[:], ((1,), (1,)), dt)
-            du0 = dv * (u0 > 0)
-            dwc0_ref[:] += _dot(m, du0, ((0,), (0,)), dt)
-            dbc0_ref[:] += jnp.broadcast_to(
-                jnp.sum(du0, axis=0, keepdims=True) / dbc0_ref.shape[0],
-                dbc0_ref.shape)
-            dm = dm + _dot(du0, wc0_ref[:], ((1,), (1,)), dt)
-        dt1 = dm * (t1 > 0)
-        dw1_ref[:] += _dot(f1, dt1, ((0,), (0,)), dt)
-        db1_ref[:] += jnp.broadcast_to(
-            jnp.sum(dt1, axis=0, keepdims=True) / db1_ref.shape[0],
-            db1_ref.shape)
-        df1 = _dot(dt1, w1_ref[:], ((1,), (1,)), dt)
-        dt0 = df1 * (t0 > 0)
-        dw0s_ref[:] += _dot(xs, dt0, ((0,), (0,)), dt)
-        dw0r_ref[:] += _dot(xr, dt0, ((0,), (0,)), dt)
-        dw0g_ref[:] += _dot(geo_ref[:], dt0, ((0,), (0,)), dt)
-        # per-edge geometry grad stream: radial/edge_attr lanes from the
-        # MLP input grad (w0g's diff rows are zero), diff lanes from the
-        # translation product; the bias lane carries a per-edge db0 term
-        # the caller discards (db0 is read off dw0g's bias row instead)
-        dgeo_v = _dot(dt0, w0g_ref[:], ((1,), (1,)), dt)
-        if equivariant:
-            dgeo_v = dgeo_v + ddiff
-        dgeo_ref[:] = jnp.where(feb_ref[s] == 1, dgeo_v,
-                                dgeo_ref[:] + dgeo_v)
-        dxs = _dot(dt0, w0s_ref[:], ((1,), (1,)), dt)
-        dx_ref[:] += _dot(onehot_s, dxs, ((0,), (0,)), dt)
-
-    # a freshly-entered edge block that is NOT accumulated this step (the
-    # forced step of an empty node block) must still be initialized, or a
-    # boundary block's second visit would accumulate onto garbage
-    @pl.when((av_ref[s] == 0) & (feb_ref[s] == 1))
-    def _init_e():
-        dgeo_ref[:] = jnp.zeros_like(dgeo_ref)
-
-
-# ---------------------------------------------------------------------------
-# backward pass S: receiver-side dx (natural receiver-sorted order)
-# ---------------------------------------------------------------------------
-
-
-def _bwd_s_kernel(equivariant, si_ref, se_ref, av_ref, fi_ref,
-                  send_ref, recv_ref, geo_ref,
-                  w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref, *rest):
-    from jax.experimental import pallas as pl
-
-    if equivariant:
-        (wc0_ref, bc0_ref, wc1_ref,
-         xm1_ref, x0_ref, xp1_ref,
-         gam1_ref, ga0_ref, gap1_ref,
-         gpm1_ref, gp0_ref, gpp1_ref, dx_ref) = rest
-    else:
-        (xm1_ref, x0_ref, xp1_ref,
-         gam1_ref, ga0_ref, gap1_ref, dx_ref) = rest
-
-    s = pl.program_id(0)
-    i = si_ref[s]
-
-    @pl.when(fi_ref[s] == 1)
-    def _init():
-        dx_ref[:] = jnp.zeros_like(dx_ref)
-
-    @pl.when(av_ref[s] == 1)
-    def _acc():
-        bn = dx_ref.shape[0]
-        dt = w1_ref.dtype
-        # roles swapped: receivers are the sorted/output side, senders ride
-        # the window (cotangents included — both live at the sender)
-        xr, onehot_r = _gather_local(recv_ref, x0_ref, i, bn, dt)
-        xs, _ = _gather_window(
-            send_ref, (xm1_ref, x0_ref, xp1_ref), i - 1, bn)
-        t0, f1, t1, m = _edge_chain(
-            xs, xr, geo_ref, w0s_ref, w0r_ref, w0g_ref, w1_ref, b1_ref, dt)
-        dm, _ = _gather_window(
-            send_ref, (gam1_ref, ga0_ref, gap1_ref), i - 1, bn)
-        if equivariant:
-            u0, v, c, diffm = _coord_chain(
-                m, geo_ref, wc0_ref, bc0_ref, wc1_ref, dt)
-            dps, _ = _gather_window(
-                send_ref, (gpm1_ref, gp0_ref, gpp1_ref), i - 1, bn)
-            dc = jnp.sum(dps * diffm, axis=1, keepdims=True)
-            col = jax.lax.broadcasted_iota(jnp.int32, dps.shape, 1)
-            dcp = jnp.where(col == 0, dc * (1.0 - c * c), 0.0)
-            dv = _dot(dcp, wc1_ref[:], ((1,), (1,)), dt)
-            du0 = dv * (u0 > 0)
-            dm = dm + _dot(du0, wc0_ref[:], ((1,), (1,)), dt)
-        dt1 = dm * (t1 > 0)
-        df1 = _dot(dt1, w1_ref[:], ((1,), (1,)), dt)
-        dt0 = df1 * (t0 > 0)
-        dxr = _dot(dt0, w0r_ref[:], ((1,), (1,)), dt)
-        dx_ref[:] += _dot(onehot_r, dxr, ((0,), (0,)), dt)
-
-
-# ---------------------------------------------------------------------------
-# public op
-# ---------------------------------------------------------------------------
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def egcl_block(equivariant, x, geo, em, w0, b0, w1, b1, wc0, bc0, wc1,
                senders, receivers, sender_perm):
     """Fused EGCL interaction block.
@@ -438,217 +126,21 @@ def egcl_block(equivariant, x, geo, em, w0, b0, w1, b1, wc0, bc0, wc1,
     ``psum``'s first 3 lanes are the translation sums; the caller divides
     by the sender degree for the segment-mean and adds to positions.
 
-    ``geo`` is ``concat([diff_normed (3), radial (1), edge_attr (A)])``
-    per edge — ONE canonical geometry definition shared with the composed
-    path (models/egnn.py ``_edge_geometry``); its cotangent chains into
-    position grads outside.  Differentiable wrt x, geo and all weights.
-
-    Requires fused_mp's collate invariants plus the EGCL_* width limits
-    (callers gate).  ``em`` is the int32 edge-validity mask: em == 0
-    edges are schedule-skipped entirely and get EXACTLY ZERO for every
-    output and grad (masked edges must tail-sort in both orderings —
-    collate guarantees this)."""
-    out, _ = _egcl_fwd_res(equivariant, x, geo, em, w0, b0, w1, b1,
-                           wc0, bc0, wc1, senders, receivers, sender_perm)
-    return out
-
-
-def _egcl_fwd_res(equivariant, x, geo, em, w0, b0, w1, b1, wc0, bc0, wc1,
-                  senders, receivers, sender_perm):
-    interpret = jax.default_backend() != "tpu"
+    Differentiable wrt x, geo and all weights (geo's cotangent chains
+    into position grads outside).  Requires the builder's collate
+    invariants plus the EGCL_* width limits (callers gate).  ``em`` is
+    the int32 edge-validity mask: em == 0 edges are schedule-skipped
+    entirely and get EXACTLY ZERO for every output and grad (masked
+    edges must tail-sort in both orderings — collate guarantees this)."""
     n, f = x.shape
     h = w1.shape[0]
-    h_pad = _round_up(max(h, 1), 128)
-    f_pad = _round_up(max(f, 1), 128)
-    bf16 = x.dtype == jnp.bfloat16
-    run, _dims = _fwd_impl(equivariant, x, geo, em, senders, receivers,
-                           sender_perm, interpret)
-    packs = _pack_weights(equivariant, w0, b0, w1, b1, wc0, bc0, wc1,
-                          f, f_pad, h_pad, bf16)
-    out = run(packs, h_pad)
-    if equivariant:
-        agg_p, psum_p = out
-        agg = agg_p[:n, :h].astype(x.dtype)
-        return (agg, psum_p[:n]), h_pad
-    agg = out[:n, :h].astype(x.dtype)
-    return (agg, None), h_pad
-
-
-def _egcl_vjp_fwd(equivariant, x, geo, em, w0, b0, w1, b1, wc0, bc0, wc1,
-                  senders, receivers, sender_perm):
-    out, _ = _egcl_fwd_res(equivariant, x, geo, em, w0, b0, w1, b1,
-                           wc0, bc0, wc1, senders, receivers, sender_perm)
-    return out, (x, geo, em, w0, b0, w1, b1, wc0, bc0, wc1,
-                 senders, receivers, sender_perm)
-
-
-def _egcl_vjp_bwd(equivariant, res, ct):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    (x, geo, em, w0, b0, w1, b1, wc0, bc0, wc1,
-     senders, receivers, sender_perm) = res
-    ga, gp = ct
-    interpret = jax.default_backend() != "tpu"
-    n, f = x.shape
-    e, gd = geo.shape
-    h = w1.shape[0]
-    bf16 = x.dtype == jnp.bfloat16
     f_pad = _round_up(max(f, 1), 128)
     h_pad = _round_up(max(h, 1), 128)
-    bn, be = _NODE_BLOCK, _EDGE_BLOCK
-    n_pad = _round_up(n, bn)
-    e_pad = _round_up(max(e, 1), be)
-    n_blocks, n_eblocks = n_pad // bn, e_pad // be
-
-    x_p = jnp.zeros((n_pad, f_pad), x.dtype).at[:n, :f].set(x)
-    ga_p = jnp.zeros((n_pad, h_pad), x.dtype).at[:n, :h].set(
-        ga.astype(x.dtype))
-    gp_p = None
-    if equivariant:
-        gp_p = jnp.zeros((n_pad, _GP), x.dtype).at[:n].set(
-            gp.astype(x.dtype))
     packs = _pack_weights(equivariant, w0, b0, w1, b1, wc0, bc0, wc1,
-                          f, f_pad, h_pad, bf16)
-    eix, xoff, const, outx = _window_maps(n_blocks)
-
-    # ---- pass R: sender-sorted — weight grads, dgeo, sender-side dx ----
-    geo_s, send_s, recv_s = _pack_edges(
-        geo[sender_perm], em[sender_perm], senders[sender_perm],
-        receivers[sender_perm], e_pad, n_pad)
-    step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
-        send_s[:, 0], n_blocks, bn, be, n_eblocks)
-    prev_eb = jnp.concatenate(
-        [jnp.full(1, -1, jnp.int32), step_eb[:-1]])
-    first_eb = (step_eb != prev_eb).astype(jnp.int32)
-
-    w_specs = [pl.BlockSpec(p.shape, const) for p in packs]
-    in_specs_r = [
-        pl.BlockSpec((be, 1), eix),
-        pl.BlockSpec((be, 1), eix),
-        pl.BlockSpec((be, _GP), eix),
-        *w_specs,
-        pl.BlockSpec((bn, f_pad), xoff(-1)),
-        pl.BlockSpec((bn, f_pad), xoff(0)),
-        pl.BlockSpec((bn, f_pad), xoff(1)),
-        pl.BlockSpec((bn, h_pad), xoff(0)),
-    ]
-    out_specs_r = [
-        pl.BlockSpec((f_pad, h_pad), const),
-        pl.BlockSpec((f_pad, h_pad), const),
-        pl.BlockSpec((_GP, h_pad), const),
-        pl.BlockSpec((h_pad, h_pad), const),
-        pl.BlockSpec((8, h_pad), const),
-    ]
-    out_shape_r = [
-        jax.ShapeDtypeStruct((f_pad, h_pad), jnp.float32),
-        jax.ShapeDtypeStruct((f_pad, h_pad), jnp.float32),
-        jax.ShapeDtypeStruct((_GP, h_pad), jnp.float32),
-        jax.ShapeDtypeStruct((h_pad, h_pad), jnp.float32),
-        jax.ShapeDtypeStruct((8, h_pad), jnp.float32),
-    ]
-    ins_r = [send_s, recv_s, geo_s, *packs, x_p, x_p, x_p, ga_p]
+                          f, f_pad, h_pad, x.dtype == jnp.bfloat16)
+    outs = _egcl_op(bool(equivariant))(
+        x, geo, em, packs, senders, receivers, sender_perm)
+    agg = outs[0][:n, :h].astype(x.dtype)
     if equivariant:
-        in_specs_r.append(pl.BlockSpec((bn, _GP), xoff(0)))
-        ins_r.append(gp_p)
-        out_specs_r += [
-            pl.BlockSpec((h_pad, h_pad), const),
-            pl.BlockSpec((8, h_pad), const),
-            pl.BlockSpec((h_pad, _GP), const),
-        ]
-        out_shape_r += [
-            jax.ShapeDtypeStruct((h_pad, h_pad), jnp.float32),
-            jax.ShapeDtypeStruct((8, h_pad), jnp.float32),
-            jax.ShapeDtypeStruct((h_pad, _GP), jnp.float32),
-        ]
-    out_specs_r += [
-        pl.BlockSpec((be, _GP), eix),
-        pl.BlockSpec((bn, f_pad), outx),
-    ]
-    out_shape_r += [
-        jax.ShapeDtypeStruct((e_pad, _GP), jnp.float32),
-        jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
-    ]
-    grid_r = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(s_max,),
-        in_specs=in_specs_r,
-        out_specs=out_specs_r,
-    )
-    outs_r = pl.pallas_call(
-        functools.partial(_bwd_r_kernel, equivariant),
-        out_shape=out_shape_r,
-        grid_spec=grid_r,
-        interpret=interpret,
-    )(step_i, step_eb, acc_valid, is_first, first_eb, *ins_r)
-    if equivariant:
-        (dw0s_p, dw0r_p, dw0g_p, dw1_p, db1_p,
-         dwc0_p, dbc0_p, dwc1_p, dgeo_p, dxs_p) = outs_r
-    else:
-        dw0s_p, dw0r_p, dw0g_p, dw1_p, db1_p, dgeo_p, dxs_p = outs_r
-
-    # ---- pass S: natural receiver order — receiver-side dx ----
-    geo_n, send_n, recv_n = _pack_edges(
-        geo, em, senders, receivers, e_pad, n_pad)
-    step_i2, step_eb2, acc_valid2, is_first2, s_max2 = _dense_schedule(
-        recv_n[:, 0], n_blocks, bn, be, n_eblocks)
-    in_specs_s = [
-        pl.BlockSpec((be, 1), eix),
-        pl.BlockSpec((be, 1), eix),
-        pl.BlockSpec((be, _GP), eix),
-        *w_specs,
-        pl.BlockSpec((bn, f_pad), xoff(-1)),
-        pl.BlockSpec((bn, f_pad), xoff(0)),
-        pl.BlockSpec((bn, f_pad), xoff(1)),
-        pl.BlockSpec((bn, h_pad), xoff(-1)),
-        pl.BlockSpec((bn, h_pad), xoff(0)),
-        pl.BlockSpec((bn, h_pad), xoff(1)),
-    ]
-    ins_s = [send_n, recv_n, geo_n, *packs, x_p, x_p, x_p,
-             ga_p, ga_p, ga_p]
-    if equivariant:
-        in_specs_s += [pl.BlockSpec((bn, _GP), xoff(-1)),
-                       pl.BlockSpec((bn, _GP), xoff(0)),
-                       pl.BlockSpec((bn, _GP), xoff(1))]
-        ins_s += [gp_p, gp_p, gp_p]
-    grid_s = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(s_max2,),
-        in_specs=in_specs_s,
-        out_specs=pl.BlockSpec((bn, f_pad), outx),
-    )
-    dxr_p = pl.pallas_call(
-        functools.partial(_bwd_s_kernel, equivariant),
-        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
-        grid_spec=grid_s,
-        interpret=interpret,
-    )(step_i2, step_eb2, acc_valid2, is_first2, *ins_s)
-
-    dx = (dxs_p[:n, :f] + dxr_p[:n, :f]).astype(x.dtype)
-    # pass R ran in sender order: un-permute the per-edge stream, then
-    # `where`-select masked rows to zero — their blocks are never visited
-    # so the memory is uninitialized (a multiply would propagate NaN bits)
-    dgeo_nat = jnp.zeros((e, _GP), jnp.float32).at[sender_perm].set(
-        dgeo_p[:e])
-    valid = (em != 0)[:, None]
-    dgeo = jnp.where(valid, dgeo_nat[:, :gd], 0.0).astype(geo.dtype)
-    # reassemble the composed concat kernel's grad: sender rows, receiver
-    # rows, then the geometry rows (geo lanes 3..3+gd map to w0[2F:])
-    dw0 = jnp.concatenate([
-        dw0s_p[:f, :h], dw0r_p[:f, :h],
-        dw0g_p[3:3 + (w0.shape[0] - 2 * f), :h],
-    ], axis=0).astype(w0.dtype)
-    db0 = dw0g_p[_GP - 1, :h].astype(b0.dtype)
-    dw1 = dw1_p[:h, :h].astype(w1.dtype)
-    db1 = jnp.sum(db1_p[:, :h], axis=0).astype(b1.dtype)
-    if equivariant:
-        dwc0 = dwc0_p[:h, :h].astype(wc0.dtype)
-        dbc0 = jnp.sum(dbc0_p[:, :h], axis=0).astype(bc0.dtype)
-        dwc1 = dwc1_p[:h, 0:1].astype(wc1.dtype)
-    else:
-        dwc0 = dbc0 = dwc1 = None
-    return (dx, dgeo, None, dw0, db0, dw1, db1, dwc0, dbc0, dwc1,
-            None, None, None)
-
-
-egcl_block.defvjp(_egcl_vjp_fwd, _egcl_vjp_bwd)
+        return agg, outs[1][:n]
+    return agg, None

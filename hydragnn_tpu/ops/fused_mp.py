@@ -46,46 +46,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from hydragnn_tpu.ops.aggregate import _round_up, block_ranges
+from hydragnn_tpu.ops.aggregate import _round_up
+from hydragnn_tpu.ops.fused_block import (  # noqa: F401 — canonical home;
+    _NODE_BLOCK, _dense_schedule)           # re-exported for back-compat
 
 
-_NODE_BLOCK = 128   # rows of out per grid step (sender window = 3x this)
 _EDGE_BLOCK = 512   # edges per inner step
-
-
-def _dense_schedule(sorted_ids, n_blocks, bn, be, n_eblocks):
-    """DENSE grid schedule: one step per (node-block, populated edge-block)
-    pair, flattened CSR-style into scalar-prefetched step tables — instead
-    of a rectangular (n_blocks, k_max) grid whose bound-degree worst case
-    makes most steps no-op DMAs.  Empty blocks get exactly one step (their
-    out must still be zeroed).  Total steps are UNCONDITIONALLY bounded:
-    ranges tile the edge blocks with at most one shared boundary block per
-    adjacent pair, so sum(max(range_i, 1)) <= n_eblocks + 2*n_blocks
-    regardless of degree distribution — no degree contract, no dropped
-    edges, no overflow case at all.
-
-    Returns (step_i, step_eb, acc_valid, is_first, s_max)."""
-    start, end = block_ranges(sorted_ids, n_blocks, bn, be, n_eblocks)
-    counts = end - start
-    steps = jnp.maximum(counts, 1)
-    offsets = jnp.cumsum(steps)
-    total = offsets[-1]
-    s_max = n_eblocks + 2 * n_blocks
-    s_idx = jnp.arange(s_max, dtype=jnp.int32)
-    step_i = jnp.minimum(
-        jnp.searchsorted(offsets, s_idx, side="right"),
-        n_blocks - 1).astype(jnp.int32)
-    block_off = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), offsets[:-1].astype(jnp.int32)])
-    k = s_idx - block_off[step_i]
-    step_eb = jnp.clip(start[step_i] + k, 0, n_eblocks - 1).astype(jnp.int32)
-    # accumulate only on real (block, edge-block) pairs; the forced step of
-    # an empty block and the trailing padding steps (which clamp onto the
-    # last block and re-read its final edge block — a cached DMA) are no-ops
-    acc_valid = ((k < counts[step_i]) & (s_idx < total)).astype(jnp.int32)
-    prev_i = jnp.concatenate([jnp.full(1, -1, jnp.int32), step_i[:-1]])
-    is_first = (step_i != prev_i).astype(jnp.int32)
-    return step_i, step_eb, acc_valid, is_first, s_max
 
 
 def _fwd_kernel(has_w, window, si_ref, se_ref, av_ref, fi_ref, send_ref,

@@ -47,9 +47,10 @@ import jax
 import jax.numpy as jnp
 
 from hydragnn_tpu.ops.aggregate import _round_up
-from hydragnn_tpu.ops.fused_mp import _dense_schedule
+from hydragnn_tpu.ops.fused_block import (
+    _NODE_BLOCK, _dense_schedule)
+from hydragnn_tpu.ops.fused_block import _window_maps as _shared_window_maps
 
-_NODE_BLOCK = 128
 _EDGE_BLOCK = 512
 
 # Widest flat head-feature width (h*f) ONE fused kernel call compiles for:
@@ -86,22 +87,11 @@ _HP = 128  # head-axis lane padding (H <= 128)
 
 
 def _window_maps(n_blocks):
-    def eix(s, si, se, av, fi):
-        return (se[s], 0)
-
-    def xm1(s, si, se, av, fi):
-        return (jnp.maximum(si[s] - 1, 0), 0)
-
-    def x0(s, si, se, av, fi):
-        return (si[s], 0)
-
-    def xp1(s, si, se, av, fi):
-        return (jnp.minimum(si[s] + 1, n_blocks - 1), 0)
-
-    def const(s, si, se, av, fi):
-        return (0, 0)
-
-    return eix, xm1, x0, xp1, const
+    """GAT-shaped view of the builder's shared index maps: the ±1 window
+    unrolled to named slots (the attention kernels address window blocks
+    individually rather than as a spec-generated list)."""
+    eix, xoff, const, _outx = _shared_window_maps(n_blocks)
+    return eix, xoff(-1), xoff(0), xoff(1), const
 
 
 def _head_expander(hf: int, f: int):

@@ -63,7 +63,8 @@ import jax
 import jax.numpy as jnp
 
 from hydragnn_tpu.ops.aggregate import _round_up
-from hydragnn_tpu.ops.fused_mp import _dense_schedule, segment_sum_dense
+from hydragnn_tpu.ops.fused_block import _dense_schedule
+from hydragnn_tpu.ops.fused_mp import segment_sum_dense
 
 _NODE_BLOCK = 128
 _EDGE_BLOCK = 512

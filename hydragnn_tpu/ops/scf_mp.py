@@ -1,39 +1,21 @@
-"""Fused CFConv edge pipeline: filter-MLP -> gather -> multiply -> segment
-sum in ONE Pallas pass, forward AND backward — no [E, F] HBM streams.
+"""SchNet CFConv as a thin spec on the fused-block builder
+(:mod:`hydragnn_tpu.ops.fused_block`): filter-MLP -> gather -> multiply ->
+segment sum in ONE Pallas pass, forward AND backward — no [E, F] HBM
+streams.
 
-Motivation (round-4 MFU attribution, docs/PERF.md): at dense-SchNet width
-(hidden 1024, batch 2048) the step is 221 ms of which only 55.7 ms is the
-matmul-flops bound; the rest is [E, 1024]-scale edge streams — dominated
-by the continuous-filter chain ``filt = (W1 @ ssp(W0 @ rbf + b0) + b1) *
-cut`` materialized per edge, its gather/scatter traffic, and the backward
-re-reads.  This kernel keeps the whole per-edge pipeline in VMEM:
+  filt_e = (ssp(rbf_e @ W0 + b0) @ W1 + b1) * cm_e
+  out[n] = sum_{e: recv[e]=n} h[send_e] * filt_e
 
-  forward (receiver-sorted dense schedule, fused_mp invariants):
-    t0   = rbf_e @ W0аug             (bias folded into a constant lane)
-    filt = (ssp(t0) @ W1 + b1) * cm_e          cm = cutoff-envelope * mask
-    out[n] += h[send_e] * filt                 (one-hot window gather +
-                                                one-hot scatter on the MXU)
+The geometry stream carries the rbf lanes, the cutoff*mask ``cm`` on lane
+G, and the builder's constant bias lane last (b0 folded onto W0's
+matching row) — so dcm falls out of the geometry cotangent with no
+special-casing.  Motivation, measured numbers and the recompute-over-
+store trade are in docs/PERF.md; schedule/VJP mechanics live in the
+builder.
 
-  backward pass R (receiver-sorted): recomputes the chain per block and
-    accumulates dW0/db0/dW1/db1 IN-KERNEL (constant-mapped output blocks,
-    sequential TPU grid), emits per-edge drbf [E, G] and dcm [E] (compact
-    streams that XLA chains into distance/position grads outside), using
-    the flash-attention recompute-over-store trade.
-  backward pass S (sender-sorted, host-precomputed permutation): recomputes
-    filt and accumulates dh — the same fused kernel with edge roles
-    swapped (fused_mp _vjp_bwd's trick, plus the in-VMEM filter).
-
-FLOP cost: the filter matmul E*F^2 is evaluated 3x (fwd, R, S) plus the
-two weight-grad matmuls — vs 3x E*F^2 for the composed XLA path — i.e.
-~5/3 the MXU work in exchange for eliminating every [E, F] HBM stream;
-at width the step is bandwidth-bound so the trade wins (measured numbers
-in docs/PERF.md).
-
-Invariants: exactly fused_mp's (nondecreasing receivers, intra-graph
-edges, graphs within one node block, pre-sorted sender permutation).
-Width limits: G (num_gaussians) <= 127 (one pad lane carries the folded
-bias) and F <= SCF_F_LIMIT (VMEM: W1 and the dW1 accumulator are [F, F]
-f32 blocks).  Callers gate on both and fall back to the composed path.
+Width limits: G (num_gaussians) <= 127 and F <= SCF_F_LIMIT (VMEM: W1
+and its grad accumulator are [F, F] f32 blocks).  Callers gate on both
+and fall back to the composed path.
 """
 
 from __future__ import annotations
@@ -41,28 +23,27 @@ from __future__ import annotations
 import functools
 import os
 
-import jax
 import jax.numpy as jnp
 
 from hydragnn_tpu.ops.aggregate import _round_up
-from hydragnn_tpu.ops.fused_mp import _NODE_BLOCK, _dense_schedule
+from hydragnn_tpu.ops.fused_block import (
+    _GP, EdgeBlockSpec, _dot, _ssp, build_fused_edge_op)
 
 _EDGE_BLOCK = 128  # [BE, F] temporaries x ~8 live + [F, F] weights in VMEM
 SCF_F_LIMIT = 1024
-_GP = 128  # padded gaussian lane count (G + bias lane <= 128)
 
 
 def _edge_block_fwd(f_pad: int, bf16: bool) -> int:
     """Forward / pass-S edge block: 256 halves the schedule's per-step
     overhead and doubles the one-hot matmul's MXU utilization; it fits
     scoped VMEM except at wide-F f32 (W1 4 MB + f32 windows + [BE, F]
-    temporaries).  Pass R keeps 128 — its dW1 accumulator block doubles
+    temporaries).  Pass P keeps 128 — its dW1 accumulator block doubles
     the resident [F, F] footprint."""
     return 256 if (f_pad <= 512 or bf16) else _EDGE_BLOCK
 
 
 def _edge_block_r(f_pad: int, bf16: bool) -> int:
-    """Pass R edge block: 128 everywhere (the resident dW1 [F, F] f32
+    """Pass P edge block: 128 everywhere (the resident dW1 [F, F] f32
     accumulator plus ~8 [BE, F] f32 temporaries cap the block well below
     fwd/pass-S's).  HYDRAGNN_SCF_BE_R overrides for sweeps; the sweep
     result (if a larger block wins at some width) gets baked here with
@@ -74,310 +55,30 @@ def _edge_block_r(f_pad: int, bf16: bool) -> int:
     return _EDGE_BLOCK
 
 
-def _ssp(x):
-    """shifted softplus, f32, matching models/layers.shifted_softplus."""
-    return jax.nn.softplus(x) - 0.6931471805599453
+def _make_chain(g: int):
+    def chain(w_vals, geo, xp, xo, dt):
+        w0, w1, b1 = w_vals
+        t0 = _dot(geo, w0, ((1,), (0,)), dt)
+        f2 = _dot(_ssp(t0), w1, ((1,), (0,)), dt) + b1[0:1, :]
+        filt = f2 * geo[:, g:g + 1]        # cm rides geometry lane G
+        return (xo * filt,)
+    return chain
 
 
-def _window_maps(n_blocks):
-    # variadic: pass R prefetches five scalar tables, fwd/pass S four
-    def eix(s, si, se, *rest):
-        return (se[s], 0)
-
-    def xoff(off):
-        def f(s, si, se, *rest):
-            return (jnp.clip(si[s] + off, 0, n_blocks - 1), 0)
-        return f
-
-    def const(s, *rest):
-        return (0, 0)
-
-    def outx(s, si, se, *rest):
-        return (si[s], 0)
-
-    return eix, xoff, const, outx
+@functools.lru_cache(maxsize=None)
+def _scf_op(g: int):
+    return build_fused_edge_op(EdgeBlockSpec(
+        name="scf", primary="receiver", gather_primary=False,
+        gather_other=True, num_outputs=1, chain=_make_chain(g),
+        edge_block=_edge_block_fwd, edge_block_p=_edge_block_r))
 
 
-def _pack_edges(rbf, cm, em, senders, receivers, e_pad, n_pad):
-    """Pad edge arrays; bias lane (_GP - 1) of rbf is constant 1.0.
-
-    MASKED edges (em == 0) are parked on the out-of-range sentinel node
-    ``n_pad`` alongside the shape-padding slots, so the dense schedule
-    assigns their edge blocks to NO node block and never visits them —
-    at flagship collate shapes HALF the edge slots are batch padding, so
-    this halves the kernel's scheduled MXU work.  Exactness: an em == 0
-    edge must carry cm == 0 (callers derive em from the same mask that
-    zeroes cm), so it contributes nothing forward (filt = f2 * cm) and
-    all its grads except dcm are proportional to cm; the caller-facing
-    contract is that dcm is ZERO for masked edges (scf_edge_pipeline
-    docstring).  Requires masked edges to sort AFTER all real edges in
-    both edge orderings (collate parks them on node N-1, the maximum
-    id — the invariant holds for the receiver sort and the stable
-    sender argsort)."""
-    e, g = rbf.shape
-    rbf_p = jnp.zeros((e_pad, _GP), jnp.float32)
-    rbf_p = rbf_p.at[:e, :g].set(rbf.astype(jnp.float32))
-    rbf_p = rbf_p.at[:, _GP - 1].set(1.0)
-    cm_p = jnp.zeros((e_pad, 1), jnp.float32).at[:e, 0].set(
-        cm.astype(jnp.float32))
-    valid = em != 0
-    send_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
-        jnp.where(valid, senders, n_pad).astype(jnp.int32))
-    recv_p = jnp.full((e_pad, 1), n_pad, jnp.int32).at[:e, 0].set(
-        jnp.where(valid, receivers, n_pad).astype(jnp.int32))
-    return rbf_p, cm_p, send_p, recv_p
-
-
-def _pack_weights(w0, b0, w1, b1, f_pad):
-    """W0 padded to [_GP, F] with b0 on the bias lane's row; b1 as an
-    [8, F] constant block (row-broadcast in kernel)."""
-    g, f = w0.shape
-    w0_p = jnp.zeros((_GP, f_pad), jnp.float32)
-    w0_p = w0_p.at[:g, :f].set(w0.astype(jnp.float32))
-    w0_p = w0_p.at[_GP - 1, :f].set(b0.astype(jnp.float32))
-    w1_p = jnp.zeros((f_pad, f_pad), jnp.float32).at[:f, :f].set(
-        w1.astype(jnp.float32))
-    b1_p = jnp.zeros((8, f_pad), jnp.float32).at[:, :f].set(
-        jnp.broadcast_to(b1.astype(jnp.float32), (8, f)))
-    return w0_p, w1_p, b1_p
-
-
-def _dot(a, b, dims, dt):
-    """MXU dot with operands in the compute dtype and f32 accumulation.
-
-    Measured NEUTRAL on the v5e (173.9 -> 173.2 ms at dense h1024):
-    JAX's default matmul precision already runs f32 dots through the MXU
-    as bf16 passes, so explicit bf16 operands buy no rate — kept because
-    it makes the operand dtype explicit and lets the constant weight
-    blocks and one-hots live in bf16 VMEM (per-step-produced f32
-    operands still pay one downcast; accumulation and every
-    elementwise stays f32)."""
-    return jax.lax.dot_general(
-        a.astype(dt), b.astype(dt), (dims, ((), ())),
-        preferred_element_type=jnp.float32)
-
-
-def _filt_block(rbf_ref, cm_ref, w0_ref, w1_ref, b1_ref):
-    """One edge block's filter chain: returns (t0, s0, f2, filt) so the
-    backward reuses every intermediate instead of re-running the E*F^2
-    matmul (each extra evaluation is a full matmul unit per layer)."""
-    dt = w1_ref.dtype  # bf16 when the model computes in bf16
-    t0 = _dot(rbf_ref[:], w0_ref[:], ((1,), (0,)), dt)
-    s0 = _ssp(t0)
-    f2 = _dot(s0, w1_ref[:], ((1,), (0,)), dt) + b1_ref[0:1, :]
-    return t0, s0, f2, f2 * cm_ref[:].astype(jnp.float32)
-
-
-def _gather_window(idx_ref, win_refs, base_block, bn):
-    """One-hot window gather: rows of concat(win_refs) at idx (global node
-    ids), returning ([BE, F] gathered, [BE, W*BN] onehot)."""
-    be = idx_ref.shape[0]
-    w = len(win_refs)
-    base = base_block * bn
-    loc = idx_ref[:] - base
-    dt = win_refs[0].dtype  # 0/1 one-hot is exact in any dtype
-    onehot = (loc == jax.lax.broadcasted_iota(
-        jnp.int32, (be, w * bn), 1)).astype(dt)
-    cat = jnp.concatenate([r[:] for r in win_refs], axis=0)
-    out = _dot(onehot, cat, ((1,), (0,)), dt)
-    return out, onehot
-
-
-# ---------------------------------------------------------------------------
-# forward
-# ---------------------------------------------------------------------------
-
-
-def _fwd_kernel(si_ref, se_ref, av_ref, fi_ref,
-                send_ref, recv_ref, rbf_ref, cm_ref,
-                w0_ref, w1_ref, b1_ref,
-                hm1_ref, h0_ref, hp1_ref,
-                out_ref):
-    from jax.experimental import pallas as pl
-
-    s = pl.program_id(0)
-    i = si_ref[s]
-
-    @pl.when(fi_ref[s] == 1)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    @pl.when(av_ref[s] == 1)
-    def _acc():
-        bn = out_ref.shape[0]
-        be = send_ref.shape[0]
-        _t0, _s0, _f2, filt = _filt_block(
-            rbf_ref, cm_ref, w0_ref, w1_ref, b1_ref)
-        hs, _ = _gather_window(
-            send_ref, (hm1_ref, h0_ref, hp1_ref), i - 1, bn)
-        msg = hs * filt
-        rloc = recv_ref[:] - i * bn
-        onehot_r = (rloc == jax.lax.broadcasted_iota(
-            jnp.int32, (be, bn), 1)).astype(w1_ref.dtype)
-        out_ref[:] += _dot(onehot_r, msg, ((0,), (0,)), w1_ref.dtype)
-
-
-def _fwd_impl(h, rbf, cm, em, senders, receivers, interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    n, f = h.shape
-    e = rbf.shape[0]
-    bf16 = h.dtype == jnp.bfloat16
-    f_pad = _round_up(max(f, 1), 128)
-    bn, be = _NODE_BLOCK, _edge_block_fwd(f_pad, bf16)
-    n_pad = _round_up(n, bn)
-    e_pad = _round_up(max(e, 1), be)
-    n_blocks, n_eblocks = n_pad // bn, e_pad // be
-
-    # node windows ride HBM<->VMEM in the COMPUTE dtype (the kernels
-    # upcast per block); under bf16 this halves the dominant window traffic
-    h_p = jnp.zeros((n_pad, f_pad), h.dtype).at[:n, :f].set(h)
-    rbf_p, cm_p, send_p, recv_p = _pack_edges(
-        rbf, cm, em, senders, receivers, e_pad, n_pad)
-
-    step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
-        recv_p[:, 0], n_blocks, bn, be, n_eblocks)
-    eix, xoff, const, outx = _window_maps(n_blocks)
-
-    def run(w0_p, w1_p, b1_p):
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
-            grid=(s_max,),
-            in_specs=[
-                pl.BlockSpec((be, 1), eix),
-                pl.BlockSpec((be, 1), eix),
-                pl.BlockSpec((be, _GP), eix),
-                pl.BlockSpec((be, 1), eix),
-                pl.BlockSpec((_GP, f_pad), const),
-                pl.BlockSpec((f_pad, f_pad), const),
-                pl.BlockSpec((8, f_pad), const),
-                pl.BlockSpec((bn, f_pad), xoff(-1)),
-                pl.BlockSpec((bn, f_pad), xoff(0)),
-                pl.BlockSpec((bn, f_pad), xoff(1)),
-            ],
-            out_specs=pl.BlockSpec((bn, f_pad), outx),
-        )
-        return pl.pallas_call(
-            _fwd_kernel,
-            out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
-            grid_spec=grid_spec,
-            interpret=interpret,
-        )(step_i, step_eb, acc_valid, is_first,
-          send_p, recv_p, rbf_p, cm_p, w0_p, w1_p, b1_p,
-          h_p, h_p, h_p)
-
-    return run, (f_pad, n, f)
-
-
-# ---------------------------------------------------------------------------
-# backward pass R: weight grads + per-edge basis grads (receiver-sorted)
-# ---------------------------------------------------------------------------
-
-
-def _bwd_r_kernel(si_ref, se_ref, av_ref, fi_ref, feb_ref,
-                  send_ref, recv_ref, rbf_ref, cm_ref,
-                  w0_ref, w1_ref, b1_ref,
-                  hm1_ref, h0_ref, hp1_ref, ga0_ref,
-                  dw0_ref, dw1_ref, db1_ref, drbf_ref):
-    from jax.experimental import pallas as pl
-
-    s = pl.program_id(0)
-    i = si_ref[s]
-
-    @pl.when(s == 0)
-    def _init_w():
-        dw0_ref[:] = jnp.zeros_like(dw0_ref)
-        dw1_ref[:] = jnp.zeros_like(dw1_ref)
-        db1_ref[:] = jnp.zeros_like(db1_ref)
-
-    @pl.when(av_ref[s] == 1)
-    def _acc():
-        bn = ga0_ref.shape[0]
-        be = send_ref.shape[0]
-        t0, s0, f2, filt = _filt_block(
-            rbf_ref, cm_ref, w0_ref, w1_ref, b1_ref)
-        hs, _ = _gather_window(
-            send_ref, (hm1_ref, h0_ref, hp1_ref), i - 1, bn)
-        dt = w1_ref.dtype
-        rloc = recv_ref[:] - i * bn
-        onehot_r = (rloc == jax.lax.broadcasted_iota(
-            jnp.int32, (be, bn), 1)).astype(dt)
-        ge = _dot(onehot_r, ga0_ref[:], ((1,), (0,)), dt)
-        dfilt = ge * hs                       # [BE, F]
-        cm = cm_ref[:].astype(jnp.float32)
-        df2 = dfilt * cm
-        dcm_v = jnp.sum(dfilt * f2, axis=1, keepdims=True)  # [BE, 1]
-        dw1_ref[:] += _dot(s0, df2, ((0,), (0,)), dt)       # [F, F]
-        db1_ref[:] += jnp.broadcast_to(
-            jnp.sum(df2, axis=0, keepdims=True) / db1_ref.shape[0],
-            db1_ref.shape)
-        dt0 = _dot(df2, w1_ref[:], ((1,), (1,)), dt) * jax.nn.sigmoid(t0)
-        dw0_ref[:] += _dot(rbf_ref[:], dt0, ((0,), (0,)), dt)  # [GP, F]
-        drbf_v = _dot(dt0, w0_ref[:], ((1,), (1,)), dt)        # [BE, GP]
-        # the bias lane's drbf slot (wrt the constant 1.0) is unused by the
-        # caller — carry dcm there instead of a second per-edge output
-        lane = jax.lax.broadcasted_iota(jnp.int32, drbf_v.shape, 1)
-        drbf_v = jnp.where(lane == drbf_v.shape[1] - 1, dcm_v, drbf_v)
-        first_eb = feb_ref[s] == 1
-        drbf_ref[:] = jnp.where(first_eb, drbf_v, drbf_ref[:] + drbf_v)
-
-    # a freshly-entered edge block that is NOT accumulated this step (the
-    # forced step of an empty node block) must still be initialized, or a
-    # boundary block's second visit would accumulate onto garbage
-    @pl.when((av_ref[s] == 0) & (feb_ref[s] == 1))
-    def _init_e():
-        drbf_ref[:] = jnp.zeros_like(drbf_ref)
-
-
-# ---------------------------------------------------------------------------
-# backward pass S: dh (sender-sorted roles-swapped fused kernel)
-# ---------------------------------------------------------------------------
-
-
-def _bwd_s_kernel(si_ref, se_ref, av_ref, fi_ref,
-                  send_ref, recv_ref, rbf_ref, cm_ref,
-                  w0_ref, w1_ref, b1_ref,
-                  gm1_ref, g0_ref, gp1_ref,
-                  dh_ref):
-    from jax.experimental import pallas as pl
-
-    s = pl.program_id(0)
-    i = si_ref[s]
-
-    @pl.when(fi_ref[s] == 1)
-    def _init():
-        dh_ref[:] = jnp.zeros_like(dh_ref)
-
-    @pl.when(av_ref[s] == 1)
-    def _acc():
-        bn = dh_ref.shape[0]
-        be = send_ref.shape[0]
-        _t0, _s0, _f2, filt = _filt_block(
-            rbf_ref, cm_ref, w0_ref, w1_ref, b1_ref)
-        # roles swapped: send_ref carries the SORTED senders (output rows),
-        # recv_ref the corresponding receivers (gather side)
-        gr, _ = _gather_window(
-            recv_ref, (gm1_ref, g0_ref, gp1_ref), i - 1, bn)
-        msg = gr * filt
-        sloc = send_ref[:] - i * bn
-        onehot_s = (sloc == jax.lax.broadcasted_iota(
-            jnp.int32, (be, bn), 1)).astype(w1_ref.dtype)
-        dh_ref[:] += _dot(onehot_s, msg, ((0,), (0,)), w1_ref.dtype)
-
-
-# ---------------------------------------------------------------------------
-# public op
-# ---------------------------------------------------------------------------
-
-
-@jax.custom_vjp
 def scf_edge_pipeline(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
                       sender_perm):
     """``out[n] = sum_{e: recv[e]=n} h[send[e]] * filt_e`` with
     ``filt_e = (ssp(rbf_e @ w0 + b0) @ w1 + b1) * cm_e`` computed in-VMEM.
 
-    Differentiable wrt h, rbf, cm, w0, b0, w1, b1.  Requires fused_mp's
+    Differentiable wrt h, rbf, cm, w0, b0, w1, b1.  Requires the builder's
     collate invariants plus G <= 127 and F <= SCF_F_LIMIT (callers gate).
     ``cm`` must be zero on padding edges (it carries the edge mask).
     ``em`` is the int32 edge-validity mask (1 = real): em == 0 edges are
@@ -388,159 +89,24 @@ def scf_edge_pipeline(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
     dcm, whose true value at cm == 0 need not be zero; callers must not
     consume dcm on masked edges (SchNet's hard-zeroed cutoff `where`
     satisfies this)."""
-    out, _ = _scf_fwd_res(h, rbf, cm, em, w0, b0, w1, b1, senders,
-                          receivers)
-    return out
-
-
-def _scf_fwd_res(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers):
-    interpret = jax.default_backend() != "tpu"
-    run, (f_pad, n, f) = _fwd_impl(h, rbf, cm, em, senders, receivers,
-                                   interpret)
-    w0_p, w1_p, b1_p = _pack_weights(w0, b0, w1, b1, f_pad)
-    if h.dtype == jnp.bfloat16:
-        # halves the constant weight blocks' VMEM and skips the per-step
-        # in-kernel downcast
-        w0_p = w0_p.astype(jnp.bfloat16)
-        w1_p = w1_p.astype(jnp.bfloat16)
-    out = run(w0_p, w1_p, b1_p)
-    return out[:n, :f].astype(h.dtype), f_pad
-
-
-def _scf_vjp_fwd(h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
-                 sender_perm):
-    out, _ = _scf_fwd_res(h, rbf, cm, em, w0, b0, w1, b1, senders,
-                          receivers)
-    return out, (h, rbf, cm, em, w0, b0, w1, b1, senders, receivers,
-                 sender_perm)
-
-
-def _scf_vjp_bwd(res, ga):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    h, rbf, cm, em, w0, b0, w1, b1, senders, receivers, sender_perm = res
-    interpret = jax.default_backend() != "tpu"
     n, f = h.shape
     e, g = rbf.shape
-    bf16 = h.dtype == jnp.bfloat16
     f_pad = _round_up(max(f, 1), 128)
-    # pass R keeps a narrow edge block (its dW1 accumulator doubles the
-    # resident [F, F] VMEM footprint); pass S uses the forward's
-    bn, be = _NODE_BLOCK, _edge_block_r(f_pad, bf16)
-    be_s = _edge_block_fwd(f_pad, bf16)
-    n_pad = _round_up(n, bn)
-    e_pad = _round_up(max(e, 1), be)
-    n_blocks, n_eblocks = n_pad // bn, e_pad // be
-
-    h_p = jnp.zeros((n_pad, f_pad), h.dtype).at[:n, :f].set(h)
-    ga_p = jnp.zeros((n_pad, f_pad), h.dtype).at[:n, :f].set(
-        ga.astype(h.dtype))
-    w0_p, w1_p, b1_p = _pack_weights(w0, b0, w1, b1, f_pad)
-    if bf16:
+    gpw = _round_up(g + 2, _GP)  # rbf lanes + cm lane + builder bias lane
+    geo = jnp.concatenate(
+        [rbf, cm[:, None].astype(rbf.dtype)], axis=1)
+    w0_p = jnp.zeros((gpw, f_pad), jnp.float32)
+    w0_p = w0_p.at[:g, :f].set(w0.astype(jnp.float32))
+    w0_p = w0_p.at[gpw - 1, :f].set(b0.astype(jnp.float32))
+    w1_p = jnp.zeros((f_pad, f_pad), jnp.float32).at[:f, :f].set(
+        w1.astype(jnp.float32))
+    b1_p = jnp.zeros((8, f_pad), jnp.float32).at[:, :f].set(
+        jnp.broadcast_to(b1.astype(jnp.float32), (8, f)))
+    if h.dtype == jnp.bfloat16:
+        # halves the constant weight blocks' VMEM; bias stays f32 (added
+        # after the f32-accumulating dots)
         w0_p = w0_p.astype(jnp.bfloat16)
         w1_p = w1_p.astype(jnp.bfloat16)
-    rbf_p, cm_p, send_p, recv_p = _pack_edges(
-        rbf, cm, em, senders, receivers, e_pad, n_pad)
-
-    eix, xoff, const, outx = _window_maps(n_blocks)
-
-    # ---- pass R: receiver-sorted (natural order) ----
-    step_i, step_eb, acc_valid, is_first, s_max = _dense_schedule(
-        recv_p[:, 0], n_blocks, bn, be, n_eblocks)
-    prev_eb = jnp.concatenate(
-        [jnp.full(1, -1, jnp.int32), step_eb[:-1]])
-    first_eb = (step_eb != prev_eb).astype(jnp.int32)
-
-    grid_r = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(s_max,),
-        in_specs=[
-            pl.BlockSpec((be, 1), eix),
-            pl.BlockSpec((be, 1), eix),
-            pl.BlockSpec((be, _GP), eix),
-            pl.BlockSpec((be, 1), eix),
-            pl.BlockSpec((_GP, f_pad), const),
-            pl.BlockSpec((f_pad, f_pad), const),
-            pl.BlockSpec((8, f_pad), const),
-            pl.BlockSpec((bn, f_pad), xoff(-1)),
-            pl.BlockSpec((bn, f_pad), xoff(0)),
-            pl.BlockSpec((bn, f_pad), xoff(1)),
-            pl.BlockSpec((bn, f_pad), xoff(0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((_GP, f_pad), const),
-            pl.BlockSpec((f_pad, f_pad), const),
-            pl.BlockSpec((8, f_pad), const),
-            pl.BlockSpec((be, _GP), eix),
-        ],
-    )
-    dw0_p, dw1_p, db1_p, drbf_p = pl.pallas_call(
-        _bwd_r_kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct((_GP, f_pad), jnp.float32),
-            jax.ShapeDtypeStruct((f_pad, f_pad), jnp.float32),
-            jax.ShapeDtypeStruct((8, f_pad), jnp.float32),
-            jax.ShapeDtypeStruct((e_pad, _GP), jnp.float32),
-        ],
-        grid_spec=grid_r,
-        interpret=interpret,
-    )(step_i, step_eb, acc_valid, is_first, first_eb,
-      send_p, recv_p, rbf_p, cm_p, w0_p, w1_p, b1_p,
-      h_p, h_p, h_p, ga_p)
-
-    # ---- pass S: sender-sorted (dh) ----
-    if sender_perm is None:
-        sender_perm = jnp.argsort(senders, stable=True)
-    e_pad_s = _round_up(max(e, 1), be_s)
-    n_eblocks_s = e_pad_s // be_s
-    rbf_s, cm_s, send_s, recv_s = _pack_edges(
-        rbf[sender_perm], cm[sender_perm], em[sender_perm],
-        senders[sender_perm], receivers[sender_perm], e_pad_s, n_pad)
-    step_i2, step_eb2, acc_valid2, is_first2, s_max2 = _dense_schedule(
-        send_s[:, 0], n_blocks, bn, be_s, n_eblocks_s)
-    grid_s = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(s_max2,),
-        in_specs=[
-            pl.BlockSpec((be_s, 1), eix),
-            pl.BlockSpec((be_s, 1), eix),
-            pl.BlockSpec((be_s, _GP), eix),
-            pl.BlockSpec((be_s, 1), eix),
-            pl.BlockSpec((_GP, f_pad), const),
-            pl.BlockSpec((f_pad, f_pad), const),
-            pl.BlockSpec((8, f_pad), const),
-            pl.BlockSpec((bn, f_pad), xoff(-1)),
-            pl.BlockSpec((bn, f_pad), xoff(0)),
-            pl.BlockSpec((bn, f_pad), xoff(1)),
-        ],
-        out_specs=pl.BlockSpec((bn, f_pad), outx),
-    )
-    dh_p = pl.pallas_call(
-        _bwd_s_kernel,
-        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
-        grid_spec=grid_s,
-        interpret=interpret,
-    )(step_i2, step_eb2, acc_valid2, is_first2,
-      send_s, recv_s, rbf_s, cm_s, w0_p, w1_p, b1_p,
-      ga_p, ga_p, ga_p)
-
-    dh = dh_p[:n, :f].astype(h.dtype)
-    # masked-edge blocks are never visited (schedule skip — _pack_edges),
-    # so their drbf output rows are uninitialized memory: select them to
-    # zero with `where` — a multiply would propagate NaN/Inf garbage bits
-    # (0 * NaN = NaN).  Their true grads are 0 except dcm, which the
-    # contract defines as 0 too.
-    valid = (em != 0)[:, None]
-    drbf = jnp.where(valid, drbf_p[:e, :g], 0.0).astype(rbf.dtype)
-    dcm = jnp.where(valid[:, 0], drbf_p[:e, _GP - 1], 0.0).astype(cm.dtype)
-    # weight grads: slice the pads; b0 rides W0's bias lane; db1's rows
-    # were pre-divided by the row count so their sum is the true grad
-    dw0 = dw0_p[:g, :f].astype(w0.dtype)
-    db0 = dw0_p[_GP - 1, :f].astype(b0.dtype)
-    dw1 = dw1_p[:f, :f].astype(w1.dtype)
-    db1 = jnp.sum(db1_p[:, :f], axis=0).astype(b1.dtype)
-    return (dh, drbf, dcm, None, dw0, db0, dw1, db1, None, None, None)
-
-
-scf_edge_pipeline.defvjp(_scf_vjp_fwd, _scf_vjp_bwd)
+    (out,) = _scf_op(int(g))(
+        h, geo, em, (w0_p, w1_p, b1_p), senders, receivers, sender_perm)
+    return out[:n, :f].astype(h.dtype)

@@ -13,9 +13,12 @@ POLICIES = ("f32", "bf16", "int8")
 
 # training-time dtype policies (Training.train_dtype_policy +
 # HYDRAGNN_TRAIN_DTYPE): narrower than the inference set — int8 weights
-# cannot carry an optimizer update, so training is f32 or
-# bf16-with-f32-accumulation only (docs/PERF.md PR-15)
-TRAIN_POLICIES = ("f32", "bf16")
+# cannot carry an optimizer update, so training is f32, bf16-with-f32-
+# accumulation, or the int8_edge pilot (docs/PERF.md PR-15): master
+# params stay f32 and only the edge-MLP kernels are fake-quantized
+# (int8 round-trip with a straight-through grad) in the forward —
+# the same step-0 golden replay gates acceptance
+TRAIN_POLICIES = ("f32", "bf16", "int8_edge")
 
 
 def check_policy(policy: str) -> str:
@@ -39,6 +42,7 @@ _EXPORTS = (
     "cast_floats",
     "dequantize",
     "dequantize_tree",
+    "fake_quant_edge_params",
     "policy_summary",
     "quantize_int8",
     "tree_nbytes",

@@ -187,6 +187,45 @@ def wrap_eval_step(eval_step, policy: str):
     return wrapped
 
 
+# the edge-MLP module names the fused-block builder specs consume
+# (ops/fused_block.py): SchNet's filter MLP, EGNN's edge MLP, CGCNN's
+# gate pair, DimeNet's sbf embedding.  The int8_edge training pilot
+# fake-quantizes exactly these kernels — the layers whose weights live
+# as constant VMEM blocks in the fused kernels, i.e. where a future
+# true-int8 MXU path would land first.
+EDGE_MLP_MODULES = frozenset((
+    "filter_0", "filter_1",
+    "edge_mlp_0", "edge_mlp_1",
+    "lin_f", "lin_s",
+    "lin_sbf1", "lin_sbf2",
+))
+
+
+def fake_quant_edge_params(params):
+    """``Training.train_dtype_policy="int8_edge"`` transform: every
+    edge-MLP *kernel* (see :data:`EDGE_MLP_MODULES`) goes through an
+    int8 round-trip (symmetric per-channel quantize -> dequantize back
+    to its dtype) with a straight-through gradient, everything else
+    passes through untouched.  Trace-time: the master params the
+    optimizer updates stay f32 — this fakes the int8 numerics the
+    fused edge kernels would see, so the step-0 golden replay can
+    measure the drift before any kernel commits to int8 accumulate."""
+    import jax
+
+    def _fq(path, x):
+        names = {getattr(p, "key", None) for p in path}
+        if "kernel" not in names or not (names & EDGE_MLP_MODULES) \
+                or not _quantizable(x):
+            return x
+        q = dequantize(quantize_int8(x), getattr(x, "dtype", None))
+        # straight-through estimator: forward sees the rounded weights,
+        # backward passes the cotangent to the master weights unchanged
+        # (round() has zero gradient a.e., which would stall training)
+        return x + jax.lax.stop_gradient(q - x)
+
+    return jax.tree_util.tree_map_with_path(_fq, params)
+
+
 def tree_nbytes(tree) -> int:
     """Resident bytes of every leaf in a pytree (QTensor counts q +
     scale) — the number behind the HBM-halving claim, reported by

@@ -92,11 +92,15 @@ _fallbacks: list = []
 def record_fallback(op: str, **fields) -> None:
     """Record one fell-off-the-fast-path event from trace-time code that
     has no MetricsLogger in reach (model dispatch sites run inside the
-    first jit trace).  Deduped on (op, reason); a consumer with a logger
-    drains via :func:`pop_fallbacks` and emits the health event."""
+    first jit trace).  Deduped on (op, arch, reason) — every arch's
+    dispatch gate shares op="fused" (ops/fused_block.note_fallback), so
+    the arch field must participate or one arch's event would swallow
+    another's; a consumer with a logger drains via
+    :func:`pop_fallbacks` and emits the health event."""
     with _lock:
-        key = (op, fields.get("reason"))
-        if any((f[0], f[1].get("reason")) == key for f in _fallbacks):
+        key = (op, fields.get("arch"), fields.get("reason"))
+        if any((f[0], f[1].get("arch"), f[1].get("reason")) == key
+               for f in _fallbacks):
             return
         _fallbacks.append((op, dict(fields)))
 

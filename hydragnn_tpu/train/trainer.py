@@ -124,6 +124,13 @@ def _loss_and_metrics(
     pre-policy build."""
     compute_dtype = (jnp.bfloat16 if (getattr(cfg, "compute_dtype", "float32")
                      == "bfloat16" or dtype_policy == "bf16") else None)
+    if dtype_policy == "int8_edge":
+        # int8 edge-MLP pilot: fake-quantize the edge-MLP kernels (int8
+        # round-trip, straight-through grad) at this one boundary — the
+        # rest of the step stays f32, master params/optimizer untouched
+        from hydragnn_tpu.quant import fake_quant_edge_params
+
+        params = fake_quant_edge_params(params)
 
     def _cast(tree, dtype):
         return jax.tree.map(
@@ -725,12 +732,14 @@ def _epoch_metrics(acc):
 _TRAIN_DTYPE_TOL = 0.05
 
 
-def _train_dtype_gate(model, cfg, state, opt_spec, output_names, batch):
-    """Golden-replay probe for ``Training.train_dtype_policy="bf16"``:
-    run ONE f32 train step and ONE bf16-policy train step on the same
-    (state, first batch) — un-donated local jits, so neither touches the
-    run's real state — and compare loss + grad-norm relative drift
-    against :data:`_TRAIN_DTYPE_TOL`.  Returns (ok, drift_stats).
+def _train_dtype_gate(model, cfg, state, opt_spec, output_names, batch,
+                      policy="bf16"):
+    """Golden-replay probe for a non-f32 ``Training.train_dtype_policy``
+    ("bf16" or "int8_edge"): run ONE f32 train step and ONE policy train
+    step on the same (state, first batch) — un-donated local jits, so
+    neither touches the run's real state — and compare loss + grad-norm
+    relative drift against :data:`_TRAIN_DTYPE_TOL`.  Returns
+    (ok, drift_stats).
 
     Mirrors serving's golden-batch replay (quant/policy.py): the operator
     asked for a numerics change, so the change must prove itself against
@@ -741,7 +750,7 @@ def _train_dtype_gate(model, cfg, state, opt_spec, output_names, batch):
                                        telemetry_metrics=True))
     bf_step = jax.jit(make_train_step(model, cfg, opt_spec, output_names,
                                       telemetry_metrics=True,
-                                      dtype_policy="bf16"))
+                                      dtype_policy=policy))
     _, m32 = jax.device_get(f32_step(state, batch))
     _, mbf = jax.device_get(bf_step(state, batch))
     ok, stats = True, {}
@@ -920,9 +929,10 @@ def train_validate_test(
     if env_td:
         train_dtype = check_train_policy(env_td)
     train_dtype_requested = train_dtype
-    if train_dtype == "bf16":
+    if train_dtype != "f32":
         import warnings
 
+        req = train_dtype_requested
         resumed_td = (resume_meta or {}).get("pipeline", {}).get(
             "train_dtype")
         if resumed_td is not None:
@@ -933,33 +943,34 @@ def train_validate_test(
             train_dtype = check_train_policy(str(resumed_td))
         elif graph_shard != "off":
             warnings.warn(
-                "train_dtype_policy=bf16 requested with graph sharding — "
-                "the halo/gspmd steps are not policy-threaded; training "
+                f"train_dtype_policy={req} requested with graph sharding "
+                "— the halo/gspmd steps are not policy-threaded; training "
                 "f32", stacklevel=2)
-            telemetry.health("train_dtype_reject", requested="bf16",
+            telemetry.health("train_dtype_reject", requested=req,
                              reason="graph_shard")
             train_dtype = "f32"
         else:
             probe = next(iter(train_loader), None)
             if probe is None:
                 warnings.warn(
-                    "train_dtype_policy=bf16 requested but the train "
+                    f"train_dtype_policy={req} requested but the train "
                     "loader is empty — the acceptance probe cannot run; "
                     "training f32", stacklevel=2)
-                telemetry.health("train_dtype_reject", requested="bf16",
+                telemetry.health("train_dtype_reject", requested=req,
                                  reason="empty_loader")
                 train_dtype = "f32"
             else:
                 td_ok, td_drift = _train_dtype_gate(
-                    model, cfg, state, opt_spec, output_names, probe)
+                    model, cfg, state, opt_spec, output_names, probe,
+                    policy=req)
                 if not td_ok:
                     warnings.warn(
-                        "train_dtype_policy=bf16 REJECTED by the step-0 "
+                        f"train_dtype_policy={req} REJECTED by the step-0 "
                         f"golden replay (relative drift {td_drift} vs "
                         f"bound {_TRAIN_DTYPE_TOL}) — training f32",
                         stacklevel=2)
                     telemetry.health(
-                        "train_dtype_reject", requested="bf16",
+                        "train_dtype_reject", requested=req,
                         reason="golden_gate",
                         drift_loss=float(td_drift.get("loss", 0.0)),
                         drift_grad_norm=float(
@@ -1507,8 +1518,15 @@ def train_validate_test(
                 # events an operator (and teleview) will actually see
                 from hydragnn_tpu.telemetry import pipeline as _pipe
 
-                for fb in _pipe.pop_fallbacks("egcl"):
-                    telemetry.health("egcl_fallback", **fb)
+                for fb in _pipe.pop_fallbacks("fused"):
+                    telemetry.health("fused_fallback", **fb)
+                    if fb.get("arch") == "EGNN":
+                        # per-arch kind kept as an alias for one release
+                        # (dashboards keyed on it migrate to
+                        # fused_fallback + arch field)
+                        legacy = {k: v for k, v in fb.items()
+                                  if k != "arch"}
+                        telemetry.health("egcl_fallback", **legacy)
             if preempt is not None and preempt.stop_requested:
                 # preemption agreed mid-epoch: bundle the exact position
                 # (epoch + items consumed) and stop; `continue` resumes here

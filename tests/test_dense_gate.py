@@ -21,13 +21,16 @@ def _good_evidence():
         "dense": {
             "SchNet-h256-bf16-b512": {"mfu_pct": 8.5,
                                       "graphs_per_sec": 24000.0},
-            "SchNet-h1024-bf16-b2048-tight": {"mfu_pct": 19.0,
+            "SchNet-h512-bf16-b512": {"mfu_pct": 12.0,
+                                      "graphs_per_sec": 16000.0},
+            "SchNet-h1024-bf16-b2048-tight": {"mfu_pct": 24.0,
                                               "graphs_per_sec": 9000.0},
         },
         "archs": {
             "SchNet": {"graphs_per_sec": 60000, "aggr_backend": "fused"},
             "GAT": {"graphs_per_sec": 50000, "aggr_backend": "fused"},
             "EGNN": {"graphs_per_sec": 40000, "aggr_backend": "fused"},
+            "CGCNN": {"graphs_per_sec": 55000, "aggr_backend": "fused"},
             # non-mainline stacks ride the generic kernels — a scatter
             # tally there is NOT a gate failure
             "SAGE": {"graphs_per_sec": 70000, "aggr_backend": "scatter"},
@@ -39,16 +42,37 @@ def test_gate_passes_good_evidence():
     ok, failures, table = bench.dense_gate(_good_evidence())
     assert ok and not failures
     assert {r["name"] for r in table if r["kind"] == "arch"} == {
-        "SchNet", "GAT", "EGNN", "SAGE"}
+        "SchNet", "GAT", "EGNN", "CGCNN", "SAGE"}
 
 
 def test_gate_fails_low_mfu_rung():
     ev = _good_evidence()
     ev["dense"]["SchNet-h256-bf16-b512"]["mfu_pct"] = (
-        bench.DENSE_MFU_FLOOR - 0.1)
+        bench._rung_floor("SchNet-h256-bf16-b512") - 0.1)
     ok, failures, _ = bench.dense_gate(ev)
     assert not ok
     assert any("MFU" in f and "h256" in f for f in failures)
+
+
+def test_gate_per_rung_floors_raised_above_blanket():
+    # the wider rungs are held to floors ABOVE the blanket 5%: an h1024
+    # rung at 19% MFU (fine under the old blanket bound) now FAILS
+    assert bench._rung_floor("SchNet-h1024-bf16-b2048-tight") > \
+        bench.DENSE_MFU_FLOOR
+    assert bench._rung_floor("SchNet-h512-bf16-b512") > \
+        bench.DENSE_MFU_FLOOR
+    # unknown rungs fall back to the blanket floor
+    assert bench._rung_floor("GAT-h64-bf16-b512") == bench.DENSE_MFU_FLOOR
+    ev = _good_evidence()
+    ev["dense"]["SchNet-h1024-bf16-b2048-tight"]["mfu_pct"] = 19.0
+    ok, failures, table = bench.dense_gate(ev)
+    assert not ok
+    assert any("h1024" in f and "20" in f for f in failures)
+    floors = {r["name"]: r["mfu_floor"] for r in table
+              if r["kind"] == "dense"}
+    assert floors["SchNet-h1024-bf16-b2048-tight"] == 20.0
+    assert floors["SchNet-h512-bf16-b512"] == 10.0
+    assert floors["SchNet-h256-bf16-b512"] == 5.0
 
 
 def test_gate_fails_mainline_arch_off_fused_path():
@@ -77,8 +101,11 @@ def test_dense_cli_exit_codes(tmp_path):
          "--evidence", str(good)],
         capture_output=True, text=True, cwd=_ROOT)
     assert r.returncode == 0, r.stderr
-    assert json.loads(r.stdout.strip().splitlines()[-1])[
-        "dense_gate"] == "PASS"
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["dense_gate"] == "PASS"
+    # the BENCH JSON records which archs ran the fused path
+    assert set(line["fused_archs"]) == {"SchNet", "GAT", "EGNN", "CGCNN"}
+    assert line["mfu_floors"] == bench.DENSE_MFU_FLOORS
 
     ev = _good_evidence()
     ev["archs"]["SchNet"]["aggr_backend"] = "scatter"

@@ -185,6 +185,103 @@ def test_config_validates_train_dtype_policy():
 
 
 # ---------------------------------------------------------------------------
+# int8_edge pilot: fake-quantized edge-MLP kernels behind the same gate
+# ---------------------------------------------------------------------------
+
+
+def test_int8_edge_fake_quant_scope_and_ste():
+    """fake_quant_edge_params touches exactly the edge-MLP kernels:
+    int8 round-trip on matching 2-D kernels, identity on biases, on
+    non-edge modules and on sub-quantizable leaves — with a
+    straight-through gradient everywhere."""
+    from hydragnn_tpu.quant import fake_quant_edge_params
+
+    rng = np.random.RandomState(0)
+    params = {"params": {
+        "filter_0": {"kernel": jnp.asarray(rng.randn(8, 16), jnp.float32),
+                     "bias": jnp.zeros((16,), jnp.float32)},
+        "lin_f": {"kernel": jnp.asarray(rng.randn(8, 4), jnp.float32)},
+        "lin1": {"kernel": jnp.asarray(rng.randn(8, 4), jnp.float32)},
+        # single-row kernel: below the quantizable floor, must pass through
+        "edge_mlp_0": {"kernel": jnp.asarray(rng.randn(1, 4), jnp.float32)},
+    }}
+    fq = fake_quant_edge_params(params)
+    p, q = params["params"], fq["params"]
+    assert not np.array_equal(p["filter_0"]["kernel"], q["filter_0"]["kernel"])
+    assert np.allclose(p["filter_0"]["kernel"], q["filter_0"]["kernel"],
+                       atol=0.05)  # int8 round-trip stays near the master
+    assert not np.array_equal(p["lin_f"]["kernel"], q["lin_f"]["kernel"])
+    assert np.array_equal(p["filter_0"]["bias"], q["filter_0"]["bias"])
+    assert np.array_equal(p["lin1"]["kernel"], q["lin1"]["kernel"])
+    assert np.array_equal(p["edge_mlp_0"]["kernel"], q["edge_mlp_0"]["kernel"])
+
+    # straight-through estimator: d(sum fq(x))/dx == 1 for every leaf
+    grads = jax.grad(lambda t: sum(
+        jnp.sum(l) for l in jax.tree.leaves(fake_quant_edge_params(t))
+    ))(params)
+    for leaf in jax.tree.leaves(grads):
+        assert np.array_equal(leaf, np.ones_like(leaf))
+
+
+def test_int8_edge_step_quantizes_schnet_filters():
+    """On a model that HAS edge MLPs (SchNet's filter network) the
+    int8_edge step produces real-but-small drift from f32, while the
+    master params the optimizer updates stay f32."""
+    from test_mixed_precision import _setup
+
+    cfg, batch = _setup()
+    model = create_model(cfg)
+    opt = select_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    s0 = create_train_state(model, batch, opt)
+
+    sf, mf = jax.jit(make_train_step(model, cfg, opt))(s0, batch)
+    si, mi = jax.jit(make_train_step(model, cfg, opt,
+                                     dtype_policy="int8_edge"))(s0, batch)
+    ref, got = float(mf["loss"]), float(mi["loss"])
+    assert np.isfinite(got)
+    assert got != ref  # the filter kernels really were rounded
+    assert abs(got - ref) < 0.05 * (abs(ref) + 1e-3)
+    for leaf in jax.tree.leaves((si.params, si.opt_state, si.batch_stats)):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+
+def test_gate_accepts_int8_edge(tmp_path):
+    """The golden-replay gate accepts an int8_edge request whose drift is
+    inside tolerance and persists the verdict (the toy SAGE model has no
+    edge MLPs, so the pilot program replays the f32 numbers exactly)."""
+    loaders = _Loaders(n_train=16)
+    _, hist = _run(loaders, tmp_path, "int8_on", num_epoch=1,
+                   training_extra={"train_dtype_policy": "int8_edge"})
+    assert hist["pipeline"]["train_dtype"] == "int8_edge"
+    assert hist["pipeline"]["train_dtype_requested"] == "int8_edge"
+    assert np.isfinite(hist["train"][0])
+
+
+def test_gate_int8_edge_reject_falls_back_bit_identical(tmp_path, monkeypatch):
+    """A rejected int8_edge request trains EXACTLY as an unrequested f32
+    run, with the same loud train_dtype_reject health event bf16 uses."""
+    import hydragnn_tpu.train.trainer as trainer_mod
+
+    loaders = _Loaders(n_train=16)
+    state_ref, hist_ref = _run(loaders, tmp_path, "f32_ref8", num_epoch=1)
+    assert hist_ref["pipeline"]["train_dtype"] == "f32"
+
+    monkeypatch.setattr(trainer_mod, "_TRAIN_DTYPE_TOL", -1.0)
+    tele = MetricsLogger.disabled()
+    with pytest.warns(UserWarning, match="REJECTED"):
+        state_rej, hist_rej = _run(
+            loaders, tmp_path, "int8_rejected", num_epoch=1,
+            training_extra={"train_dtype_policy": "int8_edge"},
+            telemetry=tele)
+    assert hist_rej["pipeline"]["train_dtype"] == "f32"
+    assert hist_rej["pipeline"]["train_dtype_requested"] == "int8_edge"
+    assert tele.health_counts.get("train_dtype_reject") == 1
+    assert _leaves_equal(state_rej.params, state_ref.params)
+    assert _leaves_equal(state_rej.opt_state, state_ref.opt_state)
+
+
+# ---------------------------------------------------------------------------
 # crash/resume bit-parity under the policy
 # ---------------------------------------------------------------------------
 

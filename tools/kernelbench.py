@@ -32,6 +32,17 @@ Four moment sets:
             the EGNN mainline-MFU claim.  The fused rows are Pallas
             (skipped off-TPU without --force-pallas); bf16 carries the
             same CPU-emulation caveat as matmul.
+  scf       SchNet's continuous-filter convolution (ops/scf_mp.py, a
+            spec on the fused-block builder): composed chain (filter MLP
+            on the rbf expansion -> cutoff multiply -> gather-multiply ->
+            segment sum) vs the one fused pass, f32 and bf16.
+  gatfused  GATv2 edge attention (ops/gat_mp.py): composed chain (two
+            gathers -> leaky-relu logits -> segment max -> exp ->
+            THREE segment scatters) vs the one fused attention pass.
+  cgcnn     CGCNN's gated sum (ops/cgcnn_mp.py, a spec on the builder):
+            composed chain ([x_i, x_j, e_ij] concat -> gate MLP pair ->
+            sigmoid*softplus -> segment sum) vs the one fused pass,
+            f32 and bf16.
 
 Methodology matches bench.py: each measurement jits a fori_loop of
 ``--inner`` serially-dependent applications (the loop carry feeds a hair of
@@ -134,6 +145,26 @@ def _time_chain(fn, data, inner, repeats):
     return best / inner
 
 
+def _edge_structure(receivers, mask, num_nodes, rng):
+    """Sender ids + sender-sort perm + int mask for the fused edge ops.
+
+    Senders are drawn inside the receiver's 128-node block, the collate
+    invariant (graphs never straddle a node block) the dense schedule's
+    3-block gather windows rely on, and padding edges park on node N-1
+    tail-sorted in BOTH orderings."""
+    import jax.numpy as jnp
+
+    e = receivers.shape[0]
+    s_np = ((receivers // 128) * 128
+            + rng.randint(0, 128, e)).astype(np.int32)
+    s_np = np.minimum(s_np, num_nodes - 1)
+    s_np[mask == 0] = num_nodes - 1  # padding edges: max sender id +
+    perm = jnp.asarray(np.argsort(s_np, kind="stable")  # stable sort
+                       .astype(np.int32))               # => tail
+    em = jnp.asarray((mask > 0).astype(np.int32))
+    return jnp.asarray(s_np), perm, em
+
+
 def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas,
               feat=0):
     """{name: data -> output} for the requested moment set."""
@@ -175,23 +206,14 @@ def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas,
         # EGNN interaction block: composed vs the one fused pass, f32 and
         # bf16.  Weights and edge structure are built EAGERLY like matmul.
         # The timed input is the NODE feature table (first n rows of the
-        # [E, F] problem data — E > N at every sweep shape); senders are
-        # drawn inside the receiver's 128-node block, the collate
-        # invariant (graphs never straddle a node block) the dense
-        # schedule's 3-block gather windows rely on, and padding edges
-        # park on node N-1 tail-sorted in BOTH orderings.
+        # [E, F] problem data — E > N at every sweep shape); edge
+        # structure comes from _edge_structure (the collate invariants
+        # the dense schedule relies on).
         from hydragnn_tpu.ops.egcl_mp import egcl_block
 
         rng = np.random.RandomState(13)
         e = receivers.shape[0]
-        s_np = ((receivers // 128) * 128
-                + rng.randint(0, 128, e)).astype(np.int32)
-        s_np = np.minimum(s_np, n - 1)
-        s_np[mask == 0] = n - 1  # padding edges: max sender id + stable
-        perm = jnp.asarray(np.argsort(s_np, kind="stable")  # sort => tail
-                           .astype(np.int32))
-        s = jnp.asarray(s_np)
-        em = jnp.asarray((mask > 0).astype(np.int32))
+        s, perm, em = _edge_structure(receivers, mask, n, rng)
         geo = jnp.asarray(np.concatenate(
             [rng.randn(e, 3).astype(np.float32) * 0.4,
              rng.rand(e, 1).astype(np.float32)], axis=1))
@@ -225,6 +247,128 @@ def _backends(moments, receivers, mask, num_nodes, on_tpu, force_pallas,
                 True, d[:n].astype(dt), geo, em, w0, b0, w1, b1,
                 wc0, bc0, wc1, s, r, perm)
             return agg.astype(jnp.float32), psum
+
+        out = {
+            "composed-f32": lambda d: composed(d, jnp.float32),
+            "composed-bf16": lambda d: composed(d, jnp.bfloat16),
+        }
+        if run_pallas:
+            out["fused-f32"] = lambda d: fused(d, jnp.float32)
+            out["fused-bf16"] = lambda d: fused(d, jnp.bfloat16)
+        return out
+
+    if moments == "scf":
+        # SchNet continuous-filter conv: composed vs the builder spec.
+        from hydragnn_tpu.models.layers import shifted_softplus
+        from hydragnn_tpu.ops.scf_mp import scf_edge_pipeline
+
+        rng = np.random.RandomState(17)
+        e = receivers.shape[0]
+        s, perm, em = _edge_structure(receivers, mask, n, rng)
+        g = 32  # rbf expansion width (the flagship num_gaussians scale)
+        rbf = jnp.asarray(rng.rand(e, g).astype(np.float32))
+        # cutoff carries the edge mask (zero on padding — the contract)
+        cm = jnp.asarray((rng.rand(e).astype(np.float32) * 0.9 + 0.1)
+                         * mask)
+        w0 = jnp.asarray(rng.randn(g, feat).astype(np.float32) * 0.1)
+        b0 = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+        w1 = jnp.asarray(rng.randn(feat, feat).astype(np.float32) * 0.1)
+        b1 = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+
+        def composed(d, dt):
+            h = d[:n].astype(dt)
+            filt = shifted_softplus(
+                rbf.astype(dt) @ w0.astype(dt) + b0.astype(dt))
+            filt = (filt @ w1.astype(dt) + b1.astype(dt)) \
+                * cm[:, None].astype(dt)
+            return jax.ops.segment_sum(
+                h[s] * filt, r, num_segments=n).astype(jnp.float32)
+
+        def fused(d, dt):
+            return scf_edge_pipeline(
+                d[:n].astype(dt), rbf, cm, em, w0, b0, w1, b1,
+                s, r, perm).astype(jnp.float32)
+
+        out = {
+            "composed-f32": lambda d: composed(d, jnp.float32),
+            "composed-bf16": lambda d: composed(d, jnp.bfloat16),
+        }
+        if run_pallas:
+            out["fused-f32"] = lambda d: fused(d, jnp.float32)
+            out["fused-bf16"] = lambda d: fused(d, jnp.bfloat16)
+        return out
+
+    if moments == "gatfused":
+        # GATv2 edge attention: composed (2 gathers, segment max, exp,
+        # 3 scatters) vs the one-pass fused attention kernel.
+        from hydragnn_tpu.ops.gat_mp import gat_edge_attention_tiled
+
+        rng = np.random.RandomState(19)
+        e = receivers.shape[0]
+        s, perm, em = _edge_structure(receivers, mask, n, rng)
+        heads = 4
+        fh = max(feat // heads, 1)
+        hf = heads * fh
+        att = rng.randn(heads, fh).astype(np.float32) * 0.2
+        att_np = np.zeros((hf, heads), np.float32)
+        for h_i in range(heads):
+            att_np[h_i * fh:(h_i + 1) * fh, h_i] = att[h_i]
+        att_mat = jnp.asarray(att_np)
+        b_edge = jnp.asarray(np.repeat(mask[:, None], heads, axis=1))
+        slope = 0.2
+
+        def composed(d):
+            x = d[:n, :hf]
+            u = jax.nn.leaky_relu(x[s] + x[r], slope)
+            logits = jnp.where(m[:, None] > 0, u @ att_mat, -_BIG)
+            mx = jax.ops.segment_max(logits, r, num_segments=n)
+            mx = jnp.where(mx <= -_BIG * 0.5, 0.0, mx)
+            ex = jnp.exp(logits - jax.lax.stop_gradient(mx)[r]) * b_edge
+            dsum = jax.ops.segment_sum(ex, r, num_segments=n)
+            wmsg = (ex[:, :, None] * x[s].reshape(e, heads, fh)
+                    ).reshape(e, hf)
+            acc = jax.ops.segment_sum(wmsg, r, num_segments=n)
+            return acc, mx, dsum
+
+        def fused(d):
+            x = d[:n, :hf]
+            return gat_edge_attention_tiled(
+                x, x, att_mat, s, r, perm, m, b_edge, (slope, fh))
+
+        out = {"composed": composed}
+        if run_pallas:
+            out["fused"] = fused
+        return out
+
+    if moments == "cgcnn":
+        # CGCNN gated sum: composed concat chain vs the builder spec.
+        from hydragnn_tpu.ops.cgcnn_mp import cgcnn_gated_block
+
+        rng = np.random.RandomState(23)
+        e = receivers.shape[0]
+        s, perm, em = _edge_structure(receivers, mask, n, rng)
+        a = 16  # edge_attr width (bond-feature scale)
+        ea = jnp.asarray(rng.rand(e, a).astype(np.float32))
+        kf = jnp.asarray(rng.randn(2 * feat + a, feat)
+                         .astype(np.float32) * 0.1)
+        bf = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+        ks = jnp.asarray(rng.randn(2 * feat + a, feat)
+                         .astype(np.float32) * 0.1)
+        bs = jnp.asarray(rng.randn(feat).astype(np.float32) * 0.1)
+
+        def composed(d, dt):
+            x = d[:n].astype(dt)
+            z = jnp.concatenate([x[r], x[s], ea.astype(dt)], axis=-1)
+            gate = jax.nn.sigmoid(z @ kf.astype(dt) + bf.astype(dt))
+            core = jax.nn.softplus(z @ ks.astype(dt) + bs.astype(dt))
+            return jax.ops.segment_sum(
+                gate * core * m[:, None].astype(dt), r,
+                num_segments=n).astype(jnp.float32)
+
+        def fused(d, dt):
+            return cgcnn_gated_block(
+                d[:n].astype(dt), ea, em, kf, bf, ks, bs,
+                s, r, perm).astype(jnp.float32)
 
         out = {
             "composed-f32": lambda d: composed(d, jnp.float32),
@@ -288,8 +432,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--shapes", default="small,flagship",
                     help=f"comma list from {sorted(SHAPES)}")
-    ap.add_argument("--moments", default="sum,pna,matmul,egcl",
-                    help="comma list from sum,pna,matmul,egcl")
+    ap.add_argument("--moments",
+                    default="sum,pna,matmul,egcl,scf,gatfused,cgcnn",
+                    help="comma list from "
+                         "sum,pna,matmul,egcl,scf,gatfused,cgcnn")
     ap.add_argument("--inner", type=int, default=20,
                     help="op applications per compiled loop (default 20)")
     ap.add_argument("--repeats", type=int, default=3,

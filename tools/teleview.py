@@ -659,12 +659,17 @@ def main(argv=None) -> int:
         with open(args.bench) as f:
             ev = json.load(f)
         ok, failures, table = _bench.dense_gate(ev)
+        floors = ", ".join(
+            f"{k} ≥{v}%" for k, v in sorted(
+                _bench.DENSE_MFU_FLOORS.items()))
         print(f"\ndense gate ({args.bench}): "
-              f"MFU floor {_bench.DENSE_MFU_FLOOR}%, fused dispatch on "
+              f"MFU floors {floors} (else ≥{_bench.DENSE_MFU_FLOOR}%), "
+              "fused dispatch on "
               + "/".join(_bench.MAINLINE_FUSED_ARCHS))
         for row in table:
             if row["kind"] == "dense":
-                print(f"  rung {row['name']}: {row['mfu_pct']}% MFU  "
+                print(f"  rung {row['name']}: {row['mfu_pct']}% MFU "
+                      f"(floor {row['mfu_floor']}%)  "
                       f"{row['graphs_per_sec']} g/s")
             else:
                 print(f"  arch {row['name']}: {row['graphs_per_sec']} g/s"
