@@ -100,6 +100,10 @@ _KNOB_LIST = [
        "hydragnn_tpu/data/stream/config.py",
        "ingest dir to tail: re-reads the manifest each epoch and trains "
        "on newly sealed segments (implies stream)"),
+    _k("HYDRAGNN_STREAM_OPEN_RETRIES", "Dataset.stream_open_retries", "2",
+       "hydragnn_tpu/data/stream/config.py",
+       "store-open retry attempts (bounded backoff) before the "
+       "in-memory fallback"),
     # -- trainer / pipeline ----------------------------------------------
     _k("HYDRAGNN_AUTO_PIPELINE", "", "1",
        "hydragnn_tpu/train/trainer.py",
@@ -261,6 +265,10 @@ _KNOB_LIST = [
     _k("HYDRAGNN_CKPT_BACKOFF", "Training.ckpt_backoff", "0.5",
        "hydragnn_tpu/resilience/config.py",
        "checkpoint retry backoff (seconds, doubling)"),
+    _k("HYDRAGNN_ELASTIC_RESUME", "Training.elastic_resume", "strict",
+       "hydragnn_tpu/resilience/elastic.py",
+       "world-shape-mismatch resume policy: strict refuses loudly, "
+       "epoch admits the resize at an epoch boundary"),
     # -- chaos (test-only fault injection) -------------------------------
     _k("HYDRAGNN_CHAOS_NAN_STEP", "Training.Chaos.nan_step", "off",
        "hydragnn_tpu/resilience/chaos.py",
@@ -271,6 +279,10 @@ _KNOB_LIST = [
     _k("HYDRAGNN_CHAOS_CKPT_FAILS", "Training.Chaos.ckpt_fails", "off",
        "hydragnn_tpu/resilience/chaos.py",
        "fail the first N checkpoint writes"),
+    _k("HYDRAGNN_CHAOS_ELASTIC", "Training.Chaos.elastic", "off",
+       "hydragnn_tpu/resilience/chaos.py",
+       "force an elastic resize of ±k hosts at an epoch boundary "
+       "(epoch:±k | e:±k)"),
     _k("HYDRAGNN_CHAOS_SERVE_PREDICT_MS", "Serving.Chaos.predict_ms",
        "off", "hydragnn_tpu/resilience/chaos.py",
        "inject predict latency (ms|ms@k+)"),
@@ -462,6 +474,18 @@ _HEALTH_LIST = [
     _h("train_dtype_reject", "hydragnn_tpu/train/trainer.py",
        "bf16 train policy requested but rejected (golden-gate drift, "
        "graph sharding, or empty loader) — run fell back to f32"),
+    # elastic training (docs/TELEMETRY.md + docs/RESILIENCE.md)
+    _h("elastic_resize", "hydragnn_tpu/resilience/elastic.py",
+       "a world resize was agreed at an epoch boundary, or a "
+       "shape-changed resume was admitted"),
+    _h("elastic_admit", "hydragnn_tpu/train/trainer.py",
+       "this host resumed INTO a new world shape (carries the converted "
+       "position and the saved shape)"),
+    _h("elastic_retire", "hydragnn_tpu/resilience/elastic.py",
+       "world shrinking: surplus hosts exit through the bundle path at "
+       "the agreed boundary and never relaunch"),
+    _h("elastic_refuse", "hydragnn_tpu/resilience/elastic.py",
+       "strict policy refused a world-shape-mismatched resume"),
     # serving lifecycle (docs/TELEMETRY.md "Serving events")
     _h("request_enqueued", "hydragnn_tpu/serve/batcher.py",
        "request accepted into the bounded queue"),
@@ -544,6 +568,9 @@ _HEALTH_LIST = [
        "streaming data plane active (store, plan and window metadata)"),
     _h("stream_fallback", "hydragnn_tpu/train/trainer.py",
        "streaming requested but the run fell back to the in-memory path"),
+    _h("stream_open_retry", "hydragnn_tpu/train/trainer.py",
+       "one failed streaming store-open attempt that was retried with "
+       "backoff before any fallback"),
     _h("stream_tail_grow", "hydragnn_tpu/train/trainer.py",
        "tail-mode store picked up newly sealed segments between epochs"),
     _h("stream_torn_segment", "hydragnn_tpu/data/stream/ingest.py",

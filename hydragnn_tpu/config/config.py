@@ -205,6 +205,15 @@ def finalize(
 
     for k, v in resilience_training_defaults().items():
         training.setdefault(k, v)
+    # elastic-resume policy (docs/RESILIENCE.md "Elastic training"):
+    # default "strict" written back and VALIDATED on every construction
+    # path — a typo'd policy must fail here, not silently refuse (or
+    # silently admit) a resized resume.  The HYDRAGNN_ELASTIC_RESUME env
+    # knob overlays at trainer build time (env wins).
+    from hydragnn_tpu.resilience.elastic import check_elastic_policy
+
+    training["elastic_resume"] = check_elastic_policy(
+        training.get("elastic_resume", "strict"))
     # ZeRO sharding stage (docs/SCALING.md §4): default 0 (replicated DP)
     # written back like the other Training defaults, and VALIDATED on every
     # construction path — a typo'd stage must fail here, not silently train

@@ -221,20 +221,42 @@ def _stream_loading_and_splitting(
             stacklevel=2)
         note_fallback("stratified splitting unsupported under streaming")
         return None
-    try:
+    # segment/manifest opens flake transiently on shared filesystems (stale
+    # NFS handles, metadata-server hiccups) — exactly the failures the
+    # checkpoint retry ladder absorbs — so the open routes through
+    # with_retries with bounded backoff BEFORE the in-memory fallback: one
+    # flake on a rejoining host must not silently change its memory
+    # profile.  Failed attempts buffer as `stream_open_retry` health
+    # events (OpenRetryRecorder; the trainer drains them).
+    from hydragnn_tpu.data.stream.config import OpenRetryRecorder
+    from hydragnn_tpu.resilience.ckpt_io import with_retries
+
+    opened = {}
+
+    def _open_store():
         if stream_cfg.tail:
-            store = open_tail_store(stream_cfg.tail)
-            if store is None:
+            s = open_tail_store(stream_cfg.tail)
+            if s is None:
                 raise FileNotFoundError(
                     f"no readable ingest segments under {stream_cfg.tail}")
         else:
-            store = GpackDataset(stream_cfg.path)
+            s = GpackDataset(stream_cfg.path)
+        opened["store"] = s
+
+    try:
+        with_retries(
+            _open_store, retries=stream_cfg.open_retries, backoff=0.25,
+            what="stream store open", telemetry=OpenRetryRecorder())
     except Exception as e:  # graftlint: disable=ROB001 (loud fallback: warned + note_fallback -> stream_fallback health event)
         warnings.warn(
-            f"streaming store open failed ({e}); falling back to the "
-            f"in-memory data path", stacklevel=2)
-        note_fallback(f"store open failed: {e}")
+            f"streaming store open failed after "
+            f"{stream_cfg.open_retries + 1} attempt(s) ({e}); falling "
+            f"back to the in-memory data path", stacklevel=2)
+        note_fallback(
+            f"store open failed after {stream_cfg.open_retries + 1} "
+            f"attempt(s): {e}")
         return None
+    store = opened["store"]
     n = len(store)
     if n == 0:
         note_fallback("store is empty")

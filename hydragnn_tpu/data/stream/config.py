@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from hydragnn_tpu.data.stream.plan import STREAM_ORDERS
 from hydragnn_tpu.utils.env import env_int, env_str
@@ -43,7 +43,7 @@ class StreamConfig:
 
     Env knobs: HYDRAGNN_STREAM, HYDRAGNN_STREAM_PATH,
     HYDRAGNN_STREAM_WINDOW, HYDRAGNN_STREAM_ORDER, HYDRAGNN_STREAM_BLOCK,
-    HYDRAGNN_STREAM_TAIL.
+    HYDRAGNN_STREAM_TAIL, HYDRAGNN_STREAM_OPEN_RETRIES.
     """
 
     enabled: bool = False   # stream the gpack store instead of decoding all
@@ -52,6 +52,7 @@ class StreamConfig:
     order: str = "global"   # global | sequential | block (plan.py)
     block: int = 2048       # block size for order=block
     tail: str = ""          # ingest dir to tail (grows between epochs)
+    open_retries: int = 2   # store/manifest open retries before fallback
 
     @classmethod
     def from_dataset(cls, dataset: Optional[Dict[str, Any]]
@@ -65,6 +66,7 @@ class StreamConfig:
             order=check_stream_order(s.get("stream_order", d.order)),
             block=int(s.get("stream_block", d.block)),
             tail=str(s.get("stream_tail", d.tail) or ""),
+            open_retries=int(s.get("stream_open_retries", d.open_retries)),
         )
         # set-but-EMPTY env falls through to the config value (the repo's
         # env-knob convention, utils/env.py)
@@ -81,9 +83,16 @@ class StreamConfig:
             cfg.block = env_int("HYDRAGNN_STREAM_BLOCK", d.block)
         if os.environ.get("HYDRAGNN_STREAM_TAIL"):
             cfg.tail = env_str("HYDRAGNN_STREAM_TAIL", d.tail)
+        if os.environ.get("HYDRAGNN_STREAM_OPEN_RETRIES"):
+            cfg.open_retries = env_int("HYDRAGNN_STREAM_OPEN_RETRIES",
+                                       d.open_retries)
         if cfg.window < 1:
             raise ValueError(
                 f"Dataset.stream_window must be >= 1, got {cfg.window}")
+        if cfg.open_retries < 0:
+            raise ValueError(
+                f"Dataset.stream_open_retries must be >= 0, "
+                f"got {cfg.open_retries}")
         if cfg.block < 1:
             raise ValueError(
                 f"Dataset.stream_block must be >= 1, got {cfg.block}")
@@ -102,6 +111,7 @@ def stream_dataset_defaults() -> Dict[str, Any]:
         "stream_order": d.order,
         "stream_block": d.block,
         "stream_tail": d.tail,
+        "stream_open_retries": d.open_retries,
     }
 
 
@@ -118,3 +128,30 @@ def note_fallback(reason: str) -> None:
 
 def pop_fallback() -> Optional[str]:
     return _FALLBACK.pop("reason", None)
+
+
+# same handoff for store-open RETRIES: one NFS flake on a rejoining host
+# must not silently flip the run to the in-memory path (a different memory
+# profile), so opens go through resilience/ckpt_io.with_retries first and
+# each failed attempt is buffered here; the trainer drains the buffer into
+# `stream_open_retry` health events once the MetricsLogger exists.
+_OPEN_RETRIES: List[Dict[str, object]] = []
+
+
+class OpenRetryRecorder:
+    """telemetry-shaped shim for with_retries at data-load time: maps the
+    retry ladder's per-attempt events into the buffered handoff (the
+    giveup event is superseded by ``note_fallback``'s reason)."""
+
+    def health(self, kind: str, **fields) -> None:
+        if kind == "ckpt_retry":
+            _OPEN_RETRIES.append(
+                {"attempt": fields.get("attempt"),
+                 "what": fields.get("what"),
+                 "error": fields.get("error")})
+
+
+def pop_open_retries() -> List[Dict[str, object]]:
+    out = list(_OPEN_RETRIES)
+    _OPEN_RETRIES.clear()
+    return out

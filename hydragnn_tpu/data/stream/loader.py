@@ -148,6 +148,14 @@ class StreamingGraphLoader:
             block=self.block,
         )
 
+    def plan(self) -> StreamPlan:
+        """This loader's StreamPlan — public accessor for callers that
+        need the plan's identity rather than its order (the trainer
+        records ``plan().fingerprint()`` in the resume bundle's ``world``
+        block so an elastic resume can validate it replays the same
+        global order; resilience/elastic.py)."""
+        return self._plan_obj()
+
     def set_epoch(self, epoch: int) -> None:
         """Reseed the shuffle (parity: DistributedSampler.set_epoch); in
         tail mode also pick up newly sealed ingest segments."""

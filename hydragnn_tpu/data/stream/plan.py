@@ -103,6 +103,34 @@ class StreamPlan:
             return int(math.ceil(self.n_total / self.world_size))
         return self.n_total
 
+    # -- elastic resize ---------------------------------------------------
+    def fingerprint(self) -> str:
+        """Identity of the GLOBAL order this plan partitions — a stable
+        hash of ``(n_total, seed, shuffle, mode, block)``, deliberately
+        EXCLUDING ``(rank, world_size)``: an elastic resize re-partitions
+        the same global permutation across a different host count, so
+        two plans that agree here replay the same data even at different
+        world shapes.  Stored in the resume bundle's ``world`` block and
+        validated on resume (resilience/elastic.py)."""
+        import hashlib
+
+        key = (f"{self.n_total}:{self.seed}:{int(self.shuffle)}:"
+               f"{self.mode}:{self.block}")
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def elastic_handoff(self, world_size: int, rank: int) -> "StreamPlan":
+        """The plan host ``rank`` of a RESIZED world uses from the next
+        epoch boundary: same global order (same fingerprint), new host
+        split.  Because ``epoch_order`` wrap-pads the global order to a
+        multiple of ``world_size`` and strides it, the union over the new
+        ranks covers every dataset index exactly once per epoch — no
+        sample dropped or double-counted across the resize
+        (tests/test_elastic.py proves the exactly-once property)."""
+        import dataclasses as _dc
+
+        return _dc.replace(self, rank=int(rank),
+                           world_size=int(world_size))
+
     # -- introspection ----------------------------------------------------
     def part_ranges(self, bounds: np.ndarray,
                     epoch: int = 0) -> List[Tuple[int, int, int]]:
@@ -130,4 +158,5 @@ class StreamPlan:
             "mode": self.mode,
             "block": int(self.block),
             "host_share": self.host_share(),
+            "fingerprint": self.fingerprint(),
         }

@@ -295,6 +295,35 @@ def consolidate_state(state, zs: ZeroSharding, mesh: Mesh):
     return state
 
 
+def reshard_state(state, mesh: Mesh, *, stage: int = 0,
+                  axis: Optional[str] = None):
+    """Place a CONSOLIDATED (replicated-or-host, unpadded) state under
+    ``mesh`` at ``stage`` — the single entry point both the trainer's
+    initial placement and an ELASTIC resume use, so "resume at a
+    different world size" is the same code path as "start fresh", not a
+    parallel implementation.
+
+    Because every serialization path consolidates first (the bundles are
+    stage-agnostic, :func:`consolidate_state`), re-sharding at a new mesh
+    size M is exact by construction: leading dims re-pad to multiples of
+    M and each device re-slices its share of the SAME full tensors —
+    ``consolidate(reshard(consolidate(x))) == consolidate(x)``
+    bit-for-bit (tools/crashtest.py ``--elastic`` proves it).
+
+    Returns ``(state, ZeroSharding-or-None)`` (None at stage 0,
+    replicated)."""
+    stage = check_zero_stage(stage)
+    # normalize to host leaves: the state may be replicated over a
+    # PREVIOUS mesh (a different device set), whose shardings must not
+    # leak into the new placement
+    state = jax.device_get(state)
+    if stage == 0:
+        from hydragnn_tpu.parallel.mesh import replicate_state
+
+        return replicate_state(state, mesh), None
+    return zero_shard_state(state, mesh, axis=axis, stage=stage)
+
+
 # ---------------------------------------------------------------------------
 # resident-byte accounting (telemetry `sharding` block, bench --zero)
 # ---------------------------------------------------------------------------

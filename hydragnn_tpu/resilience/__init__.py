@@ -33,8 +33,18 @@ predict exceptions, corrupted hot-reload candidates via
 circuit breaker the serving predict path trips under persistent faults
 (docs/SERVING.md "Overload behavior").
 
+:mod:`~hydragnn_tpu.resilience.elastic` composes the three into ELASTIC
+training: a run checkpointed at world-shape N resumes at world-shape
+M ≠ N — the consolidated bundle re-shards under the launched mesh and
+ZeRO stage (parallel/zero.py:reshard_state), the streaming plan
+re-partitions the same global order across the new host count, and the
+epoch-boundary :class:`~hydragnn_tpu.resilience.elastic.ElasticCoordinator`
+admits/retires hosts with the preemption-agreement machinery (gated by
+``Training.elastic_resume``; ``strict`` default refuses mismatched
+shapes LOUDLY instead of the old silent mis-replay).
+
 Health events (``step_skipped``, ``preempt_save``, ``resume_from``,
-``ckpt_retry``, ...) flow through the telemetry spine
+``ckpt_retry``, ``elastic_resize``, ...) flow through the telemetry spine
 (:meth:`MetricsLogger.health`) into the JSONL event log and manifest; see
 docs/RESILIENCE.md for knobs and invariants.
 """
@@ -53,6 +63,14 @@ from hydragnn_tpu.resilience.ckpt_io import (  # noqa: F401
     atomic_write_json,
     atomic_write_pickle,
     with_retries,
+)
+from hydragnn_tpu.resilience.elastic import (  # noqa: F401
+    ElasticCoordinator,
+    ElasticWorldMismatchError,
+    check_elastic_policy,
+    elastic_policy_from_training,
+    resolve_resume,
+    world_block,
 )
 from hydragnn_tpu.resilience.guards import (  # noqa: F401
     NonFiniteGuardMonitor,

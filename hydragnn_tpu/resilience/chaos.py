@@ -27,6 +27,14 @@ counted across epochs; a scanned-K dispatch counts once):
                                every dispatch from 4 on)
   HYDRAGNN_CHAOS_PREEMPT_STEP  "7"  — request preemption after dispatch 7
   HYDRAGNN_CHAOS_CKPT_FAILS    "2"  — fail the first 2 ckpt attempts
+  HYDRAGNN_CHAOS_ELASTIC       "epoch:+1" | "epoch:-1" | "2:+1"  — force
+                               an elastic resize decision of ±k hosts at
+                               an epoch boundary ("epoch" = the first
+                               boundary reached; an integer pins the
+                               0-based epoch whose boundary fires) —
+                               drives the ElasticCoordinator
+                               (resilience/elastic.py) exactly as a
+                               capacity scheduler's drain request would
 
 The SERVING side (hydragnn_tpu/serve) has its own injector,
 :class:`ServeChaos`, driving the overload/breaker/reload tier-1 tests
@@ -103,6 +111,26 @@ def _parse_nan_spec(spec: str) -> Tuple[Set[int], Optional[int]]:
     return steps, frm
 
 
+def _parse_elastic_spec(spec: str) -> Tuple[Optional[int], int]:
+    """'epoch:+1' / 'epoch:-1' / '2:+1' -> (epoch_or_None, delta).
+
+    ``None`` for the epoch means "the first boundary reached" (the common
+    spelling ``epoch:±k``); an integer pins the 0-based epoch whose
+    boundary fires.  ``delta`` must be a non-zero signed host count."""
+    when, sep, d = str(spec).strip().partition(":")
+    if not sep:
+        raise ValueError(
+            f"HYDRAGNN_CHAOS_ELASTIC must be '<epoch|k>:<±delta>', "
+            f"got {spec!r}")
+    when = when.strip().lower()
+    at: Optional[int] = None if when == "epoch" else int(when)
+    delta = int(d.strip())
+    if delta == 0:
+        raise ValueError(
+            f"HYDRAGNN_CHAOS_ELASTIC delta must be non-zero, got {spec!r}")
+    return at, delta
+
+
 class Chaos:
     """Per-run fault injector; all counters are instance state so an HPO
     loop's next trial starts clean."""
@@ -110,14 +138,19 @@ class Chaos:
     def __init__(self, nan_steps: Set[int] = frozenset(),
                  nan_from: Optional[int] = None,
                  preempt_step: Optional[int] = None,
-                 ckpt_fails: int = 0):
+                 ckpt_fails: int = 0,
+                 elastic_at: Optional[int] = None,
+                 elastic_delta: int = 0):
         self.nan_steps = set(nan_steps)
         self.nan_from = nan_from
         self.preempt_step = preempt_step
         self.ckpt_fails = int(ckpt_fails)
+        self.elastic_at = elastic_at
+        self.elastic_delta = int(elastic_delta)
         self._dispatch = 0
         self._ckpt_fails_left = self.ckpt_fails
         self._preempt_fired = False
+        self._elastic_fired = False
         self.injected_nan = 0
 
     # -- construction --------------------------------------------------------
@@ -134,14 +167,19 @@ class Chaos:
                                  str(s.get("preempt_step", "") or ""))
         fails = os.environ.get("HYDRAGNN_CHAOS_CKPT_FAILS",
                                str(s.get("ckpt_fails", "") or ""))
+        elastic = os.environ.get("HYDRAGNN_CHAOS_ELASTIC",
+                                 str(s.get("elastic", "") or ""))
         nan_steps, nan_from = _parse_nan_spec(nan_spec) if nan_spec else (
             set(), None)
         preempt_step = int(preempt) if preempt else None
         ckpt_fails = int(fails) if fails else 0
+        elastic_at, elastic_delta = (_parse_elastic_spec(elastic)
+                                     if elastic else (None, 0))
         if not nan_steps and nan_from is None and preempt_step is None \
-                and ckpt_fails <= 0:
+                and ckpt_fails <= 0 and elastic_delta == 0:
             return None
-        return cls(nan_steps, nan_from, preempt_step, ckpt_fails)
+        return cls(nan_steps, nan_from, preempt_step, ckpt_fails,
+                   elastic_at, elastic_delta)
 
     # -- injection points ----------------------------------------------------
 
@@ -173,6 +211,21 @@ class Chaos:
             self._preempt_fired = True
             return True
         return False
+
+    @property
+    def elastic_armed(self) -> bool:
+        """True when an elastic resize injection is configured (the
+        ElasticCoordinator is only built at all when this holds)."""
+        return self.elastic_delta != 0
+
+    def elastic_now(self, epoch: int) -> int:
+        """The armed resize delta if the boundary after ``epoch`` is the
+        injection point (fires once), else 0."""
+        if (self.elastic_delta and not self._elastic_fired
+                and (self.elastic_at is None or epoch >= self.elastic_at)):
+            self._elastic_fired = True
+            return self.elastic_delta
+        return 0
 
     def ckpt_attempt(self) -> None:
         """Raise while injected checkpoint failures remain."""

@@ -27,7 +27,8 @@ class ResilienceConfig:
 
     Env knobs: HYDRAGNN_NONFINITE_GUARD, HYDRAGNN_GUARD_MAX_BAD,
     HYDRAGNN_GUARD_POLL, HYDRAGNN_PREEMPT, HYDRAGNN_PREEMPT_SYNC,
-    HYDRAGNN_CKPT_RETRIES, HYDRAGNN_CKPT_BACKOFF.
+    HYDRAGNN_CKPT_RETRIES, HYDRAGNN_CKPT_BACKOFF,
+    HYDRAGNN_ELASTIC_RESUME.
     """
 
     nonfinite_guard: bool = False
@@ -37,9 +38,12 @@ class ResilienceConfig:
     preempt_sync_every: int = 8
     ckpt_retries: int = 3
     ckpt_backoff: float = 0.5
+    elastic_resume: str = "strict"
 
     @classmethod
     def from_training(cls, training: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        from hydragnn_tpu.resilience.elastic import check_elastic_policy
+
         s = dict(training or {})
         d = cls()
         cfg = cls(
@@ -54,6 +58,10 @@ class ResilienceConfig:
                                          d.preempt_sync_every)),
             ckpt_retries=int(s.get("ckpt_retries", d.ckpt_retries)),
             ckpt_backoff=float(s.get("ckpt_backoff", d.ckpt_backoff)),
+            # validated here, env-overlaid below (shared validator:
+            # resilience/elastic.py:check_elastic_policy)
+            elastic_resume=check_elastic_policy(
+                s.get("elastic_resume", d.elastic_resume)),
         )
         if "HYDRAGNN_NONFINITE_GUARD" in os.environ:
             cfg.nonfinite_guard = env_flag("HYDRAGNN_NONFINITE_GUARD")
@@ -74,6 +82,11 @@ class ResilienceConfig:
         if "HYDRAGNN_CKPT_BACKOFF" in os.environ:
             cfg.ckpt_backoff = float(
                 os.environ.get("HYDRAGNN_CKPT_BACKOFF") or d.ckpt_backoff)
+        if os.environ.get("HYDRAGNN_ELASTIC_RESUME"):
+            # set-but-empty falls through to the config value (the repo's
+            # env-knob convention, utils/env.py)
+            cfg.elastic_resume = check_elastic_policy(
+                os.environ["HYDRAGNN_ELASTIC_RESUME"])
         return cfg
 
 
@@ -89,4 +102,5 @@ def resilience_training_defaults() -> Dict[str, Any]:
         "preempt_sync_every": d.preempt_sync_every,
         "ckpt_retries": d.ckpt_retries,
         "ckpt_backoff": d.ckpt_backoff,
+        "elastic_resume": d.elastic_resume,
     }

@@ -1,0 +1,341 @@
+"""Elastic training: resume a run saved at world-shape N in world-shape M.
+
+Production pods ride preemptible capacity that shrinks and grows under a
+run; the reference HydraGNN assumes (rank, world_size) is fixed for the
+run's life.  Every primitive for elasticity already exists in this repo
+and this module composes them:
+
+- resume bundles are CONSOLIDATED stage-agnostically (parallel/zero.py:
+  consolidate_state runs before every save), so
+  :func:`~hydragnn_tpu.parallel.zero.reshard_state` can place the same
+  bundle under any launched mesh and ZeRO stage — leading dims re-pad to
+  multiples of the new axis size, moments re-slice;
+- the streaming StreamPlan is a pure function of
+  ``(n_total, seed, epoch, rank, world_size)`` (data/stream/plan.py), so
+  the per-host order at the new world size is a re-partition of the SAME
+  seeded global permutation — every dataset index is visited exactly once
+  per epoch at any world size (``StreamPlan.elastic_handoff``);
+- preemption agreement (resilience/preempt.py) supplies the allreduce
+  machinery the epoch-boundary :class:`ElasticCoordinator` reuses to
+  admit/retire hosts without a new collective protocol.
+
+The contract is EPOCH-GRANULAR: a resize takes effect at an epoch
+boundary, where the world's data position is a single integer (epoch).
+Mid-epoch positions (``items_consumed`` dispatch units) are world-shape
+DEPENDENT — a dispatch unit at world N covers ``G_N`` global samples —
+so a mid-epoch elastic resume either converts the position EXACTLY (the
+consumed sample count is a whole number of new-shape units, which holds
+whenever the global batch is preserved across the resize) or rounds UP
+to the next epoch boundary, loudly.
+
+``Training.elastic_resume`` policies:
+
+- ``strict`` (default) — refuse any world-shape mismatch with a
+  diagnostic naming both shapes and this knob.  This replaces the old
+  SILENT hazard: a bundle saved at N and resumed at M used to replay a
+  wrong-world shuffle and mis-count ``items_consumed`` without a word.
+- ``epoch``  — admit the resize.  Epoch-boundary bundles resume
+  directly; mid-epoch bundles convert exactly when possible, else round
+  up to the next epoch boundary.
+
+Health events: ``elastic_resize`` (a shape-changed resume was admitted,
+or the coordinator agreed on a resize), ``elastic_admit`` (this host
+entered the new world shape), ``elastic_retire`` (this host is leaving
+at an epoch boundary, bundle saved), ``elastic_refuse`` (strict policy
+refused a mismatched resume).  See docs/RESILIENCE.md "Elastic
+training".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+ELASTIC_POLICIES = ("strict", "epoch")
+
+
+def check_elastic_policy(value: Any) -> str:
+    """Validate a ``Training.elastic_resume`` knob value."""
+    v = str(value or "strict").strip().lower()
+    if v not in ELASTIC_POLICIES:
+        raise ValueError(
+            f"Training.elastic_resume must be one of {ELASTIC_POLICIES}, "
+            f"got {value!r}")
+    return v
+
+
+def elastic_policy_from_training(training: Optional[Dict[str, Any]],
+                                 *, env: bool = True) -> str:
+    """Resolve the elastic-resume policy: ``Training.elastic_resume``
+    overlaid by the HYDRAGNN_ELASTIC_RESUME env knob (env wins; a
+    set-but-empty env falls through to the config value — the repo's
+    env-knob convention, utils/env.py)."""
+    s = dict(training or {})
+    policy = check_elastic_policy(s.get("elastic_resume", "strict"))
+    if env and os.environ.get("HYDRAGNN_ELASTIC_RESUME"):
+        policy = check_elastic_policy(os.environ["HYDRAGNN_ELASTIC_RESUME"])
+    return policy
+
+
+# -- the resume-meta `world` block -----------------------------------------
+
+
+def world_block(*, world_size: int, n_local_devices: int, dp_extent: int,
+                zero_stage: int, epoch_units: Optional[int] = None,
+                plan_fingerprint: Optional[str] = None) -> Dict[str, Any]:
+    """The ``world`` block written into ``resume_meta.json``: everything a
+    resume at a DIFFERENT shape needs to validate and convert the saved
+    position.
+
+    ``dp_extent`` is the total data-parallel extent (the number of
+    batch shards per step — mesh device count on the mesh path, 1 on the
+    local-jit path); it is the shape the stream split and the state
+    padding actually depend on, not ``world_size`` alone.
+    ``epoch_units`` is the saved run's dispatch units per train epoch
+    (``len`` of the final wrapped train loader) — the denominator for
+    converting a mid-epoch ``items_consumed`` across shapes.
+    ``plan_fingerprint`` identifies the streaming plan's GLOBAL order
+    (shape-independent, data/stream/plan.py) when the run streams."""
+    return {
+        "world_size": int(world_size),
+        "n_local_devices": int(n_local_devices),
+        "dp_extent": int(dp_extent),
+        "zero_stage": int(zero_stage),
+        "epoch_units": (int(epoch_units)
+                        if epoch_units is not None else None),
+        "plan_fingerprint": plan_fingerprint,
+    }
+
+
+def saved_world_from_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
+    """The saved run's world block, with a legacy fallback: pre-elastic
+    bundles carry only top-level ``world_size`` and
+    ``pipeline.n_local_devices`` — synthesize a partial block (no
+    ``epoch_units``) so the shape comparison still works."""
+    w = meta.get("world")
+    if isinstance(w, dict) and "dp_extent" in w:
+        return dict(w)
+    pipeline = meta.get("pipeline") or {}
+    ws = int(meta.get("world_size", 1) or 1)
+    nl = int(pipeline.get("n_local_devices", 1) or 1)
+    mesh_dp = bool(pipeline.get("use_mesh_dp", nl > 1 or ws > 1))
+    return world_block(
+        world_size=ws, n_local_devices=nl,
+        dp_extent=(ws * nl if mesh_dp else 1),
+        zero_stage=int(pipeline.get("zero_stage", 0) or 0),
+        epoch_units=None, plan_fingerprint=None)
+
+
+class ElasticWorldMismatchError(ValueError):
+    """A resume bundle's world shape differs from the launched shape and
+    the policy refuses the resize (``strict``, the default)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticDecision:
+    """Resolved resume position for the launched world shape.
+
+    ``elastic`` is False on the same-shape path — the caller must then
+    behave EXACTLY as before this module existed (the acceptance
+    criterion: a same-shape resume stays bit-identical, the elastic path
+    provably dormant)."""
+
+    elastic: bool
+    start_epoch: int
+    skip_first: int
+    rounded: bool  # a mid-epoch position was rounded to the next boundary
+    reason: str
+    saved: Dict[str, Any]
+    launched: Dict[str, Any]
+
+
+def _shapes_match(saved: Dict[str, Any], launched: Dict[str, Any]) -> bool:
+    return (int(saved.get("world_size", 1)) ==
+            int(launched.get("world_size", 1))
+            and int(saved.get("dp_extent", 1)) ==
+            int(launched.get("dp_extent", 1)))
+
+
+def _shape_str(w: Dict[str, Any]) -> str:
+    return (f"world_size={w.get('world_size')} "
+            f"dp_extent={w.get('dp_extent')} "
+            f"zero_stage={w.get('zero_stage')}")
+
+
+def resolve_resume(meta: Dict[str, Any], *, policy: str,
+                   launched: Dict[str, Any],
+                   telemetry=None) -> ElasticDecision:
+    """Decide where the launched run resumes, given the saved bundle meta
+    and the launched world block.
+
+    Same shape -> dormant pass-through of the saved position.  Shape
+    mismatch under ``strict`` -> :class:`ElasticWorldMismatchError`
+    naming both shapes and the knob.  Shape mismatch under ``epoch`` ->
+    admit: epoch-boundary bundles (``items_consumed == 0``) resume
+    directly; mid-epoch bundles convert ``items_consumed`` exactly when
+    the consumed sample count is a whole number of launched-shape
+    dispatch units (``items * units_new % units_saved == 0`` — both
+    epochs cover the same sample total, so units scale inversely with
+    the global batch), else round UP to the next epoch boundary: the
+    already-applied updates are never replayed (no double-count), and
+    the abandoned remainder of the epoch is surfaced loudly.
+    """
+    policy = check_elastic_policy(policy)
+    saved = saved_world_from_meta(meta)
+    epoch = int(meta.get("epoch", 0))
+    items = int(meta.get("items_consumed", 0))
+
+    if _shapes_match(saved, launched):
+        # the plan fingerprint must agree even at the same shape: a
+        # changed fingerprint means a DIFFERENT dataset/seed/order under
+        # the same world — items_consumed would replay the wrong samples
+        _check_fingerprint(saved, launched)
+        return ElasticDecision(
+            elastic=False, start_epoch=epoch, skip_first=items,
+            rounded=False, reason="same_shape", saved=saved,
+            launched=launched)
+
+    if policy == "strict":
+        msg = (
+            "resume bundle world shape mismatch: saved "
+            f"[{_shape_str(saved)}] but this run launched "
+            f"[{_shape_str(launched)}].  A bundle resumed at a different "
+            "world shape needs its state re-sharded and its stream "
+            "re-planned; set Training.elastic_resume: epoch (env "
+            "HYDRAGNN_ELASTIC_RESUME=epoch) to admit the resize at the "
+            "epoch boundary, or relaunch at the saved shape.")
+        if telemetry is not None:
+            telemetry.health("elastic_refuse", policy=policy,
+                             saved=_shape_str(saved),
+                             launched=_shape_str(launched))
+        raise ElasticWorldMismatchError(msg)
+
+    _check_fingerprint(saved, launched)
+    if items == 0:
+        return ElasticDecision(
+            elastic=True, start_epoch=epoch, skip_first=0, rounded=False,
+            reason="epoch_boundary", saved=saved, launched=launched)
+
+    units_saved = saved.get("epoch_units")
+    units_new = launched.get("epoch_units")
+    if units_saved and units_new:
+        units_saved, units_new = int(units_saved), int(units_new)
+        if items >= units_saved:
+            # the whole epoch's units were consumed before the save —
+            # positionally an epoch boundary
+            return ElasticDecision(
+                elastic=True, start_epoch=epoch + 1, skip_first=0,
+                rounded=False, reason="completed_epoch", saved=saved,
+                launched=launched)
+        if (items * units_new) % units_saved == 0:
+            return ElasticDecision(
+                elastic=True, start_epoch=epoch,
+                skip_first=(items * units_new) // units_saved,
+                rounded=False, reason="mid_epoch_exact", saved=saved,
+                launched=launched)
+    return ElasticDecision(
+        elastic=True, start_epoch=epoch + 1, skip_first=0, rounded=True,
+        reason="mid_epoch_rounded", saved=saved, launched=launched)
+
+
+def _check_fingerprint(saved: Dict[str, Any],
+                       launched: Dict[str, Any]) -> None:
+    fs, fl = saved.get("plan_fingerprint"), launched.get("plan_fingerprint")
+    if fs and fl and fs != fl:
+        raise ElasticWorldMismatchError(
+            f"resume bundle stream-plan fingerprint {fs} does not match "
+            f"this run's {fl}: the saved run streamed a different global "
+            "order (dataset size, seed, or order mode changed) — "
+            "items_consumed cannot be mapped onto this stream.  Relaunch "
+            "against the saved store/seed, or clear the resume bundle.")
+
+
+# -- epoch-boundary coordinator --------------------------------------------
+
+
+class ElasticCoordinator:
+    """Epoch-boundary admit/retire agreement for elastic resizes.
+
+    The coordinator answers one question at each epoch boundary: *does
+    the world resize now?*  A resize decision is armed locally — by the
+    chaos harness (``HYDRAGNN_CHAOS_ELASTIC``, resilience/chaos.py) or
+    programmatically via :meth:`request_resize` (a scheduler draining a
+    host) — and agreed across ranks with the same allreduce-max
+    machinery preemption agreement uses (resilience/preempt.py): any
+    rank arming makes EVERY rank see the decision at the same boundary,
+    so the bundle save below is a symmetric collective.
+
+    On an agreed resize every rank saves the epoch-boundary resume
+    bundle and exits (the trainer drives this through the existing
+    SIGTERM bundle path) — a retiring host simply never relaunches, a
+    joining host relaunches with ``continue`` at the new shape and
+    :func:`resolve_resume` admits it.  The JAX runtime cannot resize a
+    live mesh, so "resize" is deliberately checkpoint-and-relaunch; what
+    this module buys is that the relaunch may be a DIFFERENT size with
+    no bit lost.
+    """
+
+    def __init__(self, *, chaos=None, telemetry=None, world_size: int = 1,
+                 cross_rank: bool = False):
+        self.chaos = chaos
+        self.telemetry = telemetry
+        self.world_size = int(world_size)
+        self.cross_rank = bool(cross_rank)
+        self._requested_delta = 0
+        self._fired = False
+
+    @classmethod
+    def from_env(cls, *, chaos=None, telemetry=None, world_size: int = 1,
+                 cross_rank: bool = False) -> Optional["ElasticCoordinator"]:
+        """Build only when something can arm a resize (the chaos knob);
+        None otherwise — the trainer then threads no coordinator at all,
+        zero overhead on the common path."""
+        if chaos is None or not getattr(chaos, "elastic_armed", False):
+            return None
+        return cls(chaos=chaos, telemetry=telemetry, world_size=world_size,
+                   cross_rank=cross_rank)
+
+    def request_resize(self, delta: int) -> None:
+        """Arm a resize of ``delta`` hosts for the next epoch boundary
+        (a drain request from the capacity scheduler)."""
+        self._requested_delta = int(delta)
+
+    def poll(self, epoch: int) -> Optional[Dict[str, Any]]:
+        """One epoch-boundary check (after epoch ``epoch`` completed);
+        every rank must call it — the agreement is a collective.
+        Returns the agreed resize decision or None."""
+        if self._fired:
+            return None
+        delta = self._requested_delta
+        if self.chaos is not None and delta == 0:
+            delta = self.chaos.elastic_now(epoch)
+        if self.cross_rank:
+            from hydragnn_tpu.resilience.preempt import host_agree_max
+
+            # agree on the largest-magnitude armed delta (allreduce-max
+            # of magnitude, sign carried separately) — the same
+            # primitive preemption agreement rides: every rank enters
+            agreed = host_agree_max(
+                [abs(float(delta)), 1.0 if delta >= 0 else 0.0])
+            delta = int(agreed[0]) * (1 if agreed[1] > 0.5 else -1)
+        if delta == 0:
+            return None
+        self._fired = True
+        decision = {
+            "epoch": int(epoch) + 1,
+            "delta": int(delta),
+            "world_size": self.world_size,
+            "target_world_size": max(1, self.world_size + int(delta)),
+        }
+        if self.telemetry is not None:
+            self.telemetry.health("elastic_resize", **decision)
+            if delta < 0:
+                # shrinking: the surplus hosts retire through the bundle
+                # path and never relaunch; `elastic_admit` is emitted by
+                # the trainer when a host resumes INTO the new shape
+                self.telemetry.health(
+                    "elastic_retire", epoch=decision["epoch"],
+                    delta=int(delta),
+                    target_world_size=decision["target_world_size"])
+        return decision

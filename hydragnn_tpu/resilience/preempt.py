@@ -22,9 +22,22 @@ the operator's escape hatch when a graceful stop hangs.
 from __future__ import annotations
 
 import signal
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 import numpy as np
+
+
+def host_agree_max(values: Sequence[float]) -> np.ndarray:
+    """The cross-rank agreement primitive: allreduce-max of a small host
+    vector, every rank entering together.  Preemption agreement
+    (:meth:`PreemptionHandler.poll`) and the epoch-boundary elastic
+    coordinator (resilience/elastic.py) share this one collective — a
+    flag raised on ANY rank becomes visible on EVERY rank at the same
+    deterministic poll index, which is what keeps lockstep loaders and
+    collective bundle saves symmetric."""
+    from hydragnn_tpu.parallel.comm import host_allreduce
+
+    return host_allreduce(np.asarray(values, dtype=np.float64), "max")
 
 
 class PreemptionHandler:
@@ -96,9 +109,6 @@ class PreemptionHandler:
         if not self.cross_rank:
             self.stop_requested = self._flag
         elif force or self._polls % self.sync_every == 0:
-            from hydragnn_tpu.parallel.comm import host_allreduce
-
-            agreed = host_allreduce(
-                np.asarray([1.0 if self._flag else 0.0]), "max")[0]
+            agreed = host_agree_max([1.0 if self._flag else 0.0])[0]
             self.stop_requested = bool(agreed > 0.5)
         return self.stop_requested

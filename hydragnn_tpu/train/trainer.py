@@ -874,6 +874,15 @@ def train_validate_test(
             cross_rank=(not explicit_mesh and world_size > 1))
 
     n_local_devices = len(jax.local_devices())
+    if mesh is not None:
+        # an explicit (sub-)mesh may use a SUBSET of this process's
+        # devices (ensemble branch, in-process elastic harness): stack as
+        # many batches per dispatch as this process contributes to THAT
+        # mesh, not as many devices as the process owns — the stacked
+        # batch axis must equal the mesh's split extent
+        _pidx = jax.process_index()
+        n_local_devices = sum(
+            1 for d in mesh.devices.flat if d.process_index == _pidx)
     n_proc = jax.process_count()
     if use_mesh_dp is None:
         # multi-process runs MUST take the global-mesh path even with one
@@ -898,12 +907,19 @@ def train_validate_test(
     # recorded fallback reason surfaces here, and an active stream loader
     # forces device residency OFF — caching every collated batch on device
     # would re-materialize the epoch the stream exists to avoid holding.
-    from hydragnn_tpu.data.stream.config import pop_fallback
+    from hydragnn_tpu.data.stream.config import (
+        pop_fallback,
+        pop_open_retries,
+    )
     from hydragnn_tpu.data.stream.loader import (
         find_stream_loader,
         try_fast_forward,
     )
 
+    for _ev in pop_open_retries():
+        # store-open attempts that failed and were retried (bounded
+        # backoff, resilience/ckpt_io.with_retries) before any fallback
+        telemetry.health("stream_open_retry", **_ev)
     stream_fb = pop_fallback()
     if stream_fb:
         telemetry.health("stream_fallback", reason=stream_fb)
@@ -985,7 +1001,6 @@ def train_validate_test(
             make_dp_train_step,
             make_mesh,
             mesh_process_count,
-            replicate_state,
         )
 
         if mesh is None:
@@ -1041,16 +1056,15 @@ def train_validate_test(
                 "state in); training with REPLICATED state.  Use the halo "
                 "backend for ZeRO + graph sharding.", stacklevel=2)
             zero_stage, zero_fallback = 0, "gspmd_graph_shard"
-        zero_sh = None
-        if zero_stage > 0:
-            # ZeRO: optimizer state (stage 1) — and params (stage 2) — live
-            # sharded along the innermost mesh axis for the whole run
-            # (reference ZeroRedundancyOptimizer, optimizer.py:43-103)
-            from hydragnn_tpu.parallel.zero import zero_shard_state
+        # state placement through the ONE resume-composable entry point:
+        # stage 0 replicates, stage >= 1 shards optimizer state — and
+        # params at stage 2 — along the innermost mesh axis for the whole
+        # run (reference ZeroRedundancyOptimizer, optimizer.py:43-103).
+        # An elastic resume re-places a consolidated bundle with this
+        # same call, so init and resume placement cannot drift apart.
+        from hydragnn_tpu.parallel.zero import reshard_state
 
-            state, zero_sh = zero_shard_state(state, mesh, stage=zero_stage)
-        else:
-            state = replicate_state(state, mesh)
+        state, zero_sh = reshard_state(state, mesh, stage=zero_stage)
         gs_stats = {}
         if graph_shard == "halo":
             # halo graph sharding: ONE graph (batch) split across the mesh —
@@ -1319,6 +1333,12 @@ def train_validate_test(
             test_loader = ResidentDeviceLoader(test_loader)
         eval_step = jax.jit(make_eval_step(model, cfg))
 
+    # the launched world shape as the elastic machinery defines it:
+    # dp_extent is the number of batch shards per step — the extent the
+    # stream split and the ZeRO padding actually depend on, not
+    # world_size alone (resilience/elastic.py:world_block)
+    dp_extent = int(mesh.devices.size) if use_mesh_dp else 1
+
     scheduler = ReduceLROnPlateau()
     earlystopper = None
     if training.get("EarlyStopping"):
@@ -1361,6 +1381,14 @@ def train_validate_test(
         preempt = PreemptionHandler(
             sync_every=res_cfg.preempt_sync_every,
             cross_rank=(not explicit_mesh and world_size > 1)).install()
+    # epoch-boundary elastic resize agreement (resilience/elastic.py) —
+    # built only when something can arm a resize (the chaos knob today, a
+    # capacity scheduler's drain hook tomorrow); None costs nothing
+    from hydragnn_tpu.resilience import ElasticCoordinator
+
+    elastic_coord = ElasticCoordinator.from_env(
+        chaos=chaos, telemetry=telemetry, world_size=world_size,
+        cross_rank=(not explicit_mesh and world_size > 1))
 
     # Orbax FULL-train-state checkpoint (step counter + params + batch stats
     # + opt state) every N epochs — beyond the reference's best-model pickle,
@@ -1395,28 +1423,82 @@ def train_validate_test(
                          "HYDRAGNN_STEPS_PER_DISPATCH" not in os.environ}}
     lr = get_learning_rate(state.opt_state)
 
-    # -- mid-run resume (resilience/resume.py) ------------------------------
+    # -- mid-run resume (resilience/resume.py + resilience/elastic.py) ------
     # the bundle's items_consumed counts dispatch units of the FINAL wrapped
-    # train loader, so the pipeline shape must match the preempted run's —
-    # a silent mismatch would re-run or skip real optimizer steps
+    # train loader, so a same-shape resume must match the preempted run's
+    # pipeline shape — a silent mismatch would re-run or skip real optimizer
+    # steps.  A WORLD-shape mismatch routes through resolve_resume: strict
+    # (default) refuses loudly naming both shapes, `epoch` admits the
+    # resize at an epoch boundary (docs/RESILIENCE.md "Elastic training").
+    from hydragnn_tpu.resilience.elastic import resolve_resume, world_block
+
+    def _launched_world():
+        try:
+            units = int(len(train_loader)) or None
+        except TypeError:
+            units = None
+        return world_block(
+            world_size=world_size, n_local_devices=n_local_devices,
+            dp_extent=dp_extent, zero_stage=zero_stage, epoch_units=units,
+            plan_fingerprint=(stream_base.plan().fingerprint()
+                              if stream_base is not None else None))
+
     start_epoch = 0
     skip_first = 0
     if resume_meta:
+        decision = resolve_resume(
+            resume_meta, policy=res_cfg.elastic_resume,
+            launched=_launched_world(), telemetry=telemetry)
         rp = resume_meta.get("pipeline") or {}
-        if rp and (int(rp.get("steps_per_dispatch", steps_per_dispatch))
-                   != steps_per_dispatch
-                   or bool(rp.get("use_mesh_dp", use_mesh_dp))
-                   != bool(use_mesh_dp)
-                   or str(rp.get("graph_shard", graph_shard))
-                   != str(graph_shard)):
-            raise ValueError(
-                f"resume bundle was saved with pipeline {rp} but this run "
-                f"built steps_per_dispatch={steps_per_dispatch}, "
-                f"use_mesh_dp={use_mesh_dp}; resume with the same pipeline "
-                "knobs (HYDRAGNN_STEPS_PER_DISPATCH etc.) for an exact "
-                "continuation")
-        start_epoch = int(resume_meta.get("epoch", 0))
-        skip_first = int(resume_meta.get("items_consumed", 0))
+        if not decision.elastic:
+            # same-shape path: EXACTLY the pre-elastic validation, so an
+            # unchanged-world resume stays bit-identical (the elastic
+            # machinery is provably dormant here — tests/test_elastic.py)
+            if rp and (int(rp.get("steps_per_dispatch", steps_per_dispatch))
+                       != steps_per_dispatch
+                       or bool(rp.get("use_mesh_dp", use_mesh_dp))
+                       != bool(use_mesh_dp)
+                       or str(rp.get("graph_shard", graph_shard))
+                       != str(graph_shard)):
+                raise ValueError(
+                    f"resume bundle was saved with pipeline {rp} but this "
+                    f"run built steps_per_dispatch={steps_per_dispatch}, "
+                    f"use_mesh_dp={use_mesh_dp}; resume with the same "
+                    "pipeline knobs (HYDRAGNN_STEPS_PER_DISPATCH etc.) for "
+                    "an exact continuation")
+        else:
+            # admitted resize: the position is epoch-granular (or an exact
+            # unit conversion), so steps_per_dispatch / use_mesh_dp may
+            # differ freely — but graph_shard changes what a dispatch unit
+            # CONTAINS, so the stream is not comparable across backends
+            if str(rp.get("graph_shard", graph_shard)) != str(graph_shard):
+                raise ValueError(
+                    "elastic resume: bundle was saved with graph_shard="
+                    f"{rp.get('graph_shard')!r} but this run built "
+                    f"{graph_shard!r}; the dispatch-unit stream is not "
+                    "comparable across graph-shard backends")
+            saved_ws = int(decision.saved.get("world_size", 1))
+            telemetry.health(
+                "elastic_resize", saved_world=saved_ws,
+                world_size=world_size, epoch=decision.start_epoch,
+                rounded=bool(decision.rounded), reason=decision.reason)
+            telemetry.health(
+                "elastic_admit", epoch=decision.start_epoch,
+                items=decision.skip_first, saved_world=saved_ws,
+                world_size=world_size, zero_stage=zero_stage,
+                reason=decision.reason)
+            if decision.rounded:
+                import warnings
+
+                warnings.warn(
+                    "elastic resume rounded a mid-epoch position (epoch "
+                    f"{int(resume_meta.get('epoch', 0))}, "
+                    f"{int(resume_meta.get('items_consumed', 0))} unit(s) "
+                    "consumed) up to the epoch "
+                    f"{decision.start_epoch} boundary — the remainder of "
+                    "the saved epoch is not replayed", stacklevel=2)
+        start_epoch = decision.start_epoch
+        skip_first = decision.skip_first
         if resume_meta.get("scheduler"):
             scheduler.load_state_dict(resume_meta["scheduler"])
         if earlystopper is not None and resume_meta.get("earlystop"):
@@ -1467,6 +1549,10 @@ def train_validate_test(
                          "train_dtype": train_dtype,
                          "n_local_devices": n_local_devices},
             "world_size": world_size,
+            # the launched world shape + stream-plan identity: what a
+            # resume at a DIFFERENT shape validates against and converts
+            # the saved position with (resilience/elastic.py)
+            "world": _launched_world(),
         }
         ok = save_resume_bundle(
             consolidate(state), meta, resume_dir(logs_dir, log_name),
@@ -1666,6 +1752,24 @@ def train_validate_test(
                     verbosity,
                     f"Preempted at end of epoch {epoch}; resume bundle saved")
                 break
+            # agreed elastic resize: the position is the single integer
+            # epoch+1 — exactly what a different-shape relaunch can admit
+            # — so save the boundary bundle and exit through the same
+            # path a preemption takes; retiring hosts never relaunch,
+            # the survivors/joiners `continue` at the new world size
+            if elastic_coord is not None:
+                resize = elastic_coord.poll(epoch)
+                if resize is not None:
+                    _save_resume(epoch + 1, 0, reason="elastic")
+                    history["preempted"] = True
+                    history["elastic"] = resize
+                    print_distributed(
+                        verbosity,
+                        f"Elastic resize agreed at end of epoch {epoch}: "
+                        f"world {resize['world_size']} -> "
+                        f"{resize['target_world_size']}; resume bundle "
+                        "saved")
+                    break
 
     finally:
         # teardown runs on EVERY exit path — a crash mid-epoch must

@@ -24,6 +24,23 @@ Usage:
                                consolidated on save and re-sharded on load
                                — proving the PR-3 bit-parity guarantee
                                survives the shard/consolidate round trip
+        [--elastic]            ELASTIC matrix (docs/RESILIENCE.md "Elastic
+                               training"): kill a 4-device victim
+                               mid-epoch, resume at 3 and at 5 devices
+                               with the global batch preserved, across
+                               zero_stage 0/1/2 plus one streaming combo.
+                               Each combo proves three things: (1) the
+                               consolidated bundle survives a reshard
+                               round trip at the NEW device count
+                               bit-for-bit; (2) the strict default policy
+                               REFUSES the resize loudly; (3) under
+                               Training.elastic_resume: epoch the resumed
+                               run's loss trajectory matches an
+                               uninterrupted fixed-size run at the new
+                               count within FP-regroup tolerance
+                               (--elastic-rtol; bit-identity across
+                               different batch regroupings is not a thing
+                               floating point offers)
 
 Exit code 0 and "PARITY PASS" when the resumed run's params are identical
 to the uninterrupted run's; non-zero otherwise.  Runs anywhere (CPU ok);
@@ -116,12 +133,16 @@ def run_child(args) -> int:
     from hydragnn_tpu.resilience import load_resume_bundle, resume_dir
     from hydragnn_tpu.train.trainer import train_validate_test
 
-    n_train = 8 * args.batch_size if args.mesh else 6 * args.batch_size
+    n_train = args.n_train or (
+        8 * args.batch_size if args.mesh else 6 * args.batch_size)
     model, cfg, opt, state, loaders = _build(
         n_train, args.batch_size, args.epochs, args.mesh,
         stream=args.stream, workdir=args.workdir)
     logs_dir = os.path.join(args.workdir, "logs")
     log_name = "crashtest" if args.mode != "baseline" else "baseline"
+
+    if args.mode == "reshard":
+        return run_reshard_child(args, state, logs_dir)
 
     resume_meta = None
     if args.mode == "resume":
@@ -170,11 +191,53 @@ def run_child(args) -> int:
     final = os.path.join(args.workdir, f"{args.mode}_final.pk")
     atomic_write_pickle(final, jax.device_get(
         {"params": state.params, "opt_state": state.opt_state,
-         "step": state.step}))
+         "step": state.step,
+         # per-epoch losses: the elastic verdict compares TRAJECTORIES
+         # across device counts, where bit-identical params are not a
+         # floating-point possibility
+         "history": {"train": list(history["train"]),
+                     "val": list(history["val"])}}))
     print(f"crashtest child: {args.mode} done "
           f"(preempted={bool(history.get('preempted'))}, "
           f"epochs={len(history['train'])})", flush=True)
     return 0
+
+
+def run_reshard_child(args, skeleton, logs_dir) -> int:
+    """Prove the elastic state contract at THIS process's device count:
+    the victim's consolidated bundle, re-placed under the launched mesh at
+    the launched ZeRO stage and consolidated again, is bit-for-bit the
+    bundle — no leaf lost, no element changed, at a device count the
+    bundle was never saved under."""
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.zero import consolidate_state, reshard_state
+    from hydragnn_tpu.resilience import load_resume_bundle, resume_dir
+
+    bundle = load_resume_bundle(skeleton, resume_dir(logs_dir, "crashtest"))
+    if bundle is None:
+        print("crashtest child: NO RESUME BUNDLE FOUND", flush=True)
+        return 3
+    state, meta = bundle
+    world = meta.get("world") or {}
+    base = jax.device_get(state)
+    mesh = make_mesh()
+    st, zs = reshard_state(base, mesh, stage=args.zero)
+    back = jax.device_get(
+        consolidate_state(st, zs, mesh) if zs is not None else st)
+    la = jax.tree_util.tree_leaves(base)
+    lb = jax.tree_util.tree_leaves(back)
+    bad = (len(la) != len(lb)
+           or any(not np.array_equal(np.asarray(a), np.asarray(b))
+                  for a, b in zip(la, lb)))
+    n_dev = len(jax.devices())
+    print(f"crashtest child: reshard round trip saved_dp="
+          f"{world.get('dp_extent')} -> {n_dev} devices at zero_stage="
+          f"{args.zero}: {'FAIL' if bad else 'OK'} "
+          f"({len(la)} leaves)", flush=True)
+    return 1 if bad else 0
 
 
 # ---------------------------------------------------------------------------
@@ -182,10 +245,20 @@ def run_child(args) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _spawn(args, mode, extra_env=None):
+def _spawn(args, mode, extra_env=None, devices=None, batch_size=None,
+           n_train=0):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                **(extra_env or {}))
-    if args.mesh or args.zero:
+    if devices is not None:
+        # the elastic phases each relaunch at their OWN device count —
+        # strip any inherited count so the override is authoritative
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{devices}").strip()
+    elif args.mesh or args.zero:
         # the mesh/ZeRO paths need >1 device to mean anything: force a
         # virtual 4-device CPU mesh unless the caller (e.g. pytest's
         # conftest, 8 devices) already forced a count
@@ -196,8 +269,10 @@ def _spawn(args, mode, extra_env=None):
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--mode", mode, "--workdir", args.workdir,
            "--epochs", str(args.epochs),
-           "--batch-size", str(args.batch_size),
+           "--batch-size", str(batch_size or args.batch_size),
            "--epoch-sleep", str(args.epoch_sleep)]
+    if n_train:
+        cmd += ["--n-train", str(n_train)]
     if args.mesh:
         cmd.append("--mesh")
     if args.stream:
@@ -209,10 +284,146 @@ def _spawn(args, mode, extra_env=None):
                             stderr=subprocess.STDOUT, text=True)
 
 
-def _drain(proc, prefix):
+def _drain(proc, prefix, quiet_tail=0):
+    """Stream child output; with quiet_tail > 0 print only the last N
+    lines (the elastic matrix runs 20+ children) and return (rc, lines)."""
+    lines = []
     for line in proc.stdout:
-        print(f"  [{prefix}] {line.rstrip()}")
+        lines.append(line.rstrip())
+        if not quiet_tail:
+            print(f"  [{prefix}] {line.rstrip()}")
+    if quiet_tail:
+        for line in lines[-quiet_tail:]:
+            print(f"  [{prefix}] {line}")
+        return proc.wait(), lines
     return proc.wait()
+
+
+def _clean_workdir(workdir):
+    import shutil
+
+    for stale in ("logs", "baseline_final.pk", "victim_final.pk",
+                  "resume_final.pk", "stream_store.gpack",
+                  "stream_store.gpack.p0"):
+        path = os.path.join(workdir, stale)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+
+def run_elastic_parent(args) -> int:
+    """The elastic matrix: victim at N=4 devices killed mid-epoch, resume
+    at M = 3 and M = 5 with the global batch preserved (G = 60 samples
+    per dispatch at every count, so each step covers the same sample
+    set), across zero_stage 0/1/2 plus one streaming combo."""
+    import numpy as np
+
+    N, G = 4, 60
+    n_train, epochs = 2 * G, args.epochs  # 2 dispatch units per epoch
+    combos = [(stage, delta, False)
+              for stage in (0, 1, 2) for delta in (-1, +1)]
+    combos.append((0, -1, True))  # streaming loader rides the same path
+
+    os.makedirs(args.workdir, exist_ok=True)
+    print(f"crashtest: elastic matrix — victim N={N} devices, resume at "
+          f"N-1/N+1, global batch {G} preserved, {len(combos)} combos")
+    failures = []
+    for stage, delta, stream in combos:
+        M = N + delta
+        args.zero, args.stream, args.mesh = stage, stream, True
+        tag = (f"zero{stage} {N}->{M}" + (" stream" if stream else ""))
+        _clean_workdir(args.workdir)
+        print(f"crashtest: [{tag}] baseline — uninterrupted at {M} devices")
+        rc, _ = _drain(_spawn(args, "baseline", devices=M,
+                              batch_size=G // M, n_train=n_train),
+                       "baseline", quiet_tail=1)
+        if rc != 0:
+            failures.append(f"{tag}: baseline rc={rc}")
+            continue
+
+        print(f"crashtest: [{tag}] victim at {N} devices, injected "
+              "preemption at dispatch 1 (mid-epoch 0)")
+        rc, _ = _drain(_spawn(args, "victim", devices=N,
+                              batch_size=G // N, n_train=n_train,
+                              extra_env={
+                                  "HYDRAGNN_CHAOS_PREEMPT_STEP": "1"}),
+                       "victim", quiet_tail=1)
+        if rc != 0:
+            failures.append(f"{tag}: victim rc={rc}")
+            continue
+
+        if stage == combos[0][0] and delta == combos[0][1] and not stream:
+            # once: the DEFAULT policy must refuse the resize loudly
+            print(f"crashtest: [{tag}] strict-policy probe — resume at "
+                  f"{M} devices WITHOUT elastic_resume: epoch")
+            rc, lines = _drain(_spawn(args, "resume", devices=M,
+                                      batch_size=G // M, n_train=n_train),
+                               "strict", quiet_tail=1)
+            refused = rc != 0 and any("mismatch" in ln for ln in lines)
+            if not refused:
+                failures.append(f"{tag}: strict policy did NOT refuse "
+                                f"(rc={rc})")
+                continue
+            print(f"  [parent] strict refusal confirmed (rc={rc})")
+
+        print(f"crashtest: [{tag}] reshard round trip at {M} devices")
+        rc, _ = _drain(_spawn(args, "reshard", devices=M,
+                              batch_size=G // M, n_train=n_train),
+                       "reshard", quiet_tail=1)
+        if rc != 0:
+            failures.append(f"{tag}: reshard round trip rc={rc}")
+            continue
+
+        print(f"crashtest: [{tag}] elastic resume at {M} devices "
+              "(elastic_resume: epoch)")
+        rc, _ = _drain(_spawn(args, "resume", devices=M,
+                              batch_size=G // M, n_train=n_train,
+                              extra_env={
+                                  "HYDRAGNN_ELASTIC_RESUME": "epoch"}),
+                       "resume", quiet_tail=2)
+        if rc != 0:
+            failures.append(f"{tag}: elastic resume rc={rc}")
+            continue
+
+        with open(os.path.join(args.workdir, "baseline_final.pk"),
+                  "rb") as f:
+            base = pickle.load(f)
+        with open(os.path.join(args.workdir, "resume_final.pk"),
+                  "rb") as f:
+            res = pickle.load(f)
+        bh, rh = base["history"], res["history"]
+        # val: every epoch (end-of-epoch params at the same data
+        # position); train: full epochs only — the resumed epoch 0
+        # averages just the post-kill units, the baseline's all of them
+        dv = -1.0
+        val_ok = train_ok = len(rh["val"]) == len(bh["val"])
+        if val_ok:
+            dv = float(np.max(np.abs(
+                np.subtract(rh["val"], bh["val"])
+                / np.asarray(bh["val"]))))
+            val_ok = np.allclose(rh["val"], bh["val"],
+                                 rtol=args.elastic_rtol)
+            train_ok = np.allclose(rh["train"][1:], bh["train"][1:],
+                                   rtol=args.elastic_rtol)
+        verdict = "PASS" if (val_ok and train_ok) else "FAIL"
+        print(f"crashtest: [{tag}] PARITY {verdict} — val/train loss "
+              f"trajectories vs fixed-{M}-device run (max rel dev "
+              f"{dv:.2e}, tol {args.elastic_rtol:.0e})")
+        if verdict == "FAIL":
+            failures.append(
+                f"{tag}: trajectory mismatch val={rh['val']} "
+                f"baseline={bh['val']}")
+
+    if failures:
+        print(f"crashtest: ELASTIC PARITY FAIL — {len(failures)} of "
+              f"{len(combos)} combos:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print(f"crashtest: ELASTIC PARITY PASS — all {len(combos)} combos "
+          f"(reshard bit-exact, strict refusal, trajectory parity)")
+    return 0
 
 
 def run_parent(args) -> int:
@@ -222,16 +433,7 @@ def run_parent(args) -> int:
     # orbax checkpoints at a HIGHER step make the victim's bundle save a
     # silent no-op (orbax declines steps <= latest), so every run starts
     # from a clean scratch tree
-    import shutil
-
-    for stale in ("logs", "baseline_final.pk", "victim_final.pk",
-                  "resume_final.pk", "stream_store.gpack",
-                  "stream_store.gpack.p0"):
-        path = os.path.join(args.workdir, stale)
-        if os.path.isdir(path):
-            shutil.rmtree(path, ignore_errors=True)
-        elif os.path.exists(path):
-            os.unlink(path)
+    _clean_workdir(args.workdir)
     print(f"crashtest: workdir {args.workdir}")
 
     print("crashtest: phase 1/3 — uninterrupted baseline")
@@ -341,14 +543,26 @@ def main(argv=None) -> int:
                     help="ZeRO stage for all three phases (implies --mesh): "
                          "proves consolidate-on-save / re-shard-on-resume "
                          "preserves mid-epoch bit parity")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic resize matrix: victim killed at "
+                         "4 devices, resumed at 3 and 5 across zero_stage "
+                         "0/1/2 + streaming (see module docstring)")
+    ap.add_argument("--elastic-rtol", type=float, default=2e-2,
+                    help="loss-trajectory tolerance for the elastic "
+                         "verdict (cross-device-count FP regroup)")
+    ap.add_argument("--n-train", type=int, default=0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
-    ap.add_argument("--mode", choices=("baseline", "victim", "resume"),
+    ap.add_argument("--mode",
+                    choices=("baseline", "victim", "resume", "reshard"),
                     default="baseline", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.zero:
         args.mesh = True
     if args.child:
         return run_child(args)
+    if args.elastic:
+        return run_elastic_parent(args)
     return run_parent(args)
 
 
